@@ -1,0 +1,45 @@
+"""automerge_tpu: a TPU-native JSON CRDT framework.
+
+Capability-parity with Automerge v0.8 (the reference at /root/reference):
+causally-ordered change delivery, LWW conflict resolution with surfaced
+conflicts, RGA-ordered lists and Text, undo/redo, change history with time
+travel, save/load, and a transport-agnostic DocSet/Connection sync protocol.
+
+Architecture (see SURVEY.md for the blueprint):
+- `core/`     — the per-document semantic engine (the oracle).
+- `frontend/` — frozen snapshots, change contexts, proxies.
+- `sync/`     — DocSet / WatchableDoc / Connection (reference wire schema).
+- `engine/`   — the columnar, batched JAX execution path: one program
+  reconciles thousands of documents (the DocSet is the batch axis).
+- `parallel/` — device-mesh sharding of batched DocSets; clock unions as
+  collective max-reductions.
+"""
+
+from .api import (
+    init, change, empty_change, merge, diff, assign, load, save, equals,
+    inspect, get_history, get_conflicts, get_changes, get_changes_for_actor,
+    apply_changes, get_missing_changes, get_missing_deps, get_clock,
+    get_actor_id, can_undo, undo, can_redo, redo,
+)
+from .core.change import Change, Op
+from .core.ids import ROOT_ID
+from .frontend.text import Text
+from .sync import Connection, DocSet, WatchableDoc
+from .utils import uuid as _uuid_mod
+from .utils.uuid import make_uuid as uuid
+
+# uuid() generates; uuid.set_factory/reset swap the generator (deterministic tests)
+uuid.set_factory = _uuid_mod.set_factory
+uuid.reset = _uuid_mod.reset
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init", "change", "empty_change", "merge", "diff", "assign", "load",
+    "save", "equals", "inspect", "get_history", "get_conflicts",
+    "get_changes", "get_changes_for_actor", "apply_changes",
+    "get_missing_changes", "get_missing_deps", "get_clock", "get_actor_id",
+    "can_undo", "undo", "can_redo", "redo",
+    "Change", "Op", "ROOT_ID", "Text", "Connection", "DocSet",
+    "WatchableDoc", "uuid", "__version__",
+]

@@ -1,0 +1,5 @@
+from .ids import ROOT_ID, HEAD, make_elem_id, parse_elem_id
+from .change import Op, Change
+from .opset import OpSet
+
+__all__ = ["ROOT_ID", "HEAD", "make_elem_id", "parse_elem_id", "Op", "Change", "OpSet"]

@@ -1,0 +1,74 @@
+"""Visible-element order index for lists and text.
+
+The reference maintains this index as a persistent order-statistic skip list
+(/root/reference/src/skip_list.js) giving O(log n) key<->index queries. The
+TPU-native design replaces rank queries with tombstone bitmaps + prefix scans
+in the columnar engine (automerge_tpu/engine/listkernel.py); this host-side
+structure only serves the interactive single-document frontend, where a flat
+array with a position dictionary is simpler and fast enough (O(n) worst-case
+updates, O(1) lookups). The public surface mirrors the skip list's:
+insert_index / set_value / remove_index / index_of / key_of / get_value
+(/root/reference/src/skip_list.js:169-327).
+
+Persistence contract: instances are immutable-by-discipline; the OpSet builder
+copies an ElemList before mutating it (copy-on-first-touch per change batch).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator
+
+
+class ElemList:
+    __slots__ = ("keys", "values", "_pos")
+
+    def __init__(self, keys: list[str] | None = None, values: list[Any] | None = None,
+                 pos: dict[str, int] | None = None):
+        self.keys = keys if keys is not None else []
+        self.values = values if values is not None else []
+        if pos is None:
+            pos = {k: i for i, k in enumerate(self.keys)}
+        self._pos = pos
+
+    def copy(self) -> "ElemList":
+        return ElemList(list(self.keys), list(self.values), dict(self._pos))
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+    def insert_index(self, index: int, key: str, value: Any) -> None:
+        self.keys.insert(index, key)
+        self.values.insert(index, value)
+        pos = self._pos
+        for i in range(index, len(self.keys)):
+            pos[self.keys[i]] = i
+
+    def remove_index(self, index: int) -> None:
+        key = self.keys.pop(index)
+        self.values.pop(index)
+        pos = self._pos
+        del pos[key]
+        for i in range(index, len(self.keys)):
+            pos[self.keys[i]] = i
+
+    def set_value(self, key: str, value: Any) -> None:
+        self.values[self._pos[key]] = value
+
+    def get_value(self, key: str) -> Any:
+        return self.values[self._pos[key]]
+
+    def index_of(self, key: str) -> int:
+        """Index of `key` among visible elements, or -1."""
+        return self._pos.get(key, -1)
+
+    def key_of(self, index: int) -> str | None:
+        """Element ID at `index`, or None if out of range."""
+        if 0 <= index < len(self.keys):
+            return self.keys[index]
+        return None
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self.keys)
+
+    def __repr__(self) -> str:
+        return f"ElemList({list(zip(self.keys, self.values))!r})"

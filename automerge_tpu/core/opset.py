@@ -1,0 +1,595 @@
+"""The CRDT semantic core: causally-ordered op application, LWW conflict
+resolution, RGA list ordering, and diff emission.
+
+This is the host-side *oracle* engine. Its semantics mirror the reference's
+OpSet (/root/reference/src/op_set.js) operation for operation; conformance
+targets (each covered by a test in tests/):
+
+- LWW winner among concurrent assigns = highest actorId (op_set.js:201,425);
+  losers are retained as conflicts keyed by actor (op_set.js:428-434).
+- Concurrent inserts at one position are ordered by Lamport (elem, actor)
+  descending, so each actor's runs do not interleave (op_set.js:343-362).
+- Delete vs concurrent assign: the assign wins — deletion only removes ops
+  causally prior to it (op_set.js:184-199).
+- Out-of-order changes buffer in a causal queue until ready (op_set.js:254-270);
+  duplicate deliveries are idempotent no-ops; reusing an (actor, seq) with
+  different content is an error (op_set.js:227-232).
+
+The batched/columnar TPU execution path lives in automerge_tpu/engine/ and is
+checked against this engine for byte-identical convergence (state hashing).
+
+Persistence model: `OpSet` instances are immutable. Mutation happens through a
+`Builder` that shallow-copies the top-level containers once per *batch* of
+changes and copies per-object state on first touch, so old document snapshots
+remain valid (the reference achieves the same with Immutable.js throughout,
+op_set.js:272-285).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator
+
+from ..utils.persist import AList, EMPTY_ALIST
+from .change import Change, Op
+from .ids import HEAD, ROOT_ID, make_elem_id, parse_elem_id
+from .elems import ElemList
+
+
+class Link:
+    """Marker for a link value inside an ElemList (points at a child object)."""
+
+    __slots__ = ("obj",)
+
+    def __init__(self, obj: str):
+        self.obj = obj
+
+    def __eq__(self, other):
+        return isinstance(other, Link) and self.obj == other.obj
+
+    def __hash__(self):
+        return hash(("__link__", self.obj))
+
+    def __repr__(self):
+        return f"Link({self.obj!r})"
+
+
+class ObjState:
+    """Per-object CRDT state (the reference's byObject entry, op_set.js:63-93).
+
+    - fields: key/elemId -> tuple of surviving assign ops, winner first
+    - following: parent elemId -> tuple of 'ins' ops inserted after it
+    - insertion: elemId -> the 'ins' op that created it
+    - inbound: ordered set (dict keys) of 'link' ops pointing at this object
+    - max_elem: per-list Lamport counter for element IDs
+    - elem_ids: visible-element order index (lists/text only)
+    """
+
+    __slots__ = ("init_action", "fields", "following", "insertion", "inbound",
+                 "max_elem", "elem_ids")
+
+    def __init__(self, init_action: str):
+        self.init_action = init_action
+        self.fields: dict[str, tuple[Op, ...]] = {}
+        self.following: dict[str, tuple[Op, ...]] = {}
+        self.insertion: dict[str, Op] = {}
+        self.inbound: dict[Op, None] = {}
+        self.max_elem = 0
+        self.elem_ids: ElemList | None = (
+            ElemList() if init_action in ("makeList", "makeText") else None)
+
+    def copy(self) -> "ObjState":
+        out = ObjState.__new__(ObjState)
+        out.init_action = self.init_action
+        out.fields = dict(self.fields)
+        out.following = dict(self.following)
+        out.insertion = dict(self.insertion)
+        out.inbound = dict(self.inbound)
+        out.max_elem = self.max_elem
+        out.elem_ids = self.elem_ids  # copied lazily by Builder.elem_ids_mut
+        return out
+
+    @property
+    def is_sequence(self) -> bool:
+        return self.init_action in ("makeList", "makeText")
+
+
+class Builder:
+    """Copy-on-write working state for applying a batch of changes."""
+
+    __slots__ = ("states", "by_object", "clock", "deps", "queue", "history",
+                 "_touched", "_elem_copied")
+
+    def __init__(self, opset: "OpSet"):
+        self.states: dict[str, AList] = dict(opset.states)
+        self.by_object: dict[str, ObjState] = dict(opset.by_object)
+        self.clock: dict[str, int] = dict(opset.clock)
+        self.deps: dict[str, int] = dict(opset.deps)
+        self.queue: list[Change] = list(opset.queue)
+        self.history: AList = opset.history
+        self._touched: set[str] = set()
+        self._elem_copied: set[str] = set()
+
+    def obj(self, object_id: str) -> ObjState:
+        """Object state for mutation (copied on first touch in this batch)."""
+        obj = self.by_object[object_id]
+        if object_id not in self._touched:
+            obj = obj.copy()
+            self.by_object[object_id] = obj
+            self._touched.add(object_id)
+        return obj
+
+    def elem_ids_mut(self, object_id: str) -> ElemList:
+        obj = self.obj(object_id)
+        if object_id not in self._elem_copied:
+            obj.elem_ids = obj.elem_ids.copy()
+            self._elem_copied.add(object_id)
+        return obj.elem_ids
+
+
+# ---------------------------------------------------------------------------
+# Causality (op_set.js:7-37)
+
+def is_concurrent(state, op1: Op, op2: Op) -> bool:
+    """True if neither stamped op causally precedes the other (op_set.js:7-16).
+
+    Ops lacking a (actor, seq) stamp — i.e. local ops inside an open change
+    block — are never concurrent with anything: prior ops are treated as
+    overwritten by the local edit.
+    """
+    a1, s1, a2, s2 = op1.actor, op1.seq, op2.actor, op2.seq
+    if not a1 or not a2 or not s1 or not s2:
+        return False
+    clock1 = state.states[a1][s1 - 1][1]
+    clock2 = state.states[a2][s2 - 1][1]
+    return clock1.get(a2, 0) < s2 and clock2.get(a1, 0) < s1
+
+
+def causally_ready(state, change: Change) -> bool:
+    """True if every causal predecessor of `change` has been applied
+    (op_set.js:20-27)."""
+    if state.clock.get(change.actor, 0) < change.seq - 1:
+        return False
+    for actor, seq in change.deps.items():
+        if actor != change.actor and state.clock.get(actor, 0) < seq:
+            return False
+    return True
+
+
+def transitive_deps(state, base_deps: dict[str, int]) -> dict[str, int]:
+    """Expand a dependency frontier into a full vector clock (op_set.js:29-37).
+
+    Unknown (actor, seq) entries — possible when computing missing changes
+    against a peer that is ahead of us — contribute only themselves.
+    """
+    out: dict[str, int] = {}
+    for actor, seq in base_deps.items():
+        if seq <= 0:
+            continue
+        entries = state.states.get(actor)
+        if entries is not None and seq - 1 < len(entries):
+            for dep_actor, dep_seq in entries[seq - 1][1].items():
+                if dep_seq > out.get(dep_actor, 0):
+                    out[dep_actor] = dep_seq
+        out[actor] = seq
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Paths and RGA traversal (op_set.js:43-60, 343-397)
+
+def get_path(state, object_id: str) -> list | None:
+    """Path from the root to `object_id` (string keys for maps, integer
+    indexes for lists), or None if unreachable (op_set.js:43-60)."""
+    path: list = []
+    while object_id != ROOT_ID:
+        obj = state.by_object.get(object_id)
+        if obj is None or not obj.inbound:
+            return None
+        ref = next(iter(obj.inbound))
+        object_id = ref.obj
+        parent = state.by_object[object_id]
+        if parent.is_sequence:
+            index = parent.elem_ids.index_of(ref.key)
+            if index < 0:
+                return None
+            path.insert(0, index)
+        else:
+            path.insert(0, ref.key)
+    return path
+
+
+def get_parent(state, object_id: str, key: str) -> str | None:
+    """elemId after which `key` was inserted, or None for the head
+    (op_set.js:336-341)."""
+    if key == HEAD:
+        return None
+    insertion = state.by_object[object_id].insertion.get(key)
+    if insertion is None:
+        raise TypeError(f"Missing index entry for list element {key}")
+    return insertion.key
+
+
+def insertions_after(state, object_id: str, parent_id: str,
+                     child_id: str | None = None) -> list[str]:
+    """Element IDs inserted directly after `parent_id`, in Lamport-descending
+    (elem, actor) order; if `child_id` is given, only those ordered before it
+    (op_set.js:351-362)."""
+    child_key = parse_elem_id(child_id) if child_id else None
+    obj = state.by_object[object_id]
+    ops = [op for op in obj.following.get(parent_id, ()) if op.action == "ins"]
+    if child_key is not None:
+        child_actor, child_elem = child_key
+        ops = [op for op in ops if (op.elem, op.actor) < (child_elem, child_actor)]
+    ops.sort(key=lambda op: (op.elem, op.actor), reverse=True)
+    return [make_elem_id(op.actor, op.elem) for op in ops]
+
+
+def get_next(state, object_id: str, key: str) -> str | None:
+    """Successor of `key` in RGA document order (op_set.js:364-376)."""
+    children = insertions_after(state, object_id, key)
+    if children:
+        return children[0]
+    while True:
+        ancestor = get_parent(state, object_id, key)
+        if ancestor is None:
+            return None
+        siblings = insertions_after(state, object_id, ancestor, key)
+        if siblings:
+            return siblings[0]
+        key = ancestor
+
+
+def get_previous(state, object_id: str, key: str) -> str | None:
+    """Predecessor of `key` in RGA document order, or None at the head
+    (op_set.js:380-397)."""
+    parent_id = get_parent(state, object_id, key)
+    children = insertions_after(state, object_id, parent_id if parent_id is not None else HEAD)
+    if children and children[0] == key:
+        return None if (parent_id is None or parent_id == HEAD) else parent_id
+
+    prev_id = None
+    for child in children:
+        if child == key:
+            break
+        prev_id = child
+    while True:
+        children = insertions_after(state, object_id, prev_id)
+        if not children:
+            return prev_id
+        prev_id = children[-1]
+
+
+def iter_list_elem_ids(state, object_id: str) -> Iterator[str]:
+    """All element IDs of a list/text object in RGA document order (including
+    deleted ones). Iterative preorder walk of the insertion tree — sequential
+    text insertions form a chain as deep as the document, so recursion is not
+    an option (the columnar engine linearizes the same tree with a sort-based
+    kernel instead, see engine/listkernel.py)."""
+    stack = [iter(insertions_after(state, object_id, HEAD))]
+    while stack:
+        nxt = next(stack[-1], None)
+        if nxt is None:
+            stack.pop()
+            continue
+        yield nxt
+        stack.append(iter(insertions_after(state, object_id, nxt)))
+
+
+# ---------------------------------------------------------------------------
+# Op application (op_set.js:63-252)
+
+def _type_of(obj: ObjState) -> str:
+    if obj.init_action == "makeText":
+        return "text"
+    if obj.init_action == "makeList":
+        return "list"
+    return "map"
+
+
+def _conflict_records(ops: tuple[Op, ...]) -> list[dict]:
+    """Conflict (loser) records for a multi-op field (op_set.js:95-103)."""
+    out = []
+    for op in ops[1:]:
+        record: dict[str, Any] = {"actor": op.actor, "value": op.value}
+        if op.action == "link":
+            record["link"] = True
+        out.append(record)
+    return out
+
+
+def apply_make(b: Builder, op: Op) -> list[dict]:
+    object_id = op.obj
+    if object_id in b.by_object:
+        raise ValueError(f"Duplicate creation of object {object_id}")
+    obj = ObjState(op.action)
+    b.by_object[object_id] = obj
+    b._touched.add(object_id)
+    b._elem_copied.add(object_id)
+    return [{"action": "create", "type": _type_of(obj), "obj": object_id}]
+
+
+def apply_insert(b: Builder, op: Op) -> list[dict]:
+    object_id = op.obj
+    elem_id = make_elem_id(op.actor, op.elem)
+    if object_id not in b.by_object:
+        raise ValueError(f"Modification of unknown object {object_id}")
+    obj = b.obj(object_id)
+    if elem_id in obj.insertion:
+        raise ValueError(f"Duplicate list element ID {elem_id}")
+    obj.following[op.key] = obj.following.get(op.key, ()) + (op,)
+    obj.max_elem = max(op.elem, obj.max_elem)
+    obj.insertion[elem_id] = op
+    return []
+
+
+def patch_list(b: Builder, object_id: str, index: int, action: str,
+               ops: tuple[Op, ...] | None) -> list[dict]:
+    obj = b.by_object[object_id]
+    first = ops[0] if ops else None
+    value = first.value if first is not None else None
+    edit: dict[str, Any] = {"action": action, "type": _type_of(obj),
+                            "obj": object_id, "index": index,
+                            "path": get_path(b, object_id)}
+    if first is not None and first.action == "link":
+        edit["link"] = True
+        value = Link(first.value)
+
+    elem_ids = b.elem_ids_mut(object_id)
+    if action == "insert":
+        elem_ids.insert_index(index, first.key, value)
+        edit["value"] = first.value
+    elif action == "set":
+        elem_ids.set_value(first.key, value)
+        edit["value"] = first.value
+    elif action == "remove":
+        elem_ids.remove_index(index)
+    else:
+        raise ValueError(f"Unknown action type: {action}")
+
+    if ops is not None and len(ops) > 1:
+        edit["conflicts"] = _conflict_records(ops)
+    return [edit]
+
+
+def update_list_element(b: Builder, object_id: str, elem_id: str) -> list[dict]:
+    obj = b.by_object[object_id]
+    ops = obj.fields.get(elem_id, ())
+    index = obj.elem_ids.index_of(elem_id)
+
+    if index >= 0:
+        if not ops:
+            return patch_list(b, object_id, index, "remove", None)
+        return patch_list(b, object_id, index, "set", ops)
+
+    if not ops:
+        return []  # deleting a non-existent element is a no-op
+
+    # Find the closest visible predecessor element (op_set.js:146-156).
+    prev_id = elem_id
+    while True:
+        index = -1
+        prev_id = get_previous(b, object_id, prev_id)
+        if prev_id is None:
+            break
+        index = obj.elem_ids.index_of(prev_id)
+        if index >= 0:
+            break
+    return patch_list(b, object_id, index + 1, "insert", ops)
+
+
+def update_map_key(b: Builder, object_id: str, key: str) -> list[dict]:
+    ops = b.by_object[object_id].fields.get(key, ())
+    edit: dict[str, Any] = {"action": "", "type": "map", "obj": object_id,
+                            "key": key, "path": get_path(b, object_id)}
+    if not ops:
+        edit["action"] = "remove"
+    else:
+        edit["action"] = "set"
+        edit["value"] = ops[0].value
+        if ops[0].action == "link":
+            edit["link"] = True
+        if len(ops) > 1:
+            edit["conflicts"] = _conflict_records(ops)
+    return [edit]
+
+
+def apply_assign(b: Builder, op: Op) -> list[dict]:
+    object_id = op.obj
+    if object_id not in b.by_object:
+        raise ValueError(f"Modification of unknown object {object_id}")
+    obj = b.obj(object_id)
+
+    prior = obj.fields.get(op.key, ())
+    overwritten, remaining = [], []
+    for prior_op in prior:
+        (remaining if is_concurrent(b, prior_op, op) else overwritten).append(prior_op)
+
+    # Overwritten links disappear from the target's inbound index.
+    for dead in overwritten:
+        if dead.action == "link":
+            target = b.obj(dead.value)
+            target.inbound.pop(dead, None)
+
+    if op.action == "link":
+        if op.value not in b.by_object:
+            raise ValueError(f"Link to unknown object {op.value}")
+        b.obj(op.value).inbound[op] = None
+    if op.action != "del":
+        remaining.append(op)
+
+    # Survivors sorted by actor descending: the highest actor wins LWW
+    # (op_set.js:201; winner read at op_set.js:425).
+    remaining.sort(key=lambda o: o.actor or "", reverse=True)
+    obj.fields[op.key] = tuple(remaining)
+
+    if obj.is_sequence:
+        return update_list_element(b, object_id, op.key)
+    return update_map_key(b, object_id, op.key)
+
+
+def apply_op(b: Builder, op: Op) -> list[dict]:
+    action = op.action
+    if action in ("makeMap", "makeList", "makeText"):
+        return apply_make(b, op)
+    if action == "ins":
+        return apply_insert(b, op)
+    if action in ("set", "del", "link"):
+        return apply_assign(b, op)
+    raise ValueError(f"Unknown operation type {action}")
+
+
+def apply_change(b: Builder, change: Change) -> list[dict]:
+    """Apply one causally-ready change (op_set.js:224-252)."""
+    actor, seq = change.actor, change.seq
+    prior = b.states.get(actor, EMPTY_ALIST)
+    if seq <= len(prior):
+        if prior[seq - 1][0] != change:
+            raise ValueError(f"Inconsistent reuse of sequence number {seq} by {actor}")
+        return []  # idempotent re-delivery
+
+    base = dict(change.deps)
+    base[actor] = seq - 1
+    all_deps = transitive_deps(b, base)
+    b.states[actor] = prior.append((change, all_deps))
+
+    diffs: list[dict] = []
+    for op in change.ops:
+        diffs.extend(apply_op(b, op.stamped(actor, seq)))
+
+    b.deps = {a: s for a, s in b.deps.items() if s > all_deps.get(a, 0)}
+    b.deps[actor] = seq
+    b.clock[actor] = seq
+    b.history = b.history.append(change)
+    return diffs
+
+
+def apply_queued_ops(b: Builder) -> list[dict]:
+    """Fixpoint drain of the causal queue (op_set.js:254-270)."""
+    diffs: list[dict] = []
+    while True:
+        leftover: list[Change] = []
+        progressed = False
+        for change in b.queue:
+            if causally_ready(b, change):
+                diffs.extend(apply_change(b, change))
+                progressed = True
+            else:
+                leftover.append(change)
+        b.queue = leftover
+        if not progressed or not leftover:
+            return diffs
+
+
+# ---------------------------------------------------------------------------
+# Read queries (op_set.js:332-479)
+
+def valid_field_name(key) -> bool:
+    return isinstance(key, str) and key != "" and not key.startswith("_")
+
+
+def get_field_ops(state, object_id: str, key: str) -> tuple[Op, ...]:
+    obj = state.by_object.get(object_id)
+    if obj is None:
+        return ()
+    return obj.fields.get(key, ())
+
+
+def get_object_fields(state, object_id: str) -> list[str]:
+    """Present field names of a map object, in field-creation order."""
+    obj = state.by_object[object_id]
+    return [key for key, ops in obj.fields.items()
+            if valid_field_name(key) and ops]
+
+
+def list_length(state, object_id: str) -> int:
+    return len(state.by_object[object_id].elem_ids)
+
+
+# ---------------------------------------------------------------------------
+# The persistent OpSet
+
+class OpSet:
+    """Immutable CRDT state for one document (op_set.js:272-285).
+
+    undo_pos / undo_stack / redo_stack live here (as in the reference) but are
+    maintained by the change-assembly layer (automerge_tpu/frontend/api.py),
+    mirroring auto_api.js:41-111.
+    """
+
+    __slots__ = ("states", "by_object", "clock", "deps", "queue", "history",
+                 "undo_pos", "undo_stack", "redo_stack")
+
+    def __init__(self, states, by_object, clock, deps, queue, history,
+                 undo_pos=0, undo_stack=(), redo_stack=()):
+        self.states = states          # actor -> AList[(Change, all_deps)]
+        self.by_object = by_object    # objectId -> ObjState
+        self.clock = clock            # actor -> seq
+        self.deps = deps              # pruned dependency frontier
+        self.queue = queue            # tuple of causally-unready changes
+        self.history = history        # AList[Change], application order
+        self.undo_pos = undo_pos
+        self.undo_stack = undo_stack  # tuple of tuples of undo Ops
+        self.redo_stack = redo_stack
+
+    @staticmethod
+    def init() -> "OpSet":
+        return OpSet(states={}, by_object={ROOT_ID: ObjState("makeMap")},
+                     clock={}, deps={}, queue=(), history=EMPTY_ALIST)
+
+    def thaw(self) -> Builder:
+        return Builder(self)
+
+    def freeze(self, b: Builder, undo_pos=None, undo_stack=None,
+               redo_stack=None) -> "OpSet":
+        return OpSet(states=b.states, by_object=b.by_object, clock=b.clock,
+                     deps=b.deps, queue=tuple(b.queue), history=b.history,
+                     undo_pos=self.undo_pos if undo_pos is None else undo_pos,
+                     undo_stack=self.undo_stack if undo_stack is None else undo_stack,
+                     redo_stack=self.redo_stack if redo_stack is None else redo_stack)
+
+    def replace_undo(self, undo_pos=None, undo_stack=None, redo_stack=None) -> "OpSet":
+        return OpSet(states=self.states, by_object=self.by_object,
+                     clock=self.clock, deps=self.deps, queue=self.queue,
+                     history=self.history,
+                     undo_pos=self.undo_pos if undo_pos is None else undo_pos,
+                     undo_stack=self.undo_stack if undo_stack is None else undo_stack,
+                     redo_stack=self.redo_stack if redo_stack is None else redo_stack)
+
+    # -- change ingestion ---------------------------------------------------
+
+    def add_change(self, change: Change) -> tuple["OpSet", list[dict]]:
+        return self.add_changes([change])
+
+    def add_changes(self, changes) -> tuple["OpSet", list[dict]]:
+        """Queue + causally apply a batch of changes (op_set.js:294-297)."""
+        b = self.thaw()
+        diffs: list[dict] = []
+        for change in changes:
+            b.queue.append(change)
+            diffs.extend(apply_queued_ops(b))
+        return self.freeze(b), diffs
+
+    # -- change-graph queries (op_set.js:299-330) ---------------------------
+
+    def get_missing_changes(self, have_deps: dict[str, int]) -> list[Change]:
+        all_deps = transitive_deps(self, have_deps)
+        out: list[Change] = []
+        for actor, entries in self.states.items():
+            skip = all_deps.get(actor, 0)
+            for i in range(skip, len(entries)):
+                out.append(entries[i][0])
+        return out
+
+    def get_changes_for_actor(self, for_actor: str, after_seq: int = 0) -> list[Change]:
+        entries = self.states.get(for_actor, EMPTY_ALIST)
+        return [entries[i][0] for i in range(after_seq, len(entries))]
+
+    def get_missing_deps(self) -> dict[str, int]:
+        missing: dict[str, int] = {}
+        for change in self.queue:
+            deps = dict(change.deps)
+            deps[change.actor] = change.seq - 1
+            for actor, seq in deps.items():
+                if self.clock.get(actor, 0) < seq:
+                    missing[actor] = max(seq, missing.get(actor, 0))
+        return missing

@@ -1,0 +1,142 @@
+"""Snapshot materialization with incremental cache maintenance.
+
+The analog of the reference's FreezeAPI (/root/reference/src/freeze_api.js):
+folds CRDT state into frozen snapshots, keeping a per-document cache of
+materialized objects. After a change, only the touched objects and their
+ancestor chain up to the root are rebuilt (freeze_api.js:148-186); everything
+else is shared structurally with the previous snapshot.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..core import opset as O
+from ..core.ids import ROOT_ID
+from ..core.opset import Link, OpSet
+from .snapshots import DocState, FrozenList, FrozenMap, RootMap
+from .text import Text
+
+
+def _op_value(state, op, cache: dict) -> Any:
+    """Application-visible value of a field op (op_set.js:399-405)."""
+    if op.action == "link":
+        return _materialize(state, op.value, cache)
+    return op.value
+
+
+def _materialize(state, object_id: str, cache: dict) -> Any:
+    """Materialize `object_id`, reusing cached snapshots of descendants."""
+    if object_id != ROOT_ID and object_id in cache:
+        return cache[object_id]
+    snapshot = _build(state, object_id, cache)
+    cache[object_id] = snapshot
+    return snapshot
+
+
+def _build(state, object_id: str, cache: dict) -> Any:
+    """Build one object's snapshot; children come from `cache` (or are built
+    recursively on a cache miss)."""
+    obj = state.by_object[object_id]
+
+    if obj.init_action == "makeText":
+        values, elem_ids = [], []
+        raw = obj.elem_ids
+        for i, key in enumerate(raw.keys):
+            value = raw.values[i]
+            if isinstance(value, Link):
+                value = _materialize(state, value.obj, cache)
+            values.append(value)
+            elem_ids.append(key)
+        return Text(values, elem_ids, object_id)
+
+    if obj.init_action == "makeList":
+        values, conflicts = [], []
+        for key in obj.elem_ids.keys:
+            ops = obj.fields.get(key, ())
+            values.append(_op_value(state, ops[0], cache))
+            if len(ops) > 1:
+                conflicts.append({op.actor: _op_value(state, op, cache)
+                                  for op in ops[1:]})
+            else:
+                conflicts.append(None)
+        return FrozenList(values, object_id, conflicts)
+
+    # map (including the root)
+    data, conflicts = {}, {}
+    for key, ops in obj.fields.items():
+        if not O.valid_field_name(key) or not ops:
+            continue
+        data[key] = _op_value(state, ops[0], cache)
+        if len(ops) > 1:
+            conflicts[key] = {op.actor: _op_value(state, op, cache)
+                              for op in ops[1:]}
+    if object_id == ROOT_ID:
+        return (data, conflicts)  # root snapshot assembled by build_root
+    return FrozenMap(data, object_id, conflicts)
+
+
+def build_root(actor_id: str, opset: OpSet, cache: dict) -> RootMap:
+    """Assemble a fresh root snapshot object (always a new identity, mirroring
+    freeze_api.js:253-262)."""
+    data, conflicts = _build(opset, ROOT_ID, cache)
+    doc_state = DocState(actor_id, opset, cache)
+    return RootMap(data, ROOT_ID, conflicts, doc_state)
+
+
+def materialize_root(actor_id: str, opset: OpSet) -> RootMap:
+    """Full (non-incremental) materialization into a fresh cache."""
+    cache: dict = {}
+    return build_root(actor_id, opset, cache)
+
+
+def update_cache(opset: OpSet, diffs: list[dict], old_cache: dict) -> dict:
+    """Incremental cache maintenance (freeze_api.js:148-186).
+
+    Rebuilds each object touched by `diffs`, then propagates rebuilds up the
+    inbound-link ancestor DAG to the root. Returns a new cache dict sharing
+    untouched snapshots with `old_cache`.
+    """
+    cache = dict(old_cache)
+
+    # Objects directly touched, in diff order (children are created/updated
+    # before the parent link that references them).
+    affected: list[str] = []
+    seen: set[str] = set()
+    for diff in diffs:
+        obj = diff["obj"]
+        if obj not in seen:
+            seen.add(obj)
+            affected.append(obj)
+
+    for object_id in affected:
+        if object_id != ROOT_ID:  # the root is rebuilt once, by build_root
+            cache[object_id] = _build(opset, object_id, cache)
+
+    # Ancestor propagation: wave by wave toward the root.
+    wave = set(affected)
+    while wave:
+        parents: set[str] = set()
+        for object_id in wave:
+            obj = opset.by_object.get(object_id)
+            if obj is None:
+                continue
+            for ref in obj.inbound:
+                parents.add(ref.obj)
+        for parent_id in parents:
+            if parent_id != ROOT_ID:
+                cache[parent_id] = _build(opset, parent_id, cache)
+        wave = parents
+
+    return cache
+
+
+def apply_changes_to_doc(doc, opset: OpSet, changes, incremental: bool):
+    """The frontend's change-ingestion entry point (freeze_api.js:245-267):
+    run changes through the CRDT core, then refresh the materialization."""
+    new_opset, diffs = opset.add_changes(changes)
+    if incremental:
+        cache = update_cache(new_opset, diffs, doc._doc.cache)
+    else:
+        cache = {}
+    return build_root(doc._doc.actor_id, new_opset, cache)

@@ -1,0 +1,82 @@
+"""Text: a character-sequence CRDT view.
+
+Mirrors /root/reference/src/text.js: a Text object is an immutable snapshot of
+a character sequence. Reads go straight to the element order index; editing
+happens through the list proxy inside a change block (insert_at / delete_at),
+exactly as the reference routes Text edits through ListHandler.
+
+A fresh `Text()` (empty) can be assigned into a document to create a text
+object; assigning a non-empty Text is not supported (parity with
+/root/reference/src/automerge.js:43-45).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator
+
+
+class Text:
+    __slots__ = ("_values", "_elem_ids", "_object_id_attr")
+
+    def __init__(self, values=(), elem_ids=(), object_id: str | None = None):
+        object.__setattr__(self, "_values", tuple(values))
+        object.__setattr__(self, "_elem_ids", tuple(elem_ids))
+        object.__setattr__(self, "_object_id_attr", object_id)
+
+    @property
+    def _object_id(self) -> str | None:
+        return self._object_id_attr
+
+    @property
+    def elem_ids(self) -> tuple[str, ...]:
+        return self._elem_ids
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    def get(self, index: int) -> Any:
+        if 0 <= index < len(self._values):
+            return self._values[index]
+        return None
+
+    def __getitem__(self, index):
+        if isinstance(index, slice):
+            return self._values[index]
+        return self._values[index]
+
+    def __iter__(self) -> Iterator[Any]:
+        return iter(self._values)
+
+    def __contains__(self, item) -> bool:
+        return item in self._values
+
+    def __str__(self) -> str:
+        return "".join(str(v) for v in self._values)
+
+    def __repr__(self) -> str:
+        return f"Text({str(self)!r})"
+
+    def __eq__(self, other):
+        if isinstance(other, Text):
+            return self._values == other._values
+        if isinstance(other, str):
+            return str(self) == other
+        if isinstance(other, (list, tuple)):
+            return list(self._values) == list(other)
+        return NotImplemented
+
+    def __hash__(self):
+        return hash(("Text", self._values))
+
+    def join(self, sep: str = "") -> str:
+        return sep.join(str(v) for v in self._values)
+
+    def index_of(self, item) -> int:
+        try:
+            return self._values.index(item)
+        except ValueError:
+            return -1
+
+    def __setattr__(self, name, value):
+        raise TypeError("Text objects are read-only. "
+                        "Use change() to get a writable version.")

@@ -1,0 +1,5 @@
+from .docset import DocSet
+from .watchable import WatchableDoc
+from .connection import Connection
+
+__all__ = ["DocSet", "WatchableDoc", "Connection"]

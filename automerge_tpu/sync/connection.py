@@ -1,0 +1,114 @@
+"""Connection: per-peer anti-entropy sync over an injected transport.
+
+Mirrors /root/reference/src/connection.js. The protocol is transport-agnostic:
+`send_msg` (constructor callback) carries messages out; `receive_msg` is called
+by the network stack on arrival. Messages are plain dicts
+`{"docId": ..., "clock": {...}, "changes": [...]?}` — the exact schema the
+reference speaks, so an automerge_tpu node can sync with any peer using the
+reference protocol over DCN/websocket/whatever.
+
+State per peer:
+- `their_clock`: best estimate of what the peer has (per doc). Everything more
+  recent must be sent.
+- `our_clock`: what we have advertised to the peer.
+
+Protocol invariants (tested in tests/test_connection.py): duplicate deliveries
+are tolerated (idempotent apply + clock checks); drops only delay convergence
+(clock re-advertisement catches up).
+
+TPU-scale counterpart: within a pod, the clock union below becomes an
+element-wise max all-reduce over int32 clock matrices
+(automerge_tpu/parallel/collective.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ..core import clock as C
+from ..core.change import coerce_change
+
+
+class Connection:
+    def __init__(self, doc_set, send_msg: Callable[[dict], None]):
+        self._doc_set = doc_set
+        self._send_msg = send_msg
+        self._their_clock: dict[str, dict[str, int]] = {}
+        self._our_clock: dict[str, dict[str, int]] = {}
+
+    # -- lifecycle (connection.js:49-56) ------------------------------------
+
+    def open(self) -> None:
+        for doc_id in self._doc_set.doc_ids:
+            self.doc_changed(doc_id, self._doc_set.get_doc(doc_id))
+        self._doc_set.register_handler(self.doc_changed)
+
+    def close(self) -> None:
+        self._doc_set.unregister_handler(self.doc_changed)
+
+    # -- sending (connection.js:58-79) --------------------------------------
+
+    def _clock_union(self, clock_map: dict, doc_id: str, clock: dict) -> dict:
+        merged = C.union(clock_map.get(doc_id, {}), clock)
+        out = dict(clock_map)
+        out[doc_id] = merged
+        return out
+
+    def send_msg(self, doc_id: str, clock: dict, changes=None) -> None:
+        msg: dict = {"docId": doc_id, "clock": dict(clock)}
+        self._our_clock = self._clock_union(self._our_clock, doc_id, clock)
+        if changes is not None:
+            msg["changes"] = [c.to_dict() for c in changes]
+        self._send_msg(msg)
+
+    def maybe_send_changes(self, doc_id: str) -> None:
+        doc = self._doc_set.get_doc(doc_id)
+        opset = doc._doc.opset
+        clock = opset.clock
+
+        if doc_id in self._their_clock:
+            changes = opset.get_missing_changes(self._their_clock[doc_id])
+            if changes:
+                self._their_clock = self._clock_union(self._their_clock, doc_id, clock)
+                self.send_msg(doc_id, clock, changes)
+                return
+
+        # Advertise when our clock moved past what we advertised — and also on
+        # first contact even with an empty clock. (The reference skips the
+        # empty-clock advert, connection.js:78, which deadlocks when both peers
+        # register an empty doc and one of them later edits it: neither side
+        # ever learns the other's clock, so nothing is pushed.)
+        if doc_id not in self._our_clock or \
+                not C.equal(clock, self._our_clock[doc_id]):
+            self.send_msg(doc_id, clock)
+
+    # -- docset callback (connection.js:82-94) ------------------------------
+
+    def doc_changed(self, doc_id: str, doc) -> None:
+        doc_state = getattr(doc, "_doc", None)
+        if doc_state is None:
+            raise TypeError("This object cannot be used for network sync. "
+                            "Are you trying to sync a snapshot from the history?")
+        clock = doc_state.opset.clock
+        if not C.less_or_equal(self._our_clock.get(doc_id, {}), clock):
+            raise ValueError("Cannot pass an old state object to a connection")
+        self.maybe_send_changes(doc_id)
+
+    # -- receiving (connection.js:96-113) -----------------------------------
+
+    def receive_msg(self, msg: dict):
+        doc_id = msg["docId"]
+        if msg.get("clock") is not None:
+            self._their_clock = self._clock_union(self._their_clock, doc_id,
+                                                  msg["clock"])
+        if msg.get("changes") is not None:
+            return self._doc_set.apply_changes(
+                doc_id, [coerce_change(c) for c in msg["changes"]])
+
+        if self._doc_set.get_doc(doc_id) is not None:
+            self.maybe_send_changes(doc_id)
+        elif doc_id not in self._our_clock:
+            # The peer has a doc we don't know: request it.
+            self.send_msg(doc_id, {})
+
+        return self._doc_set.get_doc(doc_id)

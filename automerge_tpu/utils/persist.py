@@ -1,0 +1,69 @@
+"""Lightweight persistent containers for the semantic core.
+
+The reference keeps every piece of CRDT state in Immutable.js structures so that
+old document snapshots stay valid after new changes are applied
+(/root/reference/src/op_set.js:272-285). We get the same persistence guarantee
+with two cheaper devices tuned for the actual mutation patterns:
+
+- `AList`: an append-only shared-backing list view. Appending to the newest view
+  is O(1) amortized (it extends the shared backing list in place); appending to
+  an older view copies the prefix. Change histories, per-actor state lists and
+  undo/redo stacks are append-mostly, so forks are rare and cheap.
+- copy-on-write dicts, managed by the OpSet builder (one shallow copy per
+  *batch* of changes rather than per op).
+"""
+
+from __future__ import annotations
+
+from itertools import islice
+from typing import Any, Iterator
+
+
+class AList:
+    """Persistent append-only list: views share one backing list.
+
+    A view is (backing, length). `append` mutates the backing in place when the
+    view is the newest one (length == len(backing)); otherwise it copies the
+    visible prefix. Old views never observe appends made through newer views.
+    """
+
+    __slots__ = ("_backing", "_length")
+
+    def __init__(self, backing: list | None = None, length: int | None = None):
+        self._backing = backing if backing is not None else []
+        self._length = length if length is not None else len(self._backing)
+
+    def append(self, item: Any) -> "AList":
+        if self._length == len(self._backing):
+            self._backing.append(item)
+            return AList(self._backing, self._length + 1)
+        backing = self._backing[: self._length]
+        backing.append(item)
+        return AList(backing, self._length + 1)
+
+    def extend(self, items) -> "AList":
+        out = self
+        for item in items:
+            out = out.append(item)
+        return out
+
+    def __len__(self) -> int:
+        return self._length
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return list(islice(self._backing, *idx.indices(self._length)))
+        if idx < 0:
+            idx += self._length
+        if not 0 <= idx < self._length:
+            raise IndexError(idx)
+        return self._backing[idx]
+
+    def __iter__(self) -> Iterator[Any]:
+        return islice(iter(self._backing), self._length)
+
+    def __repr__(self) -> str:
+        return f"AList({list(self)!r})"
+
+
+EMPTY_ALIST = AList([], 0)

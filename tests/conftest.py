@@ -1,0 +1,21 @@
+"""Test environment: force the CPU backend with 8 virtual devices so the
+multi-device sharding path is exercised without TPU hardware (the strategy the
+reference uses for its distributed tests is in-process simulation; ours adds a
+virtual device mesh — SURVEY.md §4)."""
+
+import os
+
+# Must run before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_uuid_factory():
+    yield
+    import automerge_tpu as am
+    am.uuid.reset()

@@ -1,0 +1,23 @@
+"""Shared test helpers (analog of /root/reference/test/helpers.js)."""
+
+import automerge_tpu as am
+
+
+def equals_one_of(actual, *expected):
+    """Assert `actual` deep-equals one of `expected` — used where the outcome
+    legitimately depends on actor-ID ordering, followed by an assertion that
+    all replicas agree."""
+    for candidate in expected:
+        if am.equals(actual, candidate):
+            return
+    raise AssertionError(f"{actual!r} is none of {expected!r}")
+
+
+def counter_uuids(prefix=""):
+    """Deterministic uuid factory: prefix1, prefix2, ..."""
+    state = {"n": 0}
+
+    def factory():
+        state["n"] += 1
+        return f"{prefix}{state['n']:04d}"
+    return factory

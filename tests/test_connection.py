@@ -1,0 +1,203 @@
+"""Sync protocol: multi-node simulation without any network.
+
+Ports the strategy of /root/reference/test/connection_test.js: several DocSets
+wired pairwise with message-capturing callbacks; tests script delivery order
+(including drops and duplicates) and assert convergence plus message counts.
+"""
+
+import automerge_tpu as am
+from automerge_tpu import Connection, DocSet
+
+
+class Link:
+    """A bidirectional link between two nodes with manual message delivery."""
+
+    def __init__(self, docset_a: DocSet, docset_b: DocSet):
+        self.queue_ab: list[dict] = []   # messages from a towards b
+        self.queue_ba: list[dict] = []
+        self.conn_a = Connection(docset_a, self.queue_ab.append)
+        self.conn_b = Connection(docset_b, self.queue_ba.append)
+        self.sent_ab = 0
+        self.sent_ba = 0
+
+    def open(self):
+        self.conn_a.open()
+        self.conn_b.open()
+
+    def deliver_one_ab(self, drop=False):
+        msg = self.queue_ab.pop(0)
+        self.sent_ab += 1
+        if not drop:
+            self.conn_b.receive_msg(msg)
+        return msg
+
+    def deliver_one_ba(self, drop=False):
+        msg = self.queue_ba.pop(0)
+        self.sent_ba += 1
+        if not drop:
+            self.conn_a.receive_msg(msg)
+        return msg
+
+    def drain(self, max_rounds=100):
+        for _ in range(max_rounds):
+            if not self.queue_ab and not self.queue_ba:
+                return
+            while self.queue_ab:
+                self.deliver_one_ab()
+            while self.queue_ba:
+                self.deliver_one_ba()
+        raise AssertionError("message exchange did not quiesce")
+
+
+def test_advertise_and_send_on_connect():
+    # node A has a doc; B connects; B requests it; A sends changes
+    ds_a, ds_b = DocSet(), DocSet()
+    doc = am.change(am.init(), lambda d: d.__setitem__("hello", "world"))
+    ds_a.set_doc("doc1", doc)
+    link = Link(ds_a, ds_b)
+    link.open()
+    # A advertises its clock on open
+    assert len(link.queue_ab) == 1
+    assert link.queue_ab[0]["docId"] == "doc1"
+    assert "changes" not in link.queue_ab[0]
+    link.drain()
+    assert ds_b.get_doc("doc1") == {"hello": "world"}
+
+
+def test_local_edit_pushes_changes():
+    ds_a, ds_b = DocSet(), DocSet()
+    ds_a.set_doc("doc1", am.init())
+    ds_b.set_doc("doc1", am.init())
+    link = Link(ds_a, ds_b)
+    link.open()
+    link.drain()
+
+    doc = am.change(ds_a.get_doc("doc1"), lambda d: d.__setitem__("x", 1))
+    ds_a.set_doc("doc1", doc)
+    # the handler fires and the changes go out
+    assert any("changes" in m for m in link.queue_ab)
+    link.drain()
+    assert ds_b.get_doc("doc1") == {"x": 1}
+
+
+def test_bidirectional_divergent_merge():
+    ds_a, ds_b = DocSet(), DocSet()
+    base = am.change(am.init("base"), lambda d: d.__setitem__("base", 0))
+    ds_a.set_doc("doc1", am.merge(am.init("A"), base))
+    ds_b.set_doc("doc1", am.merge(am.init("B"), base))
+    link = Link(ds_a, ds_b)
+    link.open()
+    link.drain()
+
+    ds_a.set_doc("doc1", am.change(ds_a.get_doc("doc1"), lambda d: d.__setitem__("a", 1)))
+    ds_b.set_doc("doc1", am.change(ds_b.get_doc("doc1"), lambda d: d.__setitem__("b", 2)))
+    link.drain()
+    assert ds_a.get_doc("doc1") == {"base": 0, "a": 1, "b": 2}
+    assert ds_b.get_doc("doc1") == {"base": 0, "a": 1, "b": 2}
+
+
+def test_forwarding_through_intermediate_node():
+    # connection_test.js:219-251: A -- M -- B; A's edit reaches B via M's gossip
+    ds_a, ds_m, ds_b = DocSet(), DocSet(), DocSet()
+    for ds in (ds_a, ds_m, ds_b):
+        ds.set_doc("doc1", am.init())
+    link_am = Link(ds_a, ds_m)
+    link_mb = Link(ds_m, ds_b)
+    link_am.open()
+    link_mb.open()
+    for _ in range(10):
+        link_am.drain()
+        link_mb.drain()
+        if not (link_am.queue_ab or link_am.queue_ba or
+                link_mb.queue_ab or link_mb.queue_ba):
+            break
+
+    ds_a.set_doc("doc1", am.change(ds_a.get_doc("doc1"), lambda d: d.__setitem__("x", 42)))
+    for _ in range(10):
+        link_am.drain()
+        link_mb.drain()
+        if not (link_am.queue_ab or link_am.queue_ba or
+                link_mb.queue_ab or link_mb.queue_ba):
+            break
+    assert ds_b.get_doc("doc1") == {"x": 42}
+
+
+def test_duplicate_delivery_tolerated():
+    ds_a, ds_b = DocSet(), DocSet()
+    ds_a.set_doc("doc1", am.init())
+    ds_b.set_doc("doc1", am.init())
+    link = Link(ds_a, ds_b)
+    link.open()
+    link.drain()
+
+    ds_a.set_doc("doc1", am.change(ds_a.get_doc("doc1"), lambda d: d.__setitem__("x", 1)))
+    # capture and deliver the change message twice
+    msg = link.queue_ab[0]
+    link.drain()
+    link.conn_b.receive_msg(msg)  # duplicate
+    link.drain()
+    assert ds_b.get_doc("doc1") == {"x": 1}
+    assert len(am.get_history(ds_b.get_doc("doc1"))) == 1
+
+
+def test_dropped_message_recovered_by_reconnection():
+    ds_a, ds_b = DocSet(), DocSet()
+    ds_a.set_doc("doc1", am.init())
+    ds_b.set_doc("doc1", am.init())
+    link = Link(ds_a, ds_b)
+    link.open()
+    link.drain()
+
+    ds_a.set_doc("doc1", am.change(ds_a.get_doc("doc1"), lambda d: d.__setitem__("x", 1)))
+    # the change message is dropped in transit
+    link.deliver_one_ab(drop=True)
+    link.drain()
+    assert ds_b.get_doc("doc1") == {}
+
+    # a fresh connection (reconnect) re-advertises and catches up
+    link2 = Link(ds_a, ds_b)
+    link2.open()
+    link2.drain()
+    assert ds_b.get_doc("doc1") == {"x": 1}
+
+
+def test_unknown_doc_requested():
+    # B receives an advertisement for a doc it doesn't have and asks for it
+    ds_a, ds_b = DocSet(), DocSet()
+    doc = am.change(am.init(), lambda d: d.__setitem__("v", 7))
+    ds_a.set_doc("doc9", doc)
+    link = Link(ds_a, ds_b)
+    link.open()
+    advert = link.deliver_one_ab()
+    assert "changes" not in advert
+    # B's reply is a request with an empty clock
+    request = link.queue_ba[0]
+    assert request["docId"] == "doc9"
+    assert request["clock"] == {}
+    link.drain()
+    assert ds_b.get_doc("doc9") == {"v": 7}
+
+
+def test_no_infinite_chatter():
+    # after convergence, no further messages are exchanged
+    ds_a, ds_b = DocSet(), DocSet()
+    ds_a.set_doc("doc1", am.init())
+    ds_b.set_doc("doc1", am.init())
+    link = Link(ds_a, ds_b)
+    link.open()
+    link.drain()
+    before = (link.sent_ab, link.sent_ba)
+    link.drain()
+    assert (link.sent_ab, link.sent_ba) == before
+
+
+def test_multiplexes_many_docs():
+    ds_a, ds_b = DocSet(), DocSet()
+    for i in range(5):
+        doc = am.change(am.init(), lambda d, i=i: d.__setitem__("n", i))
+        ds_a.set_doc(f"doc{i}", doc)
+    link = Link(ds_a, ds_b)
+    link.open()
+    link.drain()
+    for i in range(5):
+        assert ds_b.get_doc(f"doc{i}") == {"n": i}

@@ -1,0 +1,84 @@
+"""DocSet, WatchableDoc, uuid (ports /root/reference/test/watchable_doc_test.js,
+test_uuid.js, and the DocSet behaviors from connection_test.js)."""
+
+import automerge_tpu as am
+from automerge_tpu import DocSet, WatchableDoc
+from helpers import counter_uuids
+
+
+class TestDocSet:
+    def test_set_and_get(self):
+        ds = DocSet()
+        doc = am.init()
+        ds.set_doc("d", doc)
+        assert ds.get_doc("d") is doc
+        assert ds.doc_ids == ["d"]
+
+    def test_handlers_fire_on_set(self):
+        ds = DocSet()
+        events = []
+        ds.register_handler(lambda doc_id, doc: events.append(doc_id))
+        ds.set_doc("a", am.init())
+        ds.set_doc("b", am.init())
+        assert events == ["a", "b"]
+
+    def test_unregister(self):
+        ds = DocSet()
+        events = []
+        handler = lambda doc_id, doc: events.append(doc_id)
+        ds.register_handler(handler)
+        ds.unregister_handler(handler)
+        ds.set_doc("a", am.init())
+        assert events == []
+
+    def test_apply_changes_auto_creates_doc(self):
+        src = am.change(am.init(), lambda d: d.__setitem__("x", 1))
+        changes = am.get_changes(am.init(), src)
+        ds = DocSet()
+        doc = ds.apply_changes("new-doc", changes)
+        assert doc == {"x": 1}
+        assert ds.get_doc("new-doc") == {"x": 1}
+
+
+class TestWatchableDoc:
+    def test_get_set(self):
+        w = WatchableDoc(am.init())
+        assert w.get() == {}
+        doc2 = am.change(w.get(), lambda d: d.__setitem__("x", 1))
+        w.set(doc2)
+        assert w.get() is doc2
+
+    def test_handler_notified(self):
+        w = WatchableDoc(am.init())
+        events = []
+        w.register_handler(events.append)
+        doc2 = am.change(w.get(), lambda d: d.__setitem__("x", 1))
+        w.set(doc2)
+        assert events == [doc2]
+
+    def test_apply_changes(self):
+        src = am.change(am.init(), lambda d: d.__setitem__("x", 1))
+        changes = am.get_changes(am.init(), src)
+        w = WatchableDoc(am.init())
+        events = []
+        w.register_handler(events.append)
+        doc = w.apply_changes(changes)
+        assert doc == {"x": 1}
+        assert len(events) == 1
+
+
+class TestUuid:
+    def test_unique_by_default(self):
+        assert am.uuid() != am.uuid()
+
+    def test_factory_override_and_reset(self):
+        am.uuid.set_factory(counter_uuids("id-"))
+        assert am.uuid() == "id-0001"
+        assert am.uuid() == "id-0002"
+        am.uuid.reset()
+        assert not am.uuid().startswith("id-")
+
+    def test_deterministic_object_ids(self):
+        am.uuid.set_factory(counter_uuids("obj-"))
+        s = am.change(am.init("actor"), lambda d: d.__setitem__("m", {}))
+        assert s["m"]._object_id == "obj-0001"

@@ -1,0 +1,142 @@
+"""save/load, history API, and diff (ports /root/reference/test/test.js
+1082-1217)."""
+
+import json
+
+import pytest
+
+import automerge_tpu as am
+
+
+class TestSaveLoad:
+    def test_roundtrip_empty(self):
+        s = am.init()
+        s2 = am.load(am.save(s))
+        assert s2 == {}
+
+    def test_roundtrip_map_and_list(self):
+        s = am.change(am.init(), lambda d: am.assign(d, {
+            "title": "hello", "tags": ["a", "b"], "meta": {"n": 1}}))
+        s2 = am.load(am.save(s))
+        assert s2 == {"title": "hello", "tags": ["a", "b"], "meta": {"n": 1}}
+
+    def test_save_is_json(self):
+        s = am.change(am.init(), lambda d: d.__setitem__("x", 1))
+        payload = json.loads(am.save(s))
+        assert "changes" in payload
+
+    def test_load_with_actor_id(self):
+        s = am.change(am.init(), lambda d: d.__setitem__("x", 1))
+        s2 = am.load(am.save(s), "fresh-actor")
+        assert am.get_actor_id(s2) == "fresh-actor"
+        s3 = am.change(s2, lambda d: d.__setitem__("y", 2))
+        assert s3 == {"x": 1, "y": 2}
+
+    def test_conflicts_survive_roundtrip(self):
+        s1 = am.change(am.init("A"), lambda d: d.__setitem__("f", "a"))
+        s2 = am.change(am.init("B"), lambda d: d.__setitem__("f", "b"))
+        s1 = am.merge(s1, s2)
+        loaded = am.load(am.save(s1))
+        assert loaded["f"] == "b"
+        assert loaded._conflicts == {"f": {"A": "a"}}
+
+    def test_history_preserved_after_roundtrip(self):
+        s = am.change(am.init(), "first", lambda d: d.__setitem__("x", 1))
+        s = am.change(s, "second", lambda d: d.__setitem__("y", 2))
+        loaded = am.load(am.save(s))
+        history = am.get_history(loaded)
+        assert [h.change["message"] for h in history] == ["first", "second"]
+
+    def test_text_survives_roundtrip(self):
+        def edit(doc):
+            doc["text"] = am.Text()
+            doc["text"].insert_at(0, "h", "i")
+        s = am.change(am.init(), edit)
+        loaded = am.load(am.save(s))
+        assert str(loaded["text"]) == "hi"
+
+
+class TestHistory:
+    def test_history_records_changes_and_snapshots(self):
+        s = am.change(am.init(), "one", lambda d: d.__setitem__("a", 1))
+        s = am.change(s, "two", lambda d: d.__setitem__("b", 2))
+        history = am.get_history(s)
+        assert len(history) == 2
+        assert history[0].change["message"] == "one"
+        assert history[0].snapshot == {"a": 1}
+        assert history[1].snapshot == {"a": 1, "b": 2}
+
+    def test_history_after_merge(self):
+        s1 = am.change(am.init("A"), lambda d: d.__setitem__("a", 1))
+        s2 = am.change(am.init("B"), lambda d: d.__setitem__("b", 2))
+        m = am.merge(s1, s2)
+        assert len(am.get_history(m)) == 2
+
+
+class TestDiff:
+    def test_diff_empty(self):
+        s = am.init()
+        assert am.diff(s, s) == []
+
+    def test_diff_set_field(self):
+        s1 = am.init()
+        s2 = am.change(s1, lambda d: d.__setitem__("x", 1))
+        diffs = am.diff(s1, s2)
+        assert len(diffs) == 1
+        d = diffs[0]
+        assert d["action"] == "set" and d["key"] == "x" and d["value"] == 1
+        assert d["type"] == "map" and d["obj"] == am.ROOT_ID
+        assert d["path"] == []
+
+    def test_diff_nested_create(self):
+        s1 = am.init()
+        s2 = am.change(s1, lambda d: d.__setitem__("m", {"k": "v"}))
+        diffs = am.diff(s1, s2)
+        actions = [(d["action"], d.get("key")) for d in diffs]
+        assert ("create", None) in actions
+        assert any(d["action"] == "set" and d.get("link") for d in diffs)
+
+    def test_diff_list_ops(self):
+        s1 = am.change(am.init(), lambda d: d.__setitem__("xs", ["a"]))
+        s2 = am.change(s1, lambda d: d["xs"].append("b"))
+        diffs = am.diff(s1, s2)
+        assert len(diffs) == 1
+        assert diffs[0]["action"] == "insert"
+        assert diffs[0]["index"] == 1
+        assert diffs[0]["value"] == "b"
+        assert diffs[0]["path"] == ["xs"]
+
+    def test_diff_list_delete(self):
+        s1 = am.change(am.init(), lambda d: d.__setitem__("xs", ["a", "b"]))
+        s2 = am.change(s1, lambda d: d["xs"].delete_at(0))
+        diffs = am.diff(s1, s2)
+        assert diffs[0]["action"] == "remove"
+        assert diffs[0]["index"] == 0
+
+    def test_diff_diverged_raises(self):
+        s1 = am.change(am.init(), lambda d: d.__setitem__("a", 1))
+        s2 = am.change(am.init(), lambda d: d.__setitem__("b", 2))
+        with pytest.raises(ValueError):
+            am.diff(s1, s2)
+
+    def test_diff_does_not_modify_old_doc(self):
+        s1 = am.init()
+        s2 = am.change(s1, lambda d: d.__setitem__("x", 1))
+        am.diff(s1, s2)
+        assert s1 == {}
+
+
+class TestInspectEquals:
+    def test_inspect_plain(self):
+        s = am.change(am.init(), lambda d: am.assign(d, {"a": [1, {"b": 2}]}))
+        plain = am.inspect(s)
+        assert plain == {"a": [1, {"b": 2}]}
+        assert type(plain) is dict
+        assert type(plain["a"]) is list
+
+    def test_equals(self):
+        s1 = am.change(am.init(), lambda d: d.__setitem__("a", {"b": [1, 2]}))
+        s2 = am.change(am.init(), lambda d: d.__setitem__("a", {"b": [1, 2]}))
+        assert am.equals(s1, s2)
+        s3 = am.change(am.init(), lambda d: d.__setitem__("a", {"b": [1, 3]}))
+        assert not am.equals(s1, s3)

@@ -1,0 +1,179 @@
+"""Proxy-facade conformance inside change blocks (ports
+/root/reference/test/proxies_test.js)."""
+
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu.core.ids import ROOT_ID
+
+
+class TestMapProxy:
+    def test_metadata(self):
+        def cb(doc):
+            assert doc._object_id == ROOT_ID
+            assert doc._type == "map"
+            assert doc._actor_id == "actor1"
+        am.change(am.init("actor1"), cb)
+
+    def test_keys_items_iteration(self):
+        s = am.change(am.init(), lambda d: am.assign(d, {"a": 1, "b": 2}))
+
+        def cb(doc):
+            assert sorted(doc.keys()) == ["a", "b"]
+            assert sorted(doc.items()) == [("a", 1), ("b", 2)]
+            assert sorted(iter(doc)) == ["a", "b"]
+            assert len(doc) == 2
+            assert "a" in doc
+            assert "z" not in doc
+        am.change(s, cb)
+
+    def test_get_with_default(self):
+        def cb(doc):
+            assert doc.get("missing") is None
+            assert doc.get("missing", 5) == 5
+        am.change(am.init(), cb)
+
+    def test_missing_key_raises(self):
+        def cb(doc):
+            with pytest.raises(KeyError):
+                doc["missing"]
+        am.change(am.init(), cb)
+
+    def test_underscore_keys_hidden(self):
+        def cb(doc):
+            with pytest.raises(KeyError):
+                doc["_foo"]
+        am.change(am.init(), cb)
+
+    def test_to_plain(self):
+        s = am.change(am.init(), lambda d: d.__setitem__("m", {"x": [1, 2]}))
+
+        def cb(doc):
+            assert doc.to_plain() == {"m": {"x": [1, 2]}}
+        am.change(s, cb)
+
+    def test_equality_with_dict(self):
+        def cb(doc):
+            doc["a"] = 1
+            assert doc == {"a": 1}
+        am.change(am.init(), cb)
+
+    def test_update_method(self):
+        s = am.change(am.init(), lambda d: d.update({"a": 1, "b": 2}))
+        assert s == {"a": 1, "b": 2}
+
+    def test_nested_proxy_object_id_matches_snapshot(self):
+        s = am.change(am.init(), lambda d: d.__setitem__("m", {}))
+        snapshot_id = s["m"]._object_id
+
+        def cb(doc):
+            assert doc["m"]._object_id == snapshot_id
+        am.change(s, cb)
+
+
+class TestListProxy:
+    def test_metadata_and_reads(self):
+        s = am.change(am.init(), lambda d: d.__setitem__("xs", [10, 20, 30]))
+
+        def cb(doc):
+            xs = doc["xs"]
+            assert xs._type == "list"
+            assert len(xs) == 3
+            assert xs[0] == 10
+            assert xs[-1] == 30
+            assert xs[0:2] == [10, 20]
+            assert list(xs) == [10, 20, 30]
+            assert 20 in xs
+            assert xs.index(20) == 1
+            assert xs.count(10) == 1
+        am.change(s, cb)
+
+    def test_out_of_range_read(self):
+        s = am.change(am.init(), lambda d: d.__setitem__("xs", [1]))
+
+        def cb(doc):
+            with pytest.raises(IndexError):
+                doc["xs"][5]
+            assert doc["xs"].get(5) is None
+        am.change(s, cb)
+
+    def test_equality_with_list(self):
+        def cb(doc):
+            doc["xs"] = [1, 2]
+            assert doc["xs"] == [1, 2]
+        am.change(am.init(), cb)
+
+    def test_remove(self):
+        s = am.change(am.init(), lambda d: d.__setitem__("xs", ["a", "b", "c"]))
+        s = am.change(s, lambda d: d["xs"].remove("b"))
+        assert s == {"xs": ["a", "c"]}
+
+
+class TestLinkingExistingObjects:
+    def test_move_subtree(self):
+        s = am.change(am.init(), lambda d: d.__setitem__("a", {"inner": {"v": 1}}))
+
+        def cb(doc):
+            doc["b"] = doc["a"]["inner"]  # link the same object under a new key
+        s2 = am.change(s, cb)
+        assert s2["b"] == {"v": 1}
+        assert s2["b"]._object_id == s2["a"]["inner"]._object_id
+
+    def test_alias_then_edit_shows_in_both(self):
+        s = am.change(am.init(), lambda d: d.__setitem__("a", {"inner": {"v": 1}}))
+        s = am.change(s, lambda d: d.__setitem__("b", d["a"]["inner"]))
+        s = am.change(s, lambda d: d["b"].__setitem__("v", 99))
+        assert s["a"]["inner"] == {"v": 99}
+        assert s["b"] == {"v": 99}
+
+
+class TestMutationOutsideChangeBlock:
+    def test_proxy_methods_unusable_after_commit(self):
+        captured = {}
+
+        def cb(doc):
+            doc["xs"] = [1]
+            captured["proxy"] = doc["xs"]
+        am.change(am.init(), cb)
+        # Using the captured proxy afterwards operates on the discarded working
+        # state; the committed document is unaffected.
+
+    def test_snapshot_is_frozen(self):
+        s = am.change(am.init(), lambda d: d.__setitem__("m", {"x": 1}))
+        with pytest.raises(TypeError):
+            s["m"]["x"] = 2
+        with pytest.raises(TypeError):
+            s["m"].pop("x")
+
+
+class TestReviewRegressions:
+    def test_reference_cycle_refused(self):
+        s = am.change(am.init(), lambda d: d.__setitem__("a", {}))
+        with pytest.raises(ValueError):
+            am.change(s, lambda d: d["a"].__setitem__("me", d["a"]))
+        s2 = am.change(s, lambda d: d.__setitem__("b", {"inner": {}}))
+        with pytest.raises(ValueError):
+            am.change(s2, lambda d: d["b"]["inner"].__setitem__("up", d["b"]))
+
+    def test_negative_index_assignment(self):
+        s = am.change(am.init(), lambda d: d.__setitem__("xs", [1, 2, 3]))
+        s = am.change(s, lambda d: d["xs"].__setitem__(-1, 99))
+        assert s == {"xs": [1, 2, 99]}
+
+    def test_negative_insert(self):
+        s = am.change(am.init(), lambda d: d.__setitem__("xs", [1, 3]))
+        s = am.change(s, lambda d: d["xs"].insert(-1, 2))
+        assert s == {"xs": [1, 2, 3]}
+
+    def test_assign_on_list_proxy(self):
+        s = am.change(am.init(), lambda d: d.__setitem__("xs", ["a", "b"]))
+        s = am.change(s, lambda d: am.assign(d["xs"], {1: "B"}))
+        assert s == {"xs": ["a", "B"]}
+
+    def test_load_rejects_future_format(self):
+        import json as _json
+        s = am.change(am.init(), lambda d: d.__setitem__("x", 1))
+        payload = _json.loads(am.save(s))
+        payload["automerge_tpu"] = 99
+        with pytest.raises(ValueError):
+            am.load(_json.dumps(payload))

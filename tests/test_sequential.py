@@ -1,0 +1,320 @@
+"""Sequential (single-replica) behavior.
+
+Ports the semantics of /root/reference/test/test.js 'sequential use' (7-533):
+change blocks, root and nested maps, lists, frozen-snapshot enforcement.
+"""
+
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu.core.ids import ROOT_ID
+
+
+@pytest.fixture
+def s1():
+    return am.init()
+
+
+class TestBasics:
+    def test_initially_empty_map(self, s1):
+        assert s1 == {}
+
+    def test_does_not_mutate_old_snapshots(self, s1):
+        s2 = am.change(s1, lambda d: d.__setitem__("foo", "bar"))
+        assert "foo" not in s1
+        assert s2["foo"] == "bar"
+
+    def test_no_conflicts_on_repeated_assignment(self, s1):
+        assert s1._conflicts == {}
+        s1 = am.change(s1, "change", lambda d: d.__setitem__("foo", "one"))
+        assert s1._conflicts == {}
+        s1 = am.change(s1, "change", lambda d: d.__setitem__("foo", "two"))
+        assert s1._conflicts == {}
+
+    def test_root_object_id(self, s1):
+        assert s1._object_id == ROOT_ID
+
+
+class TestChanges:
+    def test_groups_several_changes(self, s1):
+        def cb(doc):
+            doc["first"] = "one"
+            assert doc["first"] == "one"
+            doc["second"] = "two"
+            assert doc == {"first": "one", "second": "two"}
+        s2 = am.change(s1, "change message", cb)
+        assert s1 == {}
+        assert s2 == {"first": "one", "second": "two"}
+
+    def test_snapshots_are_read_only(self, s1):
+        s2 = am.change(s1, lambda d: d.__setitem__("foo", "bar"))
+        with pytest.raises(TypeError):
+            s2["foo"] = "lemon"
+        with pytest.raises(TypeError):
+            del s2["foo"]
+        with pytest.raises(TypeError):
+            s2.update({"x": 1})
+        assert s2["foo"] == "bar"
+
+    def test_repeated_read_and_write_within_block(self, s1):
+        def cb(doc):
+            doc["counter"] = 1
+            assert doc["counter"] == 1
+            doc["counter"] += 1
+            doc["counter"] += 1
+            assert doc["counter"] == 3
+        s2 = am.change(s1, "change message", cb)
+        assert s1 == {}
+        assert s2 == {"counter": 3}
+
+    def test_no_conflicts_on_same_field_multiple_writes_in_one_change(self, s1):
+        def cb(doc):
+            doc["counter"] = 1
+            doc["counter"] += 1
+            doc["counter"] += 1
+        s1 = am.change(s1, "change message", cb)
+        assert s1["counter"] == 3
+        assert s1._conflicts == {}
+
+    def test_unchanged_callback_returns_same_object(self, s1):
+        s2 = am.change(s1, lambda d: None)
+        assert s2 is s1
+
+    def test_writing_existing_value_is_a_noop(self, s1):
+        s1 = am.change(s1, lambda d: d.__setitem__("field", 123))
+        s2 = am.change(s1, lambda d: d.__setitem__("field", 123))
+        assert s2 is s1
+
+    def test_resolving_a_conflict_is_not_a_noop(self, s1):
+        s2 = am.merge(am.init(), s1)
+        s1 = am.change(s1, lambda d: d.__setitem__("field", 123))
+        s2 = am.change(s2, lambda d: d.__setitem__("field", 321))
+        s1 = am.merge(s1, s2)
+        assert list(s1._conflicts.keys()) == ["field"]
+        resolved = am.change(s1, lambda d: d.__setitem__("field", s1["field"]))
+        assert resolved is not s1
+        assert resolved == {"field": s1["field"]}
+        assert resolved._conflicts == {}
+
+    def test_sanity_checks_arguments(self, s1):
+        s1 = am.change(s1, lambda d: d.__setitem__("nested", {}))
+        with pytest.raises(TypeError):
+            am.change({}, lambda d: None)
+        with pytest.raises(TypeError):
+            am.change(s1["nested"], lambda d: None)
+
+    def test_change_message_must_be_string(self, s1):
+        with pytest.raises(TypeError):
+            am.change(s1, 123, lambda d: None)
+
+    def test_attribute_style_assignment(self, s1):
+        s2 = am.change(s1, lambda d: setattr(d, "foo", "bar"))
+        assert s2["foo"] == "bar"
+        assert s2.foo == "bar"
+
+    def test_empty_change(self, s1):
+        s1 = am.change(s1, lambda d: d.__setitem__("field", 123))
+        s2 = am.empty_change(s1, "empty!")
+        assert s2 is not s1
+        assert s2 == s1
+        history = am.get_history(s2)
+        assert history[-1].change["message"] == "empty!"
+        assert history[-1].change["ops"] == []
+
+
+class TestRootMap:
+    def test_set_root_properties(self, s1):
+        def cb(doc):
+            doc["first"] = "one"
+            doc["second"] = "two"
+        s2 = am.change(s1, cb)
+        assert s2 == {"first": "one", "second": "two"}
+
+    def test_delete_root_property(self, s1):
+        s1 = am.change(s1, lambda d: am.assign(d, {"a": 1, "b": 2}))
+        s2 = am.change(s1, lambda d: d.__delitem__("a"))
+        assert s2 == {"b": 2}
+        assert s1 == {"a": 1, "b": 2}
+
+    def test_delete_via_delattr(self, s1):
+        s1 = am.change(s1, lambda d: setattr(d, "x", 1))
+        s2 = am.change(s1, lambda d: delattr(d, "x"))
+        assert s2 == {}
+
+    def test_numeric_boolean_none_values(self, s1):
+        def cb(doc):
+            doc["int"] = 42
+            doc["float"] = 3.5
+            doc["bool"] = True
+            doc["none"] = None
+        s2 = am.change(s1, cb)
+        assert s2 == {"int": 42, "float": 3.5, "bool": True, "none": None}
+
+    def test_key_validation(self, s1):
+        with pytest.raises(TypeError):
+            am.change(s1, lambda d: d.__setitem__("", 1))
+        with pytest.raises(TypeError):
+            am.change(s1, lambda d: d.__setitem__("_x", 1))
+        with pytest.raises(TypeError):
+            am.change(s1, lambda d: d.__setitem__(7, 1))
+
+    def test_unsupported_value_types(self, s1):
+        with pytest.raises(TypeError):
+            am.change(s1, lambda d: d.__setitem__("f", lambda: None))
+        with pytest.raises(TypeError):
+            am.change(s1, lambda d: d.__setitem__("f", object()))
+
+
+class TestNestedMaps:
+    def test_create_nested_map(self, s1):
+        s2 = am.change(s1, lambda d: d.__setitem__("nested", {}))
+        assert s2 == {"nested": {}}
+        assert s2["nested"]._object_id != ROOT_ID
+
+    def test_nested_map_with_contents(self, s1):
+        s2 = am.change(s1, lambda d: d.__setitem__(
+            "birds", {"wrens": 3, "sparrows": 15}))
+        assert s2 == {"birds": {"wrens": 3, "sparrows": 15}}
+        assert s2["birds"] == {"wrens": 3, "sparrows": 15}
+
+    def test_deeply_nested(self, s1):
+        def cb(doc):
+            doc["a"] = {"b": {"c": {"d": "deep"}}}
+        s2 = am.change(s1, cb)
+        assert s2["a"]["b"]["c"]["d"] == "deep"
+
+    def test_mutate_nested_map_in_later_change(self, s1):
+        s1 = am.change(s1, lambda d: d.__setitem__("style", {"font": "Arial"}))
+        s2 = am.change(s1, lambda d: d["style"].__setitem__("size", 12))
+        assert s2 == {"style": {"font": "Arial", "size": 12}}
+        assert s1 == {"style": {"font": "Arial"}}
+
+    def test_delete_key_in_nested_map(self, s1):
+        s1 = am.change(s1, lambda d: d.__setitem__("style", {"font": "Arial", "size": 12}))
+        s2 = am.change(s1, lambda d: d["style"].__delitem__("size"))
+        assert s2 == {"style": {"font": "Arial"}}
+
+    def test_replace_nested_object(self, s1):
+        s1 = am.change(s1, lambda d: d.__setitem__("a", {"x": 1}))
+        s2 = am.change(s1, lambda d: d.__setitem__("a", {"y": 2}))
+        assert s2 == {"a": {"y": 2}}
+
+    def test_structural_sharing_of_unchanged_subtrees(self, s1):
+        s1 = am.change(s1, lambda d: am.assign(d, {"a": {"x": 1}, "b": {"y": 2}}))
+        s2 = am.change(s1, lambda d: d["a"].__setitem__("x", 99))
+        # the untouched subtree keeps its identity (incremental cache)
+        assert s2["b"] is s1["b"]
+        assert s2["a"] is not s1["a"]
+
+
+class TestLists:
+    def test_create_list(self, s1):
+        s2 = am.change(s1, lambda d: d.__setitem__("noodles", []))
+        assert s2 == {"noodles": []}
+
+    def test_list_with_contents(self, s1):
+        s2 = am.change(s1, lambda d: d.__setitem__("noodles", ["udon", "soba"]))
+        assert s2 == {"noodles": ["udon", "soba"]}
+        assert s2["noodles"][0] == "udon"
+        assert s2["noodles"][1] == "soba"
+        assert len(s2["noodles"]) == 2
+
+    def test_append(self, s1):
+        s1 = am.change(s1, lambda d: d.__setitem__("noodles", ["udon"]))
+        s2 = am.change(s1, lambda d: d["noodles"].append("soba"))
+        assert s2 == {"noodles": ["udon", "soba"]}
+        assert s1 == {"noodles": ["udon"]}
+
+    def test_insert_at(self, s1):
+        s1 = am.change(s1, lambda d: d.__setitem__("noodles", ["udon", "soba"]))
+        s2 = am.change(s1, lambda d: d["noodles"].insert_at(1, "ramen"))
+        assert s2 == {"noodles": ["udon", "ramen", "soba"]}
+
+    def test_insert_python_semantics(self, s1):
+        s1 = am.change(s1, lambda d: d.__setitem__("xs", [1, 3]))
+        s2 = am.change(s1, lambda d: d["xs"].insert(1, 2))
+        assert s2 == {"xs": [1, 2, 3]}
+
+    def test_set_list_index(self, s1):
+        s1 = am.change(s1, lambda d: d.__setitem__("xs", ["a", "b"]))
+        s2 = am.change(s1, lambda d: d["xs"].__setitem__(1, "B"))
+        assert s2 == {"xs": ["a", "B"]}
+
+    def test_assign_one_past_end_inserts(self, s1):
+        s1 = am.change(s1, lambda d: d.__setitem__("xs", ["a"]))
+        s2 = am.change(s1, lambda d: d["xs"].__setitem__(1, "b"))
+        assert s2 == {"xs": ["a", "b"]}
+
+    def test_insert_past_end_raises(self, s1):
+        s1 = am.change(s1, lambda d: d.__setitem__("xs", ["a"]))
+        with pytest.raises(IndexError):
+            am.change(s1, lambda d: d["xs"].__setitem__(5, "x"))
+
+    def test_delete_at(self, s1):
+        s1 = am.change(s1, lambda d: d.__setitem__("xs", ["a", "b", "c"]))
+        s2 = am.change(s1, lambda d: d["xs"].delete_at(1))
+        assert s2 == {"xs": ["a", "c"]}
+
+    def test_del_item(self, s1):
+        s1 = am.change(s1, lambda d: d.__setitem__("xs", ["a", "b", "c"]))
+        s2 = am.change(s1, lambda d: d["xs"].__delitem__(0))
+        assert s2 == {"xs": ["b", "c"]}
+
+    def test_pop_push_shift_unshift(self, s1):
+        s1 = am.change(s1, lambda d: d.__setitem__("xs", ["a", "b", "c"]))
+
+        def cb(doc):
+            assert doc["xs"].pop() == "c"
+            assert doc["xs"].shift() == "a"
+            doc["xs"].unshift("z")
+            doc["xs"].push("d", "e")
+        s2 = am.change(s1, cb)
+        assert s2 == {"xs": ["z", "b", "d", "e"]}
+
+    def test_splice(self, s1):
+        s1 = am.change(s1, lambda d: d.__setitem__("xs", ["a", "b", "c", "d"]))
+
+        def cb(doc):
+            deleted = doc["xs"].splice(1, 2, "X")
+            assert deleted == ["b", "c"]
+        s2 = am.change(s1, cb)
+        assert s2 == {"xs": ["a", "X", "d"]}
+
+    def test_fill(self, s1):
+        s1 = am.change(s1, lambda d: d.__setitem__("xs", [1, 2, 3, 4]))
+        s2 = am.change(s1, lambda d: d["xs"].fill(0, 1, 3))
+        assert s2 == {"xs": [1, 0, 0, 4]}
+
+    def test_nested_objects_in_lists(self, s1):
+        s2 = am.change(s1, lambda d: d.__setitem__(
+            "todos", [{"title": "water plants", "done": False}]))
+        assert s2 == {"todos": [{"title": "water plants", "done": False}]}
+        s3 = am.change(s2, lambda d: d["todos"][0].__setitem__("done", True))
+        assert s3 == {"todos": [{"title": "water plants", "done": True}]}
+
+    def test_extend(self, s1):
+        s1 = am.change(s1, lambda d: d.__setitem__("xs", [1]))
+        s2 = am.change(s1, lambda d: d["xs"].extend([2, 3]))
+        assert s2 == {"xs": [1, 2, 3]}
+
+    def test_list_snapshot_read_only(self, s1):
+        s2 = am.change(s1, lambda d: d.__setitem__("xs", [1, 2]))
+        with pytest.raises(TypeError):
+            s2["xs"].append(3)
+        with pytest.raises(TypeError):
+            s2["xs"][0] = 9
+
+
+class TestCounterlikeReadback:
+    def test_reads_see_prior_writes_in_same_block(self, s1):
+        def cb(doc):
+            doc["list"] = []
+            doc["list"].append("a")
+            assert doc["list"] == ["a"]
+            assert len(doc["list"]) == 1
+            doc["nested"] = {"x": 1}
+            assert doc["nested"]["x"] == 1
+            doc["nested"]["y"] = 2
+            assert doc["nested"] == {"x": 1, "y": 2}
+        s2 = am.change(s1, cb)
+        assert s2 == {"list": ["a"], "nested": {"x": 1, "y": 2}}

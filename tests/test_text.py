@@ -1,0 +1,76 @@
+"""Text CRDT (ports /root/reference/test/text_test.js)."""
+
+import automerge_tpu as am
+
+
+def make_text(*chars):
+    def edit(doc):
+        doc["text"] = am.Text()
+        if chars:
+            doc["text"].insert_at(0, *chars)
+    return am.change(am.init(), edit)
+
+
+class TestText:
+    def test_empty_text(self):
+        s = make_text()
+        assert len(s["text"]) == 0
+        assert str(s["text"]) == ""
+
+    def test_insert(self):
+        s = make_text("h", "e", "l", "l", "o")
+        assert str(s["text"]) == "hello"
+        assert s["text"].get(1) == "e"
+        assert len(s["text"]) == 5
+
+    def test_insert_in_middle(self):
+        s = make_text("a", "c")
+        s = am.change(s, lambda d: d["text"].insert_at(1, "b"))
+        assert str(s["text"]) == "abc"
+
+    def test_delete(self):
+        s = make_text("a", "b", "c")
+        s = am.change(s, lambda d: d["text"].delete_at(1))
+        assert str(s["text"]) == "ac"
+
+    def test_iteration(self):
+        s = make_text("x", "y")
+        assert list(s["text"]) == ["x", "y"]
+        assert "x" in s["text"]
+
+    def test_equality_with_str(self):
+        s = make_text("h", "i")
+        assert s["text"] == "hi"
+
+    def test_concurrent_inserts_converge(self):
+        s1 = make_text("a", "b")
+        s2 = am.merge(am.init("Z"), s1)
+        s1 = am.change(s1, lambda d: d["text"].insert_at(2, "1"))
+        s2 = am.change(s2, lambda d: d["text"].insert_at(2, "2"))
+        m1 = am.merge(s1, s2)
+        m2 = am.merge(s2, s1)
+        assert str(m1["text"]) == str(m2["text"])
+        assert sorted(str(m1["text"])) == ["1", "2", "a", "b"]
+
+    def test_concurrent_runs_do_not_interleave(self):
+        s1 = make_text()
+        s2 = am.merge(am.init("Z"), s1)
+        s1 = am.change(s1, lambda d: d["text"].insert_at(0, "a", "a", "a"))
+        s2 = am.change(s2, lambda d: d["text"].insert_at(0, "b", "b", "b"))
+        m = am.merge(s1, s2)
+        assert str(m["text"]) in ("aaabbb", "bbbaaa")
+
+    def test_text_snapshot_read_only(self):
+        s = make_text("a")
+        try:
+            s["text"].foo = 1
+            assert False, "should have raised"
+        except TypeError:
+            pass
+
+    def test_text_in_nested_object(self):
+        def edit(doc):
+            doc["card"] = {"title": am.Text()}
+            doc["card"]["title"].insert_at(0, "o", "k")
+        s = am.change(am.init(), edit)
+        assert str(s["card"]["title"]) == "ok"

@@ -1,0 +1,127 @@
+"""Undo/redo semantics (ports /root/reference/test/test.js 770-1080).
+
+Undo/redo are first-class changes: local-only, history-growing, computed from
+inverse ops recorded per local change.
+"""
+
+import pytest
+
+import automerge_tpu as am
+
+
+class TestUndo:
+    def test_cannot_undo_initially(self):
+        s = am.init()
+        assert not am.can_undo(s)
+        with pytest.raises(ValueError):
+            am.undo(s)
+
+    def test_undo_set(self):
+        s = am.change(am.init(), lambda d: d.__setitem__("x", 1))
+        assert am.can_undo(s)
+        s = am.undo(s)
+        assert s == {}
+
+    def test_undo_overwrite_restores_previous(self):
+        s = am.change(am.init(), lambda d: d.__setitem__("x", 1))
+        s = am.change(s, lambda d: d.__setitem__("x", 2))
+        s = am.undo(s)
+        assert s == {"x": 1}
+        s = am.undo(s)
+        assert s == {}
+        assert not am.can_undo(s)
+
+    def test_undo_delete_restores_value(self):
+        s = am.change(am.init(), lambda d: d.__setitem__("x", 1))
+        s = am.change(s, lambda d: d.__delitem__("x"))
+        assert s == {}
+        s = am.undo(s)
+        assert s == {"x": 1}
+
+    def test_undo_grows_history(self):
+        s = am.change(am.init(), lambda d: d.__setitem__("x", 1))
+        n = len(am.get_history(s))
+        s = am.undo(s)
+        assert len(am.get_history(s)) == n + 1
+
+    def test_undo_list_insertion(self):
+        s = am.change(am.init(), lambda d: d.__setitem__("xs", ["a"]))
+        s = am.change(s, lambda d: d["xs"].append("b"))
+        s = am.undo(s)
+        assert s == {"xs": ["a"]}
+
+    def test_undo_list_deletion(self):
+        s = am.change(am.init(), lambda d: d.__setitem__("xs", ["a", "b"]))
+        s = am.change(s, lambda d: d["xs"].delete_at(1))
+        assert s == {"xs": ["a"]}
+        s = am.undo(s)
+        assert s == {"xs": ["a", "b"]}
+
+    def test_undo_only_affects_local_changes(self):
+        s1 = am.change(am.init("A"), lambda d: d.__setitem__("mine", 1))
+        s2 = am.change(am.init("B"), lambda d: d.__setitem__("theirs", 2))
+        s1 = am.merge(s1, s2)
+        s1 = am.undo(s1)
+        assert s1 == {"theirs": 2}
+
+    def test_undo_with_message(self):
+        s = am.change(am.init(), lambda d: d.__setitem__("x", 1))
+        s = am.undo(s, "undo it")
+        assert am.get_history(s)[-1].change["message"] == "undo it"
+
+
+class TestRedo:
+    def test_cannot_redo_initially(self):
+        s = am.init()
+        assert not am.can_redo(s)
+        with pytest.raises(ValueError):
+            am.redo(s)
+
+    def test_redo_after_undo(self):
+        s = am.change(am.init(), lambda d: d.__setitem__("x", 1))
+        s = am.undo(s)
+        assert s == {}
+        assert am.can_redo(s)
+        s = am.redo(s)
+        assert s == {"x": 1}
+        assert not am.can_redo(s)
+
+    def test_undo_redo_chain(self):
+        s = am.init()
+        s = am.change(s, lambda d: d.__setitem__("x", 1))
+        s = am.change(s, lambda d: d.__setitem__("x", 2))
+        s = am.change(s, lambda d: d.__setitem__("x", 3))
+        s = am.undo(s)
+        s = am.undo(s)
+        assert s == {"x": 1}
+        s = am.redo(s)
+        assert s == {"x": 2}
+        s = am.redo(s)
+        assert s == {"x": 3}
+
+    def test_new_change_clears_redo_stack(self):
+        s = am.change(am.init(), lambda d: d.__setitem__("x", 1))
+        s = am.undo(s)
+        s = am.change(s, lambda d: d.__setitem__("y", 2))
+        assert not am.can_redo(s)
+        with pytest.raises(ValueError):
+            am.redo(s)
+
+    def test_redo_deletion(self):
+        s = am.change(am.init(), lambda d: d.__setitem__("x", 1))
+        s = am.change(s, lambda d: d.__delitem__("x"))
+        s = am.undo(s)
+        assert s == {"x": 1}
+        s = am.redo(s)
+        assert s == {}
+
+    def test_undo_redo_with_conflict(self):
+        s1 = am.change(am.init("A"), lambda d: d.__setitem__("f", "a"))
+        s2 = am.change(am.init("B"), lambda d: d.__setitem__("f", "b"))
+        s1 = am.merge(s1, s2)
+        assert s1["f"] == "b"
+        s1 = am.change(s1, lambda d: d.__setitem__("f", "resolved"))
+        s1 = am.undo(s1)
+        # undo restores both conflicting ops
+        assert s1["f"] == "b"
+        assert s1._conflicts == {"f": {"A": "a"}}
