@@ -1,0 +1,21 @@
+"""The columnar, batched TPU execution engine.
+
+This is the performance path promised by BASELINE.json's north star: the
+per-document interpretive loop of the semantic core (automerge_tpu/core) is
+replaced by fixed-shape integer kernels that reconcile an entire DocSet in one
+compiled program:
+
+- change causality and LWW winner selection lower to masked integer
+  comparisons over padded op tables (`kernels.field_states`);
+- RGA list ordering lowers to a next-pointer scan + pointer-doubling list
+  ranking (`kernels.linearize`);
+- tombstone index resolution lowers to scatter + prefix sums;
+- convergence checking lowers to an order-independent per-document state hash.
+
+Host code (encode.py) only interns strings to integers and pads; it never
+interprets ops one at a time.
+"""
+
+from .batchdoc import BatchedDocSet, apply_batch
+
+__all__ = ["BatchedDocSet", "apply_batch"]

@@ -1,0 +1,174 @@
+"""BatchedDocSet: a whole DocSet as one columnar device computation.
+
+The DocSet is the natural batch dimension of the TPU design (SURVEY.md §2.3):
+N documents' change sets are encoded into stacked integer arrays and one
+jitted, vmapped program computes every document's converged state — field
+survivors, LWW winners, list orders, tombstone ranks and a canonical state
+hash — in a single device invocation.
+
+`materialize` decodes a document's device state back into plain Python
+structures through the host-side string tables; it exists for parity checks
+and reads, not for the hot loop. The hot loop is: encode once, apply on
+device, compare hashes.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+import jax
+
+from ..core.change import Change
+from ..core.ids import ROOT_ID
+from .encode import (A_MAKE_LIST, A_MAKE_MAP, A_MAKE_TEXT, DocEncoding,
+                     encode_doc, stack_docs)
+from .kernels import apply_doc
+
+
+def apply_batch(doc_changes: list[list[Change]],
+                actors: list[str] | None = None):
+    """Encode + apply a batch of documents' change sets on device.
+
+    Returns (encodings, batch, out) where `out` holds per-doc device arrays
+    including `out["hash"]` — the canonical per-document state hash.
+    """
+    if actors is None:
+        all_actors = set()
+        for changes in doc_changes:
+            for c in changes:
+                all_actors.add(c.actor)
+        actors = sorted(all_actors)
+    encodings = [encode_doc(changes, actors) for changes in doc_changes]
+    batch = stack_docs(encodings)
+    max_fids = batch.pop("max_fids")
+    arrays = {k: jax.numpy.asarray(v) for k, v in batch.items()}
+    out = apply_doc(arrays, max_fids)
+    return encodings, arrays, out
+
+
+class BatchedDocSet:
+    """Columnar counterpart of sync.DocSet for bulk reconciliation."""
+
+    def __init__(self):
+        self.doc_ids: list[str] = []
+        self.changes: dict[str, list[Change]] = {}
+        self._encodings: list[DocEncoding] | None = None
+        self._out = None
+
+    def add_changes(self, doc_id: str, changes) -> None:
+        if doc_id not in self.changes:
+            self.changes[doc_id] = []
+            self.doc_ids.append(doc_id)
+        self.changes[doc_id].extend(changes)
+        self._out = None
+
+    def reconcile(self):
+        """Run the batched kernel over every document; returns per-doc hashes
+        as a numpy uint32 array aligned with self.doc_ids."""
+        doc_changes = [self.changes[d] for d in self.doc_ids]
+        self._encodings, _, self._out = apply_batch(doc_changes)
+        return np.asarray(self._out["hash"])
+
+    def state_hash(self, doc_id: str) -> int:
+        if self._out is None:
+            self.reconcile()
+        return int(np.asarray(self._out["hash"])[self.doc_ids.index(doc_id)])
+
+    def materialize(self, doc_id: str) -> Any:
+        """Decode one document's converged state into plain Python (dicts,
+        lists, strings for text)."""
+        if self._out is None:
+            self.reconcile()
+        i = self.doc_ids.index(doc_id)
+        enc = self._encodings[i]
+        out = {k: np.asarray(v)[i] for k, v in self._out.items()}
+        return decode_doc(enc, out)
+
+
+def decode_doc(enc: DocEncoding, out: dict[str, np.ndarray]) -> Any:
+    """Rebuild the nested document from device outputs + host tables."""
+    present = out["present"]
+    win_value = out["win_value"]
+    candidate = out["candidate"]
+
+    # conflicts: surviving value-carrying ops per fid, minus the winner
+    ops_by_fid: dict[int, list[tuple[int, int]]] = {}
+    fid_arr, actor_arr, value_arr = enc.fid, enc.actor, enc.value
+    for op_i in np.nonzero(candidate[:len(fid_arr)])[0]:
+        ops_by_fid.setdefault(int(fid_arr[op_i]), []).append(
+            (int(actor_arr[op_i]), int(value_arr[op_i])))
+
+    obj_type = {i: t for i, (_, t) in enumerate(enc.objects)}
+    fields_of_obj: dict[int, list[tuple[int, str]]] = {}
+    for f, (obj_idx, key) in enumerate(enc.fields):
+        fields_of_obj.setdefault(obj_idx, []).append((f, key))
+
+    list_rows = {int(obj): row for row, obj in enumerate(enc.list_obj)
+                 if obj >= 0}
+
+    def decode_value(value_id: int):
+        raw = enc.value_table.values[value_id]
+        if isinstance(raw, tuple) and len(raw) == 2 and raw[0] == "__link__":
+            return build(enc_obj_index(raw[1]))
+        return raw
+
+    obj_id_to_idx = {oid: i for i, (oid, _) in enumerate(enc.objects)}
+
+    def enc_obj_index(object_id: str) -> int:
+        return obj_id_to_idx[object_id]
+
+    def build(obj_idx: int):
+        t = obj_type[obj_idx]
+        if t == A_MAKE_MAP:
+            data = {}
+            conflicts = {}
+            for f, key in fields_of_obj.get(obj_idx, []):
+                if not present[f]:
+                    continue
+                data[key] = decode_value(int(win_value[f]))
+                survivors = ops_by_fid.get(f, [])
+                if len(survivors) > 1:
+                    win_actor = max(a for a, _ in survivors)
+                    conflicts[key] = {
+                        enc.actors[a]: decode_value(v)
+                        for a, v in survivors if a != win_actor}
+            return (data, conflicts) if obj_idx == 0 else data
+        # list or text
+        row = list_rows.get(obj_idx)
+        values: list = []
+        if row is not None:
+            vis = out["elem_visible"][row]
+            ranks = out["vis_rank"][row]
+            n_vis = int(vis.sum())
+            values = [None] * n_vis
+            for slot in np.nonzero(vis)[0]:
+                f = int(enc.ins_fid[row][slot])
+                values[int(ranks[slot])] = decode_value(int(win_value[f]))
+        if t == A_MAKE_TEXT:
+            return "".join(str(v) for v in values)
+        return values
+
+    data, conflicts = build(0)
+    return {"data": data, "conflicts": conflicts}
+
+
+def oracle_state(doc) -> dict:
+    """The same {data, conflicts} shape produced from an oracle document, for
+    parity assertions (text objects render as strings)."""
+    from .. import api
+    from ..frontend.text import Text
+
+    def convert(value):
+        if isinstance(value, Text):
+            return str(value)
+        if isinstance(value, dict):
+            return {k: convert(v) for k, v in value.items()}
+        if isinstance(value, (list, tuple)):
+            return [convert(v) for v in value]
+        return value
+
+    conflicts = {k: {a: convert(v) for a, v in c.items()}
+                 for k, c in doc._conflicts.items()}
+    return {"data": convert(api.inspect(doc)), "conflicts": conflicts}

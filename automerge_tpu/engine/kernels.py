@@ -1,0 +1,251 @@
+"""Device kernels: the CRDT semantics as fixed-shape integer array programs.
+
+Everything in this file is jit/vmap-compatible JAX operating on one document's
+padded arrays; engine/batchdoc.py vmaps these over the document axis so one
+compiled program reconciles an entire DocSet.
+
+Correspondence with the reference semantics:
+
+- `field_states` replaces the per-op interpretive loop of applyAssign
+  (/root/reference/src/op_set.js:179-209). Key insight: survivor analysis is
+  order-independent — op i survives iff no other op on the same field causally
+  dominates it, where "j dominates i" is the masked integer comparison
+  clock[change_j][actor_i] >= seq_i (the vectorized form of isConcurrent,
+  op_set.js:7-16). The LWW winner is the surviving op with the highest actor
+  rank (op_set.js:201), and ranks are assigned in sorted-string order so the
+  tie-break matches the reference exactly.
+
+- `linearize` replaces the insertion-tree walk (op_set.js:343-397) and the
+  skip list's rank queries (src/skip_list.js:259-285). It exploits the RGA
+  invariant parent.elem < child.elem: processing 'ins' ops in ascending
+  (elem, actor) order and head-inserting each element right after its parent
+  reproduces the reference's descending-children preorder exactly. That is an
+  O(1)-per-step lax.scan building a next-pointer array, followed by
+  pointer-doubling list ranking (log2 n gathers) to turn the linked list into
+  positions, and a scatter + prefix sum over the tombstone bitmap for
+  index resolution.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .encode import A_DEL, A_SET
+
+INT32_MAX = jnp.iinfo(jnp.int32).max
+
+
+# ---------------------------------------------------------------------------
+# Field survivor analysis + LWW winner selection
+
+def field_states(op_mask, action, fid, actor, seq, change_idx, value, clock,
+                 max_fids: int):
+    """Compute per-field CRDT state for one document.
+
+    Returns:
+      survivor:  [max_ops] bool — assign ops not causally overwritten
+      candidate: [max_ops] bool — survivors that carry a value (not 'del')
+      present:   [max_fids] bool — field has a visible value
+      win_actor: [max_fids] int32 — LWW winner's actor rank (-1 if absent)
+      win_value: [max_fids] int32 — winner's value id (-1 if absent)
+    """
+    is_assign = action >= A_SET
+    amask = op_mask & is_assign
+
+    # clock of op j's change, evaluated at op i's actor: [j, i]
+    clock_j = clock[change_idx]                # [max_ops, n_actors]
+    clock_j_at_i = clock_j[:, actor]           # [j, i]
+
+    dominates = (
+        amask[:, None] & amask[None, :]
+        & (fid[:, None] == fid[None, :])
+        & (clock_j_at_i >= seq[None, :])
+        & (change_idx[:, None] != change_idx[None, :])
+    )
+    dominated = jnp.any(dominates, axis=0)
+    survivor = amask & ~dominated
+    candidate = survivor & (action != A_DEL)
+
+    # Segment reductions over the dense fid space; padded/invalid ops are
+    # parked in an extra trailing segment.
+    seg = jnp.where(amask, fid, max_fids)
+    win_actor = jax.ops.segment_max(
+        jnp.where(candidate, actor, -1), seg,
+        num_segments=max_fids + 1)[:max_fids]
+    win_actor = jnp.maximum(win_actor, -1)  # segment_max of empty segments is -inf-ish
+
+    is_winner = candidate & (actor == win_actor[jnp.where(amask, fid, 0)]) & amask
+    win_value = jax.ops.segment_max(
+        jnp.where(is_winner, value, -1), seg,
+        num_segments=max_fids + 1)[:max_fids]
+    win_value = jnp.maximum(win_value, -1)
+    present = win_actor >= 0
+    return survivor, candidate, present, win_actor, win_value
+
+
+# ---------------------------------------------------------------------------
+# RGA linearization
+
+def _ceil_log2(n: int) -> int:
+    bits = 0
+    m = 1
+    while m < n:
+        m *= 2
+        bits += 1
+    return max(bits, 1)
+
+
+def linearize(ins_mask, ins_elem, ins_actor, ins_parent):
+    """Order one list object's elements (including tombstones).
+
+    Returns elem_pos: [max_elems] int32 — 0-based position of each element
+    slot in the full RGA document order (garbage for masked-out slots).
+    """
+    max_elems = ins_mask.shape[0]
+
+    # Ascending (elem, actor) processing order; padding sorts to the end.
+    sort_elem = jnp.where(ins_mask, ins_elem, INT32_MAX)
+    order = jnp.lexsort((ins_actor, sort_elem))
+
+    # next-pointer construction: node 0 is the head sentinel, element slot e
+    # lives at node e+1.
+    def step(next_arr, slot):
+        valid = ins_mask[slot]
+        p = jnp.where(ins_parent[slot] >= 0, ins_parent[slot] + 1, 0)
+        e = slot + 1
+        succ = next_arr[p]
+        updated = next_arr.at[e].set(succ).at[p].set(e)
+        return jnp.where(valid, updated, next_arr), None
+
+    next0 = jnp.full(max_elems + 1, -1, dtype=jnp.int32)
+    next_arr, _ = jax.lax.scan(step, next0, order)
+
+    # Pointer-doubling list ranking: d[v] = #nodes strictly after v.
+    d = jnp.where(next_arr >= 0, 1, 0).astype(jnp.int32)
+    nxt = next_arr
+    for _ in range(_ceil_log2(max_elems + 1)):
+        safe = jnp.maximum(nxt, 0)
+        d = d + jnp.where(nxt >= 0, d[safe], 0)
+        nxt = jnp.where(nxt >= 0, nxt[safe], -1)
+
+    total = d[0]
+    pos = total - d            # head = 0, first element = 1, ...
+    return pos[1:] - 1         # element slot positions, 0-based
+
+
+def visible_ranks(elem_pos, visible):
+    """Tombstone index resolution: position of each visible element among the
+    visible ones (the replacement for skip-list keyOf/indexOf). Returns
+    vis_rank [max_elems] (-1 where not visible)."""
+    max_elems = elem_pos.shape[0]
+    safe_pos = jnp.clip(elem_pos, 0, max_elems - 1)
+    arr = jnp.zeros(max_elems, dtype=jnp.int32).at[safe_pos].add(
+        jnp.where(visible, 1, 0))
+    cum = jnp.cumsum(arr)
+    rank = cum[safe_pos] - 1
+    return jnp.where(visible, rank, -1)
+
+
+# ---------------------------------------------------------------------------
+# Order-independent state hashing (convergence oracle)
+
+def _mix(h):
+    """32-bit finalizer (murmur3-style) over uint32."""
+    h = h.astype(jnp.uint32)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def _mix4(a, b, c, d):
+    h = _mix(a.astype(jnp.uint32) + jnp.uint32(0x9E3779B9))
+    h = _mix(h ^ b.astype(jnp.uint32))
+    h = _mix(h ^ c.astype(jnp.uint32))
+    h = _mix(h ^ d.astype(jnp.uint32))
+    return h
+
+
+def state_hash(candidate, fid, actor, value, fid_is_list, fid_list_obj,
+               fid_vis_rank):
+    """Canonical per-document hash of the converged state.
+
+    Map fields hash as (fid, actor, value) per surviving value-carrying op
+    (winner + conflicts = the whole field state). List/text element fields
+    hash by their resolved visible rank instead of their element identity, so
+    two replicas agree iff their visible sequences and values agree. The sum
+    is order-independent, hence delivery-order-independent.
+    """
+    safe_fid = jnp.maximum(fid, 0)
+    is_list = fid_is_list[safe_fid]
+    key1 = jnp.where(is_list, fid_list_obj[safe_fid], jnp.int32(-7))
+    key2 = jnp.where(is_list, fid_vis_rank[safe_fid], safe_fid)
+    contrib = _mix4(key1, key2, actor, value)
+    # list elements that resolved to rank -1 (tombstoned) carry no value; a
+    # candidate op on an invisible element cannot happen (candidate => present
+    # => visible), so no extra masking is needed beyond `candidate`.
+    return jnp.sum(jnp.where(candidate, contrib, jnp.uint32(0)),
+                   dtype=jnp.uint32)
+
+
+# ---------------------------------------------------------------------------
+# Whole-document kernel
+
+@partial(jax.jit, static_argnames=("max_fids",))
+def apply_doc(batch, max_fids: int):
+    """Compute converged state for every document in a stacked batch.
+
+    batch: dict of arrays with leading docs axis (see encode.stack_docs).
+    Returns a dict of per-doc state arrays (see batchdoc.BatchedDocSet).
+    """
+
+    def one_doc(op_mask, action, fid, actor, seq, change_idx, value, clock,
+                ins_mask, ins_elem, ins_actor, ins_parent, ins_fid, list_obj):
+        survivor, candidate, present, win_actor, win_value = field_states(
+            op_mask, action, fid, actor, seq, change_idx, value, clock,
+            max_fids)
+
+        # Linearize every list object in this doc.
+        elem_pos = jax.vmap(linearize)(ins_mask, ins_elem, ins_actor, ins_parent)
+        safe_ins_fid = jnp.clip(ins_fid, 0, max_fids - 1)
+        elem_visible = ins_mask & (ins_fid >= 0) & present[safe_ins_fid]
+        vis_rank = jax.vmap(visible_ranks)(elem_pos, elem_visible)
+
+        # fid -> (is_list, owning list object, visible rank) lookup tables.
+        # Invalid entries are parked in an extra trailing slot and sliced off.
+        fid_is_list = jnp.zeros(max_fids + 1, dtype=jnp.int32)
+        fid_list_obj = jnp.full(max_fids + 1, -1, dtype=jnp.int32)
+        fid_vis_rank = jnp.full(max_fids + 1, -1, dtype=jnp.int32)
+        flat_fid = ins_fid.reshape(-1)
+        flat_valid = flat_fid >= 0
+        flat_obj = jnp.broadcast_to(list_obj[:, None], ins_fid.shape).reshape(-1)
+        flat_rank = vis_rank.reshape(-1)
+        upd = jnp.where(flat_valid, flat_fid, max_fids)
+        fid_is_list = fid_is_list.at[upd].max(flat_valid.astype(jnp.int32))
+        fid_list_obj = fid_list_obj.at[upd].max(
+            jnp.where(flat_valid, flat_obj, -1))
+        fid_vis_rank = fid_vis_rank.at[upd].max(
+            jnp.where(flat_valid, flat_rank, -1))
+        fid_is_list = fid_is_list[:max_fids].astype(bool)
+        fid_list_obj = fid_list_obj[:max_fids]
+        fid_vis_rank = fid_vis_rank[:max_fids]
+
+        h = state_hash(candidate, fid, actor, value,
+                       fid_is_list, fid_list_obj, fid_vis_rank)
+        return {
+            "survivor": survivor, "candidate": candidate, "present": present,
+            "win_actor": win_actor, "win_value": win_value,
+            "elem_pos": elem_pos, "vis_rank": vis_rank,
+            "elem_visible": elem_visible, "hash": h,
+        }
+
+    return jax.vmap(one_doc)(
+        batch["op_mask"], batch["action"], batch["fid"], batch["actor"],
+        batch["seq"], batch["change_idx"], batch["value"], batch["clock"],
+        batch["ins_mask"], batch["ins_elem"], batch["ins_actor"],
+        batch["ins_parent"], batch["ins_fid"], batch["list_obj"])

@@ -1,0 +1,226 @@
+"""Convergence parity: the columnar device engine vs the Python oracle.
+
+This is the BASELINE.json conformance gate: the batched kernel must produce
+byte-identical converged state (and equal canonical hashes) for the same
+change sets, regardless of delivery order.
+"""
+
+import random
+
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu.core.change import Change
+from automerge_tpu.engine.batchdoc import apply_batch, decode_doc, oracle_state
+
+
+def engine_state(changes):
+    encs, _, out = apply_batch([changes])
+    import numpy as np
+    doc_out = {k: np.asarray(v)[0] for k, v in out.items()}
+    return decode_doc(encs[0], doc_out)
+
+
+def engine_hash(changes):
+    _, _, out = apply_batch([changes])
+    import numpy as np
+    return int(np.asarray(out["hash"])[0])
+
+
+def all_changes(doc):
+    return doc._doc.opset.get_missing_changes({})
+
+
+def assert_parity(doc):
+    changes = all_changes(doc)
+    expected = oracle_state(doc)
+    actual = engine_state(changes)
+    assert actual == expected, f"\nengine: {actual}\noracle: {expected}"
+    # hash must be invariant under delivery-order permutation
+    h1 = engine_hash(changes)
+    shuffled = list(changes)
+    random.Random(0).shuffle(shuffled)
+    h2 = engine_hash(shuffled)
+    assert h1 == h2
+
+
+class TestMapParity:
+    def test_flat_map(self):
+        s = am.change(am.init("A"), lambda d: am.assign(d, {"x": 1, "y": "two"}))
+        assert_parity(s)
+
+    def test_overwrite(self):
+        s = am.change(am.init("A"), lambda d: d.__setitem__("x", 1))
+        s = am.change(s, lambda d: d.__setitem__("x", 2))
+        assert_parity(s)
+
+    def test_delete(self):
+        s = am.change(am.init("A"), lambda d: am.assign(d, {"x": 1, "y": 2}))
+        s = am.change(s, lambda d: d.__delitem__("x"))
+        assert_parity(s)
+
+    def test_lww_conflict(self):
+        s1 = am.change(am.init("A"), lambda d: d.__setitem__("f", "a"))
+        s2 = am.change(am.init("B"), lambda d: d.__setitem__("f", "b"))
+        assert_parity(am.merge(s1, s2))
+
+    def test_three_actor_conflict(self):
+        docs = [am.change(am.init(a), lambda d, a=a: d.__setitem__("f", f"from {a}"))
+                for a in "ABC"]
+        m = am.merge(am.merge(docs[0], docs[1]), docs[2])
+        assert_parity(m)
+
+    def test_add_wins(self):
+        s1 = am.change(am.init("A"), lambda d: d.__setitem__("k", "v"))
+        s2 = am.merge(am.init("B"), s1)
+        s1 = am.change(s1, lambda d: d.__delitem__("k"))
+        s2 = am.change(s2, lambda d: d.__setitem__("k", "w"))
+        assert_parity(am.merge(s1, s2))
+
+    def test_nested_maps(self):
+        s = am.change(am.init("A"), lambda d: d.__setitem__(
+            "cfg", {"ui": {"theme": "dark"}, "n": 3}))
+        s = am.change(s, lambda d: d["cfg"]["ui"].__setitem__("lang", "en"))
+        assert_parity(s)
+
+    def test_value_types(self):
+        s = am.change(am.init("A"), lambda d: am.assign(d, {
+            "i": 42, "f": 3.5, "b": True, "b2": False, "n": None, "s": "str",
+            "zero": 0}))
+        assert_parity(s)
+
+
+class TestListParity:
+    def test_simple_list(self):
+        s = am.change(am.init("A"), lambda d: d.__setitem__("xs", [1, 2, 3]))
+        assert_parity(s)
+
+    def test_list_insert_middle(self):
+        s = am.change(am.init("A"), lambda d: d.__setitem__("xs", ["a", "c"]))
+        s = am.change(s, lambda d: d["xs"].insert_at(1, "b"))
+        assert_parity(s)
+
+    def test_list_delete(self):
+        s = am.change(am.init("A"), lambda d: d.__setitem__("xs", ["a", "b", "c"]))
+        s = am.change(s, lambda d: d["xs"].delete_at(1))
+        assert_parity(s)
+
+    def test_list_set_index(self):
+        s = am.change(am.init("A"), lambda d: d.__setitem__("xs", ["a", "b"]))
+        s = am.change(s, lambda d: d["xs"].__setitem__(0, "A"))
+        assert_parity(s)
+
+    def test_concurrent_inserts_same_position(self):
+        s1 = am.change(am.init("A"), lambda d: d.__setitem__("xs", []))
+        s2 = am.merge(am.init("B"), s1)
+        s1 = am.change(s1, lambda d: d["xs"].extend(["a1", "a2"]))
+        s2 = am.change(s2, lambda d: d["xs"].extend(["b1", "b2"]))
+        assert_parity(am.merge(s1, s2))
+
+    def test_concurrent_insert_delete(self):
+        s1 = am.change(am.init("A"), lambda d: d.__setitem__("xs", ["a", "b", "c"]))
+        s2 = am.merge(am.init("B"), s1)
+        s1 = am.change(s1, lambda d: d["xs"].delete_at(2))
+        s2 = am.change(s2, lambda d: d["xs"].insert_at(2, "mid"))
+        assert_parity(am.merge(s1, s2))
+
+    def test_tombstone_heavy(self):
+        s = am.change(am.init("A"), lambda d: d.__setitem__("xs", list(range(10))))
+        for _ in range(8):
+            s = am.change(s, lambda d: d["xs"].delete_at(0))
+        assert_parity(s)
+
+    def test_objects_in_lists(self):
+        s = am.change(am.init("A"), lambda d: d.__setitem__(
+            "cards", [{"t": "one"}, {"t": "two"}]))
+        s = am.change(s, lambda d: d["cards"][0].__setitem__("done", True))
+        assert_parity(s)
+
+
+class TestTextParity:
+    def test_text(self):
+        def edit(doc):
+            doc["t"] = am.Text()
+            doc["t"].insert_at(0, *"hello")
+        s = am.change(am.init("A"), edit)
+        s = am.change(s, lambda d: d["t"].delete_at(0))
+        s = am.change(s, lambda d: d["t"].insert_at(2, "X"))
+        assert_parity(s)
+
+    def test_concurrent_text(self):
+        def edit(doc):
+            doc["t"] = am.Text()
+            doc["t"].insert_at(0, *"ab")
+        s1 = am.change(am.init("A"), edit)
+        s2 = am.merge(am.init("B"), s1)
+        s1 = am.change(s1, lambda d: d["t"].insert_at(2, *"12"))
+        s2 = am.change(s2, lambda d: d["t"].insert_at(2, *"xy"))
+        assert_parity(am.merge(s1, s2))
+
+
+class TestBatch:
+    def test_many_docs_one_invocation(self):
+        docs = []
+        for i in range(16):
+            s = am.change(am.init(f"actor{i:02d}"),
+                          lambda d, i=i: am.assign(d, {"n": i, "xs": [i, i + 1]}))
+            docs.append(s)
+        batches = [all_changes(d) for d in docs]
+        encs, _, out = apply_batch(batches)
+        import numpy as np
+        for i, doc in enumerate(docs):
+            doc_out = {k: np.asarray(v)[i] for k, v in out.items()}
+            assert decode_doc(encs[i], doc_out) == oracle_state(doc)
+
+    def test_cross_replica_hash_equality(self):
+        s1 = am.change(am.init("A"), lambda d: d.__setitem__("xs", ["a"]))
+        s2 = am.merge(am.init("B"), s1)
+        s1 = am.change(s1, lambda d: d["xs"].append("b"))
+        s2 = am.change(s2, lambda d: d["xs"].insert_at(0, "z"))
+        m1, m2 = am.merge(s1, s2), am.merge(s2, s1)
+        assert engine_hash(all_changes(m1)) == engine_hash(all_changes(m2))
+
+
+class TestFuzzConvergence:
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_traces(self, seed):
+        rng = random.Random(seed)
+        actors = ["A", "B", "C"]
+        docs = {a: am.init(a) for a in actors}
+        # seed shared structure
+        base = am.change(docs["A"], lambda d: am.assign(
+            d, {"m": {}, "xs": ["x"], "k": 0}))
+        docs["A"] = base
+        for a in ("B", "C"):
+            docs[a] = am.merge(docs[a], base)
+
+        def random_edit(doc, rng):
+            choice = rng.random()
+            if choice < 0.35:
+                key = rng.choice(["k", "k2", "k3"])
+                return am.change(doc, lambda d: d.__setitem__(key, rng.randint(0, 9)))
+            if choice < 0.5:
+                return am.change(doc, lambda d: d["m"].__setitem__(
+                    rng.choice(["p", "q"]), rng.randint(0, 9)))
+            if choice < 0.7:
+                val = f"v{rng.randint(0, 99)}"
+                pos = rng.randint(0, len(doc["xs"]))
+                return am.change(doc, lambda d: d["xs"].insert_at(pos, val))
+            if choice < 0.85 and len(doc["xs"]) > 0:
+                pos = rng.randint(0, len(doc["xs"]) - 1)
+                return am.change(doc, lambda d: d["xs"].delete_at(pos))
+            if len(doc["xs"]) > 0:
+                pos = rng.randint(0, len(doc["xs"]) - 1)
+                return am.change(doc, lambda d: d["xs"].__setitem__(
+                    pos, f"s{rng.randint(0, 99)}"))
+            return doc
+
+        for _ in range(15):
+            actor = rng.choice(actors)
+            docs[actor] = random_edit(docs[actor], rng)
+            if rng.random() < 0.3:
+                other = rng.choice([a for a in actors if a != actor])
+                docs[actor] = am.merge(docs[actor], docs[other])
+
+        final = am.merge(am.merge(docs["A"], docs["B"]), docs["C"])
+        assert_parity(final)
