@@ -1,0 +1,32 @@
+"""Collectives for DocSet reconciliation across a mesh.
+
+The reference's Connection merges peer clocks with an element-wise max
+(clockUnion, /root/reference/src/connection.js:16-19). Over a device mesh the
+same operation on a sharded [n_docs, n_actors] clock matrix is a max-reduction
+whose cross-shard step XLA lowers to an all-reduce over ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DOCS_AXIS
+
+
+def global_clock_union(clocks, mesh: Mesh):
+    """Element-wise max over the (sharded) docs axis: the fleet-wide vector
+    clock across every replica of a document group.
+
+    clocks: [n_docs, n_actors] int32, sharded over docs.
+    Returns [n_actors] replicated on every device.
+    """
+    out_sharding = NamedSharding(mesh, P())  # replicated result
+
+    @jax.jit
+    def reduce(c):
+        return jax.lax.with_sharding_constraint(
+            jnp.max(c, axis=0), out_sharding)
+
+    return reduce(clocks)

@@ -1,0 +1,82 @@
+"""Device-mesh sharding of batched DocSet reconciliation.
+
+The reference's unit of distribution is the DocSet synced per-connection
+(/root/reference/src/connection.js); its only parallelism is replica
+parallelism across network peers (SURVEY.md §2.3). The TPU-native equivalent:
+the document axis of a columnar batch is sharded across a
+`jax.sharding.Mesh`, and one jitted program reconciles the whole set with XLA
+inserting any needed collectives. Documents are independent, so the forward
+pass is embarrassingly parallel over ICI; cross-document reductions (global
+clock unions, convergence checks) become mesh collectives
+(parallel/collective.py).
+
+On a multi-host pod the same code runs under jax.distributed with a global
+mesh; the host boundary still speaks the reference's {docId, clock, changes}
+schema over DCN while device shards reconcile in parallel.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..engine.encode import encode_doc, stack_docs
+
+DOCS_AXIS = "docs"
+
+
+def make_mesh(n_devices: int | None = None, axis: str = DOCS_AXIS) -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))
+
+
+def _pad_docs(batch: dict, multiple: int) -> dict:
+    """Pad the leading docs axis so it divides the mesh size; padded docs are
+    fully masked out and contribute nothing."""
+    n_docs = batch["op_mask"].shape[0]
+    rem = n_docs % multiple
+    if rem == 0:
+        return batch
+    pad = multiple - rem
+    out = {}
+    for key, arr in batch.items():
+        if not isinstance(arr, np.ndarray):
+            out[key] = arr
+            continue
+        widths = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
+        fill = False if arr.dtype == bool else (0 if key in ("actor", "seq", "change_idx", "clock", "ins_elem", "ins_actor") else -1)
+        out[key] = np.pad(arr, widths, constant_values=fill)
+    return out
+
+
+def shard_batch(batch: dict, mesh: Mesh):
+    """device_put every batch array with the docs axis sharded over the mesh."""
+    sharding = NamedSharding(mesh, P(DOCS_AXIS))
+    return {k: jax.device_put(np.asarray(v), sharding) for k, v in batch.items()}
+
+
+def sharded_apply(arrays: dict, max_fids: int, mesh: Mesh):
+    """The batched reconcile kernel jitted over the mesh: inputs arrive
+    sharded over docs, outputs stay sharded over docs."""
+    from ..engine.kernels import apply_doc
+    out_sharding = NamedSharding(mesh, P(DOCS_AXIS))
+    fn = jax.jit(lambda b: apply_doc(b, max_fids),
+                 out_shardings=out_sharding)
+    return fn(arrays)
+
+
+def reconcile_sharded(doc_changes, mesh: Mesh):
+    """End-to-end: encode a list of per-document change sets, shard them over
+    the mesh, reconcile, and return (encodings, sharded outputs, n_real_docs)."""
+    all_actors = sorted({c.actor for changes in doc_changes for c in changes})
+    encodings = [encode_doc(changes, all_actors) for changes in doc_changes]
+    batch = stack_docs(encodings)
+    max_fids = batch.pop("max_fids")
+    batch = _pad_docs(batch, mesh.devices.size)
+    arrays = shard_batch(batch, mesh)
+    out = sharded_apply(arrays, max_fids, mesh)
+    return encodings, out, len(doc_changes)

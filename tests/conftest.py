@@ -5,11 +5,17 @@ virtual device mesh — SURVEY.md §4)."""
 
 import os
 
-# Must run before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must run before jax creates a backend. Force the CPU platform with 8
+# virtual devices so the mesh-sharding paths are exercised deterministically
+# and offline. (The environment presets JAX_PLATFORMS to the TPU tunnel and
+# its plugin wins over the env var, so the config API is used instead.)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
