@@ -16,12 +16,13 @@ Architecture (see SURVEY.md for the blueprint):
 """
 
 from .api import (
-    init, change, empty_change, merge, diff, assign, load, save, equals,
-    inspect, get_history, get_conflicts, get_changes, get_changes_for_actor,
-    apply_changes, get_missing_changes, get_missing_deps, get_clock,
-    get_actor_id, can_undo, undo, can_redo, redo,
+    init, init_immutable, change, empty_change, merge, diff, assign, load,
+    load_immutable, save, equals, inspect, get_history, get_conflicts,
+    get_changes, get_changes_for_actor, apply_changes, get_missing_changes,
+    get_missing_deps, get_clock, get_actor_id, can_undo, undo, can_redo, redo,
 )
 from .core.change import Change, Op
+from .utils import metrics
 from .core.ids import ROOT_ID
 from .frontend.text import Text
 from .sync import Connection, DocSet, WatchableDoc
@@ -35,11 +36,12 @@ uuid.reset = _uuid_mod.reset
 __version__ = "0.1.0"
 
 __all__ = [
-    "init", "change", "empty_change", "merge", "diff", "assign", "load",
+    "init", "init_immutable", "change", "empty_change", "merge", "diff",
+    "assign", "load", "load_immutable",
     "save", "equals", "inspect", "get_history", "get_conflicts",
     "get_changes", "get_changes_for_actor", "apply_changes",
     "get_missing_changes", "get_missing_deps", "get_clock", "get_actor_id",
     "can_undo", "undo", "can_redo", "redo",
     "Change", "Op", "ROOT_ID", "Text", "Connection", "DocSet",
-    "WatchableDoc", "uuid", "__version__",
+    "WatchableDoc", "uuid", "metrics", "__version__",
 ]

@@ -42,6 +42,24 @@ def init(actor_id: str | None = None) -> RootMap:
     return materialize_root(actor_id or make_uuid(), OpSet.init())
 
 
+def init_immutable(actor_id: str | None = None):
+    """Create an empty document with the immutable-view frontend
+    (automerge.js:147-149)."""
+    from .frontend.immutable_view import materialize_immutable_root
+    return materialize_immutable_root(actor_id or make_uuid(), OpSet.init())
+
+
+def load_immutable(data: str, actor_id: str | None = None):
+    """Load a saved change log into an immutable-view document
+    (automerge.js:216-221)."""
+    doc = init_immutable(actor_id)
+    payload = json.loads(data)
+    changes = payload.get("changes", payload) if isinstance(payload, dict) else payload
+    return apply_changes_to_doc(doc, doc._doc.opset,
+                                [coerce_change(c) for c in changes],
+                                incremental=False)
+
+
 # ---------------------------------------------------------------------------
 # Change assembly (auto_api.js:28-111)
 

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 from typing import Any, Iterator
 
+from ..utils import metrics
 from ..utils.persist import AList, EMPTY_ALIST
 from .change import Change, Op
 from .ids import HEAD, ROOT_ID, make_elem_id, parse_elem_id
@@ -460,6 +461,9 @@ def apply_change(b: Builder, change: Change) -> list[dict]:
     b.deps[actor] = seq
     b.clock[actor] = seq
     b.history = b.history.append(change)
+    metrics.bump("changes_applied")
+    metrics.bump("ops_applied", len(change.ops))
+    metrics.bump("diffs_emitted", len(diffs))
     return diffs
 
 

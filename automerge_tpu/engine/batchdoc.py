@@ -40,11 +40,16 @@ def apply_batch(doc_changes: list[list[Change]],
             for c in changes:
                 all_actors.add(c.actor)
         actors = sorted(all_actors)
-    encodings = [encode_doc(changes, actors) for changes in doc_changes]
-    batch = stack_docs(encodings)
-    max_fids = batch.pop("max_fids")
-    arrays = {k: jax.numpy.asarray(v) for k, v in batch.items()}
-    out = apply_doc(arrays, max_fids)
+    from ..utils import metrics
+    with metrics.trace("engine_reconcile"):
+        encodings = [encode_doc(changes, actors) for changes in doc_changes]
+        batch = stack_docs(encodings)
+        max_fids = batch.pop("max_fids")
+        arrays = {k: jax.numpy.asarray(v) for k, v in batch.items()}
+        out = apply_doc(arrays, max_fids)
+    metrics.bump("engine_docs_reconciled", len(doc_changes))
+    metrics.bump("engine_ops_reconciled",
+                 sum(len(c.ops) for changes in doc_changes for c in changes))
     return encodings, arrays, out
 
 

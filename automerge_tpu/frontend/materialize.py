@@ -133,8 +133,15 @@ def update_cache(opset: OpSet, diffs: list[dict], old_cache: dict) -> dict:
 
 def apply_changes_to_doc(doc, opset: OpSet, changes, incremental: bool):
     """The frontend's change-ingestion entry point (freeze_api.js:245-267):
-    run changes through the CRDT core, then refresh the materialization."""
+    run changes through the CRDT core, then refresh the materialization.
+    Dispatches on the document's frontend style (auto_api.js:34-38)."""
     new_opset, diffs = opset.add_changes(changes)
+    if getattr(doc._doc, "frontend", "frozen") == "immutable":
+        # The immutable-view frontend re-instantiates from the opset (the
+        # reference's ImmutableAPI likewise refreshes rather than patches,
+        # immutable_api.js:45-50).
+        from .immutable_view import materialize_immutable_root
+        return materialize_immutable_root(doc._doc.actor_id, new_opset)
     if incremental:
         cache = update_cache(new_opset, diffs, doc._doc.cache)
     else:

@@ -20,14 +20,21 @@ _READONLY_MSG = ("this document snapshot is read-only. "
 
 
 class DocState:
-    """Internal per-document state hanging off the root snapshot."""
+    """Internal per-document state hanging off the root snapshot.
 
-    __slots__ = ("actor_id", "opset", "cache")
+    `frontend` selects the materialization style — "frozen" (blocked-mutator
+    dict/list snapshots) or "immutable" (mapping-proxy/tuple views) — the
+    analog of the reference's FreezeAPI/ImmutableAPI dispatch
+    (auto_api.js:34-38)."""
 
-    def __init__(self, actor_id: str, opset, cache: dict):
+    __slots__ = ("actor_id", "opset", "cache", "frontend")
+
+    def __init__(self, actor_id: str, opset, cache: dict,
+                 frontend: str = "frozen"):
         self.actor_id = actor_id
         self.opset = opset
         self.cache = cache  # objectId -> materialized snapshot
+        self.frontend = frontend
 
 
 def _blocked(name: str):

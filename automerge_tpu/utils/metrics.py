@@ -1,0 +1,76 @@
+"""Observability: counters, timers, and profiler hooks.
+
+The reference has no instrumentation at all (SURVEY.md §5 — no logging, no
+timers anywhere in src/). The rebuild adds the counters the reference's
+maintainers could only infer from the data model, plus a trace hook that
+annotates device work for jax.profiler / xprof.
+
+Usage:
+    from automerge_tpu import metrics
+    metrics.snapshot()   # {"changes_applied": ..., "ops_applied": ...}
+    metrics.reset()
+
+    with metrics.trace("reconcile"):   # host timer + device annotation
+        ...
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+
+class _Metrics:
+    def __init__(self):
+        self.counters: dict[str, int] = defaultdict(int)
+        self.timers: dict[str, float] = defaultdict(float)
+
+    def bump(self, name: str, n: int = 1) -> None:
+        self.counters[name] += n
+
+    def add_time(self, name: str, seconds: float) -> None:
+        self.timers[name] += seconds
+
+    def snapshot(self) -> dict:
+        out = dict(self.counters)
+        out.update({f"{k}_s": round(v, 6) for k, v in self.timers.items()})
+        return out
+
+    def reset(self) -> None:
+        self.counters.clear()
+        self.timers.clear()
+
+
+_global = _Metrics()
+
+
+def bump(name: str, n: int = 1) -> None:
+    _global.bump(name, n)
+
+
+def snapshot() -> dict:
+    return _global.snapshot()
+
+
+def reset() -> None:
+    _global.reset()
+
+
+@contextmanager
+def trace(name: str):
+    """Host wall-clock accounting plus a device trace annotation (visible in
+    xprof captures when a jax.profiler trace is active)."""
+    try:
+        import jax.profiler
+        annotation = jax.profiler.TraceAnnotation(name)
+    except Exception:  # profiler unavailable on some backends
+        annotation = None
+    t0 = time.perf_counter()
+    if annotation is not None:
+        with annotation:
+            yield
+    else:
+        yield
+    _global.add_time(name, time.perf_counter() - t0)
+    _global.bump(f"{name}_count")

@@ -1,0 +1,65 @@
+"""Property-based tests of the element order index against a shadow model.
+
+Ports the strategy of /root/reference/test/skip_list_test.js:170-205: random
+operation sequences are applied both to the real structure (ElemList) and to a
+plain-list shadow model, asserting equal observable state after every step.
+Also covers the persistence contract (copies do not alias).
+"""
+
+import random
+
+import pytest
+
+from automerge_tpu.core.elems import ElemList
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_random_ops_match_shadow_model(seed):
+    rng = random.Random(seed)
+    real = ElemList()
+    shadow: list[tuple[str, object]] = []
+
+    for step in range(300):
+        n = len(shadow)
+        op = rng.random()
+        if op < 0.5 or n == 0:
+            i = rng.randint(0, n)
+            key, value = f"k{seed}:{step}", rng.randint(0, 999)
+            real.insert_index(i, key, value)
+            shadow.insert(i, (key, value))
+        elif op < 0.75:
+            i = rng.randint(0, n - 1)
+            real.remove_index(i)
+            shadow.pop(i)
+        else:
+            i = rng.randint(0, n - 1)
+            key = shadow[i][0]
+            value = rng.randint(0, 999)
+            real.set_value(key, value)
+            shadow[i] = (key, value)
+
+        # observable state equivalence
+        assert len(real) == len(shadow)
+        for i, (key, value) in enumerate(shadow):
+            assert real.key_of(i) == key
+            assert real.index_of(key) == i
+            assert real.get_value(key) == value
+        assert list(real) == [k for k, _ in shadow]
+        assert real.key_of(len(shadow)) is None
+        assert real.index_of("missing") == -1
+
+
+def test_copy_is_independent():
+    a = ElemList()
+    a.insert_index(0, "x", 1)
+    b = a.copy()
+    b.insert_index(1, "y", 2)
+    b.set_value("x", 99)
+    assert len(a) == 1 and a.get_value("x") == 1
+    assert len(b) == 2 and b.get_value("x") == 99
+
+
+def test_out_of_range_key_of():
+    e = ElemList()
+    assert e.key_of(0) is None
+    assert e.key_of(-1) is None

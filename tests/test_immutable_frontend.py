@@ -1,0 +1,77 @@
+"""Immutable-view frontend parity (ports /root/reference/test/immutable_test.js)."""
+
+import pytest
+
+import automerge_tpu as am
+
+
+class TestImmutableFrontend:
+    def test_init_empty(self):
+        d = am.init_immutable()
+        assert len(d) == 0
+        assert d == {}
+
+    def test_change_and_read(self):
+        d = am.init_immutable("actor")
+        d = am.change(d, lambda doc: doc.__setitem__("k", "v"))
+        assert d["k"] == "v"
+        assert d.get("missing") is None
+        assert "k" in d
+        assert list(d.keys()) == ["k"]
+
+    def test_nested_views_are_immutable(self):
+        d = am.init_immutable()
+        d = am.change(d, lambda doc: doc.__setitem__("m", {"x": [1, 2]}))
+        with pytest.raises(TypeError):
+            d["m"]["x"] = 3          # MappingProxyType rejects writes
+        assert isinstance(d["m"]["x"], tuple)
+        with pytest.raises(TypeError):
+            d.__setattr__("foo", 1)
+
+    def test_save_equality_across_frontends(self):
+        # immutable_test.js:31-34 — the frontends are interchangeable views
+        # over the same change log.
+        def edit(doc):
+            doc["title"] = "hello"
+            doc["items"] = [1, 2]
+
+        from helpers import counter_uuids
+        am.uuid.set_factory(counter_uuids("obj-"))
+        frozen = am.change(am.init("same-actor"), edit)
+        am.uuid.set_factory(counter_uuids("obj-"))
+        immut = am.change(am.init_immutable("same-actor"), edit)
+        assert am.save(frozen) == am.save(immut)
+
+    def test_merge_between_frontends(self):
+        f = am.change(am.init("A"), lambda d: d.__setitem__("a", 1))
+        i = am.change(am.init_immutable("B"), lambda d: d.__setitem__("b", 2))
+        merged = am.merge(i, f)
+        assert merged == {"a": 1, "b": 2}
+        # result keeps the immutable frontend
+        assert type(merged).__name__ == "ImmutableRoot"
+
+    def test_conflicts_surface(self):
+        f = am.change(am.init("A"), lambda d: d.__setitem__("f", "a"))
+        i = am.change(am.init_immutable("B"), lambda d: d.__setitem__("f", "b"))
+        m = am.merge(i, f)
+        assert m["f"] == "b"
+        assert dict(m._conflicts["f"]) == {"A": "a"}
+
+    def test_load_immutable(self):
+        src = am.change(am.init(), lambda d: d.__setitem__("x", [1, {"y": 2}]))
+        loaded = am.load_immutable(am.save(src))
+        assert loaded["x"][0] == 1
+        assert loaded["x"][1]["y"] == 2
+
+    def test_undo_on_immutable(self):
+        d = am.change(am.init_immutable(), lambda doc: doc.__setitem__("n", 1))
+        d = am.change(d, lambda doc: doc.__setitem__("n", 2))
+        d = am.undo(d)
+        assert d["n"] == 1
+
+    def test_text_in_immutable_doc(self):
+        def edit(doc):
+            doc["t"] = am.Text()
+            doc["t"].insert_at(0, "h", "i")
+        d = am.change(am.init_immutable(), edit)
+        assert str(d["t"]) == "hi"

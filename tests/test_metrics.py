@@ -1,0 +1,42 @@
+"""Observability counters and trace hooks."""
+
+import automerge_tpu as am
+from automerge_tpu import metrics
+
+
+def test_counters_track_applied_changes():
+    metrics.reset()
+    s = am.change(am.init(), lambda d: d.__setitem__("a", 1))
+    s = am.change(s, lambda d: am.assign(d, {"b": 2, "c": 3}))
+    snap = metrics.snapshot()
+    assert snap["changes_applied"] == 2
+    assert snap["ops_applied"] == 3
+    assert snap["diffs_emitted"] >= 3
+
+
+def test_engine_counters():
+    metrics.reset()
+    from automerge_tpu.engine.batchdoc import apply_batch
+    s = am.change(am.init("A"), lambda d: d.__setitem__("x", 1))
+    apply_batch([s._doc.opset.get_missing_changes({})])
+    snap = metrics.snapshot()
+    assert snap["engine_docs_reconciled"] == 1
+    assert snap["engine_ops_reconciled"] == 1
+    assert snap["engine_reconcile_count"] == 1
+    assert snap["engine_reconcile_s"] > 0
+
+
+def test_trace_context_manager():
+    metrics.reset()
+    with metrics.trace("custom_phase"):
+        pass
+    snap = metrics.snapshot()
+    assert snap["custom_phase_count"] == 1
+    assert "custom_phase_s" in snap
+
+
+def test_reset():
+    metrics.reset()
+    am.change(am.init(), lambda d: d.__setitem__("a", 1))
+    metrics.reset()
+    assert metrics.snapshot() == {}
