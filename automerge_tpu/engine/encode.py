@@ -23,6 +23,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 from typing import Any
+import zlib
 
 import numpy as np
 
@@ -36,6 +37,13 @@ _ACTION_CODE = {"makeMap": A_MAKE_MAP, "makeList": A_MAKE_LIST,
                 "del": A_DEL, "link": A_LINK}
 
 ASSIGN_CODES = (A_SET, A_DEL, A_LINK)
+
+
+def content_hash(text: str) -> int:
+    """Stable 31-bit content hash (crc32). Used so state hashes depend on
+    string/value *content*, not on interning-table order — required for
+    incrementally-grown resident tables to agree with canonical ones."""
+    return zlib.crc32(text.encode("utf-8")) & 0x7FFFFFFF
 
 
 def _pad_to(n: int, minimum: int = 8) -> int:
@@ -76,6 +84,9 @@ class ValueTable:
     def id_of(self, value: Any) -> int:
         return self.index[self._key(value)]
 
+    def hash_of(self, value: Any) -> int:
+        return content_hash(repr(self._key(value)))
+
 
 @dataclass
 class DocEncoding:
@@ -88,6 +99,8 @@ class DocEncoding:
     seq: np.ndarray
     change_idx: np.ndarray
     value: np.ndarray        # value table id; -1 for del / non-assign
+    fid_hash: np.ndarray     # content hash of (obj uuid, key)
+    value_hash: np.ndarray   # content hash of the value
     # per change
     clock: np.ndarray        # [max_changes, n_actors] transitive deps
     # per list object, per element slot
@@ -97,6 +110,7 @@ class DocEncoding:
     ins_parent: np.ndarray   # element slot index of parent, -1 for head
     ins_fid: np.ndarray      # fid of the element's assign field
     list_obj: np.ndarray     # [max_lists] object id or -1
+    list_obj_hash: np.ndarray  # [max_lists] content hash of the list's uuid
     # decode tables (host side)
     actors: list = None
     objects: list = None     # (object_id, type_code)
@@ -219,7 +233,10 @@ def encode_doc(changes: list[Change], actors: list[str] | None = None) -> DocEnc
     seq_arr = np.zeros(max_ops, dtype=np.int32)
     change_idx = np.zeros(max_ops, dtype=np.int32)
     value_arr = np.full(max_ops, -1, dtype=np.int32)
+    fid_hash_arr = np.zeros(max_ops, dtype=np.int32)
+    value_hash_arr = np.zeros(max_ops, dtype=np.int32)
     clock_mat = np.zeros((max_changes, n_actors), dtype=np.int32)
+    obj_uuid = {i: oid for i, (oid, _) in enumerate(objects)}
 
     i = 0
     for ci, c in enumerate(ready):
@@ -235,10 +252,13 @@ def encode_doc(changes: list[Change], actors: list[str] | None = None) -> DocEnc
             change_idx[i] = ci
             if code in ASSIGN_CODES:
                 fid[i] = fid_index[(obj_index[op.obj], op.key)]
+                fid_hash_arr[i] = content_hash(f"{op.obj}\x00{op.key}")
                 if code == A_SET:
                     value_arr[i] = values.id_of(op.value)
+                    value_hash_arr[i] = values.hash_of(op.value)
                 elif code == A_LINK:
                     value_arr[i] = values.id_of(("__link__", op.value))
+                    value_hash_arr[i] = values.hash_of(("__link__", op.value))
             i += 1
 
     # -- list tables --------------------------------------------------------
@@ -252,9 +272,11 @@ def encode_doc(changes: list[Change], actors: list[str] | None = None) -> DocEnc
     ins_parent = np.full((max_lists, max_elems), -1, dtype=np.int32)
     ins_fid = np.full((max_lists, max_elems), -1, dtype=np.int32)
     list_obj = np.full(max_lists, -1, dtype=np.int32)
+    list_obj_hash = np.full(max_lists, -1, dtype=np.int32)
 
     for li, oi in enumerate(list_objs):
         list_obj[li] = oi
+        list_obj_hash[li] = content_hash(obj_uuid[oi])
         slots = list_elems[oi]
         for (elem, arank, parent_eid, eid) in list_ins[oi]:
             slot = slots[eid]
@@ -266,9 +288,11 @@ def encode_doc(changes: list[Change], actors: list[str] | None = None) -> DocEnc
 
     return DocEncoding(
         op_mask=op_mask, action=action, fid=fid, actor=actor_arr, seq=seq_arr,
-        change_idx=change_idx, value=value_arr, clock=clock_mat,
+        change_idx=change_idx, value=value_arr, fid_hash=fid_hash_arr,
+        value_hash=value_hash_arr, clock=clock_mat,
         ins_mask=ins_mask, ins_elem=ins_elem, ins_actor=ins_actor,
         ins_parent=ins_parent, ins_fid=ins_fid, list_obj=list_obj,
+        list_obj_hash=list_obj_hash,
         actors=list(actors), objects=objects,
         fields=fields, value_table=values, n_fids=len(fields), queued=queued)
 
@@ -301,6 +325,8 @@ def stack_docs(encodings: list[DocEncoding]) -> dict[str, np.ndarray]:
         "seq": np.stack([pad1(e.seq, max_ops, 0) for e in encodings]),
         "change_idx": np.stack([pad1(e.change_idx, max_ops, 0) for e in encodings]),
         "value": np.stack([pad1(e.value, max_ops, -1) for e in encodings]),
+        "fid_hash": np.stack([pad1(e.fid_hash, max_ops, 0) for e in encodings]),
+        "value_hash": np.stack([pad1(e.value_hash, max_ops, 0) for e in encodings]),
         "clock": np.stack([pad2(e.clock, max_changes, n_actors, 0) for e in encodings]),
         "ins_mask": np.stack([pad2(e.ins_mask, max_lists, max_elems, False) for e in encodings]),
         "ins_elem": np.stack([pad2(e.ins_elem, max_lists, max_elems, 0) for e in encodings]),
@@ -308,6 +334,7 @@ def stack_docs(encodings: list[DocEncoding]) -> dict[str, np.ndarray]:
         "ins_parent": np.stack([pad2(e.ins_parent, max_lists, max_elems, -1) for e in encodings]),
         "ins_fid": np.stack([pad2(e.ins_fid, max_lists, max_elems, -1) for e in encodings]),
         "list_obj": np.stack([pad1(e.list_obj, max_lists, -1) for e in encodings]),
+        "list_obj_hash": np.stack([pad1(e.list_obj_hash, max_lists, -1) for e in encodings]),
     }
     batch["max_fids"] = max_fids
     return batch

@@ -171,21 +171,25 @@ def _mix4(a, b, c, d):
     return h
 
 
-def state_hash(candidate, fid, actor, value, fid_is_list, fid_list_obj,
-               fid_vis_rank):
+def state_hash(candidate, fid, actor, fid_hash, value_hash, fid_is_list,
+               fid_list_objhash, fid_vis_rank):
     """Canonical per-document hash of the converged state.
 
-    Map fields hash as (fid, actor, value) per surviving value-carrying op
-    (winner + conflicts = the whole field state). List/text element fields
-    hash by their resolved visible rank instead of their element identity, so
-    two replicas agree iff their visible sequences and values agree. The sum
-    is order-independent, hence delivery-order-independent.
+    Map fields hash as (field-content-hash, actor, value-content-hash) per
+    surviving value-carrying op (winner + conflicts = the whole field state).
+    List/text element fields hash by (owning-object hash, resolved visible
+    rank) instead of their element identity, so two replicas agree iff their
+    visible sequences and values agree. Content hashes (crc32 of the string/
+    value identity, computed at encode time) make the hash independent of
+    interning-table order, so incrementally-grown resident tables and
+    from-scratch canonical tables agree. The sum is order-independent, hence
+    delivery-order-independent.
     """
     safe_fid = jnp.maximum(fid, 0)
     is_list = fid_is_list[safe_fid]
-    key1 = jnp.where(is_list, fid_list_obj[safe_fid], jnp.int32(-7))
-    key2 = jnp.where(is_list, fid_vis_rank[safe_fid], safe_fid)
-    contrib = _mix4(key1, key2, actor, value)
+    key1 = jnp.where(is_list, fid_list_objhash[safe_fid], jnp.int32(-7))
+    key2 = jnp.where(is_list, fid_vis_rank[safe_fid], fid_hash)
+    contrib = _mix4(key1, key2, actor, value_hash)
     # list elements that resolved to rank -1 (tombstoned) carry no value; a
     # candidate op on an invisible element cannot happen (candidate => present
     # => visible), so no extra masking is needed beyond `candidate`.
@@ -205,7 +209,9 @@ def apply_doc(batch, max_fids: int):
     """
 
     def one_doc(op_mask, action, fid, actor, seq, change_idx, value, clock,
-                ins_mask, ins_elem, ins_actor, ins_parent, ins_fid, list_obj):
+                fid_hash, value_hash,
+                ins_mask, ins_elem, ins_actor, ins_parent, ins_fid, list_obj,
+                list_obj_hash):
         survivor, candidate, present, win_actor, win_value = field_states(
             op_mask, action, fid, actor, seq, change_idx, value, clock,
             max_fids)
@@ -219,24 +225,25 @@ def apply_doc(batch, max_fids: int):
         # fid -> (is_list, owning list object, visible rank) lookup tables.
         # Invalid entries are parked in an extra trailing slot and sliced off.
         fid_is_list = jnp.zeros(max_fids + 1, dtype=jnp.int32)
-        fid_list_obj = jnp.full(max_fids + 1, -1, dtype=jnp.int32)
+        fid_list_objhash = jnp.full(max_fids + 1, -1, dtype=jnp.int32)
         fid_vis_rank = jnp.full(max_fids + 1, -1, dtype=jnp.int32)
         flat_fid = ins_fid.reshape(-1)
         flat_valid = flat_fid >= 0
-        flat_obj = jnp.broadcast_to(list_obj[:, None], ins_fid.shape).reshape(-1)
+        flat_objhash = jnp.broadcast_to(
+            list_obj_hash[:, None], ins_fid.shape).reshape(-1)
         flat_rank = vis_rank.reshape(-1)
         upd = jnp.where(flat_valid, flat_fid, max_fids)
         fid_is_list = fid_is_list.at[upd].max(flat_valid.astype(jnp.int32))
-        fid_list_obj = fid_list_obj.at[upd].max(
-            jnp.where(flat_valid, flat_obj, -1))
+        fid_list_objhash = fid_list_objhash.at[upd].max(
+            jnp.where(flat_valid, flat_objhash, -1))
         fid_vis_rank = fid_vis_rank.at[upd].max(
             jnp.where(flat_valid, flat_rank, -1))
         fid_is_list = fid_is_list[:max_fids].astype(bool)
-        fid_list_obj = fid_list_obj[:max_fids]
+        fid_list_objhash = fid_list_objhash[:max_fids]
         fid_vis_rank = fid_vis_rank[:max_fids]
 
-        h = state_hash(candidate, fid, actor, value,
-                       fid_is_list, fid_list_obj, fid_vis_rank)
+        h = state_hash(candidate, fid, actor, fid_hash, value_hash,
+                       fid_is_list, fid_list_objhash, fid_vis_rank)
         return {
             "survivor": survivor, "candidate": candidate, "present": present,
             "win_actor": win_actor, "win_value": win_value,
@@ -247,5 +254,7 @@ def apply_doc(batch, max_fids: int):
     return jax.vmap(one_doc)(
         batch["op_mask"], batch["action"], batch["fid"], batch["actor"],
         batch["seq"], batch["change_idx"], batch["value"], batch["clock"],
+        batch["fid_hash"], batch["value_hash"],
         batch["ins_mask"], batch["ins_elem"], batch["ins_actor"],
-        batch["ins_parent"], batch["ins_fid"], batch["list_obj"])
+        batch["ins_parent"], batch["ins_fid"], batch["list_obj"],
+        batch["list_obj_hash"])

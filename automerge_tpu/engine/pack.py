@@ -22,8 +22,8 @@ import jax.numpy as jnp
 
 # field order is the wire contract
 FIELDS = ("op_mask", "action", "fid", "actor", "seq", "change_idx", "value",
-          "clock", "ins_mask", "ins_elem", "ins_actor", "ins_parent",
-          "ins_fid", "list_obj")
+          "fid_hash", "value_hash", "clock", "ins_mask", "ins_elem",
+          "ins_actor", "ins_parent", "ins_fid", "list_obj", "list_obj_hash")
 
 
 def pack_batch(batch: dict) -> tuple[np.ndarray, tuple]:

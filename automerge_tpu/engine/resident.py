@@ -1,0 +1,517 @@
+"""Device-resident incremental DocSet state.
+
+The from-scratch batch path (batchdoc.py) re-ships every document's full op
+log per reconcile. A syncing service does the opposite: state lives on the
+device and only *deltas* cross the host boundary. This module keeps the
+columnar op tables resident in device memory and applies incoming change
+batches by scattering delta rows at per-document offsets, then re-running the
+reconcile kernel over the updated tables.
+
+Key mechanics:
+- Interning tables grow in arrival order (canonical ordering cannot be kept
+  incrementally); state hashes stay canonical anyway because they mix content
+  hashes, not table ids (encode.content_hash).
+- Actor ranks MUST remain sorted by actor string (the LWW tie-break). When a
+  new actor appears, the host computes the new ranking and the device remaps
+  the resident actor columns and clock matrix with one gather
+  (`_remap_actors`). New actors are rare; the gather is cheap.
+- Capacities (ops, changes, elements, fids, actors) are padded to powers of
+  two and doubled on overflow, bounding recompilation.
+- Causality: each document keeps a host-side queue of changes whose
+  dependencies are not yet applied (the OpSet queue's analog,
+  /root/reference/src/op_set.js:254-270); duplicates are dropped
+  idempotently (op_set.js:227-232).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.change import Change
+from ..core.ids import ROOT_ID, HEAD, make_elem_id
+from .encode import (A_DEL, A_INS, A_LINK, A_MAKE_LIST, A_MAKE_MAP,
+                     A_MAKE_TEXT, A_SET, ASSIGN_CODES, _ACTION_CODE,
+                     ValueTable, content_hash, _pad_to)
+from .kernels import apply_doc
+
+OP_COLS = ("op_mask", "action", "fid", "actor", "seq", "change_idx", "value",
+           "fid_hash", "value_hash")
+
+
+class DocTables:
+    """Host-side per-document interning state, arrival-ordered."""
+
+    def __init__(self):
+        self.objects: list[tuple[str, int]] = [(ROOT_ID, A_MAKE_MAP)]
+        self.obj_index: dict[str, int] = {ROOT_ID: 0}
+        self.fields: list[tuple[int, str]] = []
+        self.fid_index: dict[tuple[int, str], int] = {}
+        self.values = ValueTable()
+        self.value_arrival: dict = {}   # key -> arrival id
+        self.value_list: list = []
+        self.list_rows: dict[int, int] = {}      # obj_idx -> list row
+        self.elem_slots: dict[int, dict[str, int]] = {}  # obj_idx -> eid -> slot
+        self.state_clocks: dict[tuple[str, int], dict[str, int]] = {}
+        self.clock: dict[str, int] = {}
+        self.seen: set[tuple[str, int]] = set()
+        self.queue: list[Change] = []
+        self.n_changes = 0
+        self.n_ops = 0
+
+    # arrival-ordered value interning (ValueTable sorts; we can't)
+    def value_id(self, value) -> int:
+        key = ValueTable._key(value)
+        if key not in self.value_arrival:
+            self.value_arrival[key] = len(self.value_list)
+            self.value_list.append(value)
+        return self.value_arrival[key]
+
+    def fid_of(self, obj_idx: int, key: str) -> int:
+        fk = (obj_idx, key)
+        if fk not in self.fid_index:
+            self.fid_index[fk] = len(self.fields)
+            self.fields.append(fk)
+        return self.fid_index[fk]
+
+
+class Delta:
+    """Delta rows for one document (plain Python lists, stacked later)."""
+
+    def __init__(self):
+        self.ops: list[tuple] = []        # rows matching OP_COLS[1:]
+        self.clocks: list[np.ndarray] = []  # rows [n_actors]
+        self.ins: list[tuple] = []        # (list_row, slot, elem, actor, parent_slot, fid)
+        self.new_lists: list[tuple] = []  # (list_row, obj_idx, obj_hash)
+
+
+class ResidentDocSet:
+    """A DocSet whose columnar state lives on the device."""
+
+    def __init__(self, doc_ids: list[str]):
+        self.doc_ids = list(doc_ids)
+        self.doc_index = {d: i for i, d in enumerate(self.doc_ids)}
+        n = len(self.doc_ids)
+        self.tables = [DocTables() for _ in range(n)]
+        self.actors: list[str] = []
+        self.actor_rank: dict[str, int] = {}
+
+        # capacities (powers of two)
+        self.cap_ops = 8
+        self.cap_changes = 8
+        self.cap_lists = 1
+        self.cap_elems = 8
+        self.cap_actors = 2
+        self.cap_fids = 8
+
+        self.op_count = np.zeros(n, dtype=np.int64)
+        self.change_count = np.zeros(n, dtype=np.int64)
+
+        self.state: dict[str, jnp.ndarray] = {}
+        self._alloc()
+        self._out = None
+
+    # ------------------------------------------------------------------
+    def _alloc(self):
+        n = len(self.doc_ids)
+        z = jnp.zeros
+        self.state = {
+            "op_mask": z((n, self.cap_ops), dtype=bool),
+            "action": jnp.full((n, self.cap_ops), -1, dtype=jnp.int32),
+            "fid": jnp.full((n, self.cap_ops), -1, dtype=jnp.int32),
+            "actor": z((n, self.cap_ops), dtype=jnp.int32),
+            "seq": z((n, self.cap_ops), dtype=jnp.int32),
+            "change_idx": z((n, self.cap_ops), dtype=jnp.int32),
+            "value": jnp.full((n, self.cap_ops), -1, dtype=jnp.int32),
+            "fid_hash": z((n, self.cap_ops), dtype=jnp.int32),
+            "value_hash": z((n, self.cap_ops), dtype=jnp.int32),
+            "clock": z((n, self.cap_changes, self.cap_actors), dtype=jnp.int32),
+            "ins_mask": z((n, self.cap_lists, self.cap_elems), dtype=bool),
+            "ins_elem": z((n, self.cap_lists, self.cap_elems), dtype=jnp.int32),
+            "ins_actor": z((n, self.cap_lists, self.cap_elems), dtype=jnp.int32),
+            "ins_parent": jnp.full((n, self.cap_lists, self.cap_elems), -1, dtype=jnp.int32),
+            "ins_fid": jnp.full((n, self.cap_lists, self.cap_elems), -1, dtype=jnp.int32),
+            "list_obj": jnp.full((n, self.cap_lists), -1, dtype=jnp.int32),
+            "list_obj_hash": jnp.full((n, self.cap_lists), -1, dtype=jnp.int32),
+        }
+
+    def _grow(self, **caps):
+        """Grow capacities; pad resident arrays in place (device-side)."""
+        old = dict(cap_ops=self.cap_ops, cap_changes=self.cap_changes,
+                   cap_lists=self.cap_lists, cap_elems=self.cap_elems,
+                   cap_actors=self.cap_actors)
+        for k, v in caps.items():
+            setattr(self, k, v)
+
+        def pad(arr, pads, fill):
+            return jnp.pad(arr, pads, constant_values=fill)
+
+        s = self.state
+        d_ops = self.cap_ops - old["cap_ops"]
+        if d_ops:
+            for col in OP_COLS:
+                fill = False if col == "op_mask" else (
+                    -1 if col in ("action", "fid", "value") else 0)
+                s[col] = pad(s[col], ((0, 0), (0, d_ops)), fill)
+        d_ch = self.cap_changes - old["cap_changes"]
+        d_ac = self.cap_actors - old["cap_actors"]
+        if d_ch or d_ac:
+            s["clock"] = pad(s["clock"], ((0, 0), (0, d_ch), (0, d_ac)), 0)
+        d_l = self.cap_lists - old["cap_lists"]
+        d_e = self.cap_elems - old["cap_elems"]
+        if d_l or d_e:
+            for col, fill in (("ins_mask", False), ("ins_elem", 0),
+                              ("ins_actor", 0), ("ins_parent", -1),
+                              ("ins_fid", -1)):
+                s[col] = pad(s[col], ((0, 0), (0, d_l), (0, d_e)), fill)
+            if d_l:
+                s["list_obj"] = pad(s["list_obj"], ((0, 0), (0, d_l)), -1)
+                s["list_obj_hash"] = pad(s["list_obj_hash"], ((0, 0), (0, d_l)), -1)
+
+    # ------------------------------------------------------------------
+    def _register_actors(self, changes_by_doc) -> None:
+        new = {c.actor for changes in changes_by_doc.values() for c in changes}
+        new -= set(self.actors)
+        if not new:
+            return
+        old_actors = list(self.actors)
+        self.actors = sorted(set(self.actors) | new)
+        self.actor_rank = {a: i for i, a in enumerate(self.actors)}
+        if len(self.actors) > self.cap_actors:
+            self._grow(cap_actors=_pad_to(len(self.actors), 2))
+        if not old_actors:
+            return
+        # remap resident actor columns + clock matrix columns
+        perm = np.array([self.actor_rank[a] for a in old_actors], dtype=np.int32)
+        inv = np.full(self.cap_actors, -1, dtype=np.int32)
+        for old_rank, new_rank in enumerate(perm):
+            inv[new_rank] = old_rank
+        self.state = _remap_actors(self.state, jnp.asarray(perm), jnp.asarray(inv))
+
+    # ------------------------------------------------------------------
+    def _encode_delta(self, doc_idx: int, changes: list[Change]) -> Delta:
+        t = self.tables[doc_idx]
+        delta = Delta()
+        # causal admission
+        pending = list(t.queue)
+        for c in changes:
+            key = (c.actor, c.seq)
+            if key in t.seen:
+                continue
+            pending.append(c)
+            t.seen.add(key)
+        ready: list[Change] = []
+        progress = True
+        while progress:
+            progress = False
+            still = []
+            for c in pending:
+                deps = dict(c.deps)
+                deps[c.actor] = c.seq - 1
+                if all(t.clock.get(a, 0) >= s for a, s in deps.items()):
+                    ready.append(c)
+                    t.clock[c.actor] = max(t.clock.get(c.actor, 0), c.seq)
+                    progress = True
+                else:
+                    still.append(c)
+            pending = still
+        t.queue = pending
+
+        n_actors = self.cap_actors
+        for c in ready:
+            # transitive clock
+            base = dict(c.deps)
+            base[c.actor] = c.seq - 1
+            full: dict[str, int] = {}
+            for a, s in base.items():
+                if s <= 0:
+                    continue
+                trans = t.state_clocks.get((a, s))
+                if trans:
+                    for a2, s2 in trans.items():
+                        if s2 > full.get(a2, 0):
+                            full[a2] = s2
+                full[a] = s
+            t.state_clocks[(c.actor, c.seq)] = full
+            row = np.zeros(n_actors, dtype=np.int32)
+            for a, s in full.items():
+                row[self.actor_rank[a]] = s
+            change_idx = t.n_changes
+            t.n_changes += 1
+            delta.clocks.append(row)
+
+            arank = self.actor_rank[c.actor]
+            for op in c.ops:
+                code = _ACTION_CODE[op.action]
+                if code in (A_MAKE_MAP, A_MAKE_LIST, A_MAKE_TEXT):
+                    if op.obj not in t.obj_index:
+                        t.obj_index[op.obj] = len(t.objects)
+                        t.objects.append((op.obj, code))
+                        if code in (A_MAKE_LIST, A_MAKE_TEXT):
+                            oi = t.obj_index[op.obj]
+                            row_i = len(t.list_rows)
+                            t.list_rows[oi] = row_i
+                            t.elem_slots[oi] = {}
+                            delta.new_lists.append(
+                                (row_i, oi, content_hash(op.obj)))
+                    fid = -1
+                    value = -1
+                    fh = vh = 0
+                elif code == A_INS:
+                    oi = t.obj_index[op.obj]
+                    eid = make_elem_id(c.actor, op.elem)
+                    slots = t.elem_slots[oi]
+                    if eid not in slots:
+                        slot = len(slots)
+                        slots[eid] = slot
+                        parent_slot = (-1 if op.key == HEAD
+                                       else slots[op.key])
+                        fid = t.fid_of(oi, eid)
+                        delta.ins.append((t.list_rows[oi], slot, op.elem,
+                                          arank, parent_slot, fid))
+                    fid = -1
+                    value = -1
+                    fh = vh = 0
+                else:  # assign
+                    oi = t.obj_index[op.obj]
+                    fid = t.fid_of(oi, op.key)
+                    fh = content_hash(f"{op.obj}\x00{op.key}")
+                    if code == A_SET:
+                        value = t.value_id(op.value)
+                        vh = content_hash(repr(ValueTable._key(op.value)))
+                    elif code == A_LINK:
+                        value = t.value_id(("__link__", op.value))
+                        vh = content_hash(repr(ValueTable._key(("__link__", op.value))))
+                    else:
+                        value = -1
+                        vh = 0
+                delta.ops.append((code, fid, arank, c.seq, change_idx,
+                                  value, fh, vh))
+                t.n_ops += 1
+        return delta
+
+    # ------------------------------------------------------------------
+    def apply_changes(self, changes_by_doc: dict[str, list[Change]]) -> None:
+        """Encode + scatter a delta batch into resident state."""
+        self._register_actors(changes_by_doc)
+        flat, meta = self._build_delta_arrays(changes_by_doc)
+        self.state = _scatter_delta(self.state, flat, meta)
+        self._out = None
+
+    def _build_delta_arrays(self, changes_by_doc: dict[str, list[Change]]):
+        n = len(self.doc_ids)
+        deltas = [Delta() for _ in range(n)]
+        for doc_id, changes in changes_by_doc.items():
+            i = self.doc_index[doc_id]
+            deltas[i] = self._encode_delta(i, changes)
+
+        # capacity checks
+        need_ops = int(max((self.op_count[i] + len(d.ops)
+                            for i, d in enumerate(deltas)), default=0))
+        need_ch = int(max((self.change_count[i] + len(d.clocks)
+                           for i, d in enumerate(deltas)), default=0))
+        need_lists = max((len(t.list_rows) for t in self.tables), default=0)
+        need_elems = max((len(s) for t in self.tables
+                          for s in t.elem_slots.values()), default=0)
+        need_fids = max((len(t.fields) for t in self.tables), default=0)
+        grow = {}
+        if need_ops > self.cap_ops:
+            grow["cap_ops"] = _pad_to(need_ops)
+        if need_ch > self.cap_changes:
+            grow["cap_changes"] = _pad_to(need_ch)
+        if need_lists > self.cap_lists:
+            grow["cap_lists"] = _pad_to(need_lists, 1)
+        if need_elems > self.cap_elems:
+            grow["cap_elems"] = _pad_to(need_elems)
+        if grow:
+            self._grow(**grow)
+        if need_fids > self.cap_fids:
+            self.cap_fids = _pad_to(need_fids)
+
+        # stack delta arrays
+        max_d_ops = _pad_to(max((len(d.ops) for d in deltas), default=1), 1)
+        max_d_ch = _pad_to(max((len(d.clocks) for d in deltas), default=1), 1)
+        max_d_ins = _pad_to(max((len(d.ins) for d in deltas), default=1), 1)
+        max_d_nl = _pad_to(max((len(d.new_lists) for d in deltas), default=1), 1)
+
+        d_ops = np.zeros((n, max_d_ops, 8), dtype=np.int32)
+        d_ops_n = np.zeros(n, dtype=np.int32)
+        d_clock = np.zeros((n, max_d_ch, self.cap_actors), dtype=np.int32)
+        d_ch_n = np.zeros(n, dtype=np.int32)
+        d_ins = np.zeros((n, max_d_ins, 6), dtype=np.int32)
+        d_ins_n = np.zeros(n, dtype=np.int32)
+        d_nl = np.zeros((n, max_d_nl, 3), dtype=np.int32)
+        d_nl_n = np.zeros(n, dtype=np.int32)
+        offsets_ops = self.op_count.astype(np.int32)
+        offsets_ch = self.change_count.astype(np.int32)
+
+        for i, d in enumerate(deltas):
+            if d.ops:
+                d_ops[i, :len(d.ops)] = np.array(d.ops, dtype=np.int32)
+                d_ops_n[i] = len(d.ops)
+            if d.clocks:
+                d_clock[i, :len(d.clocks)] = np.stack(d.clocks)
+                d_ch_n[i] = len(d.clocks)
+            if d.ins:
+                d_ins[i, :len(d.ins)] = np.array(d.ins, dtype=np.int32)
+                d_ins_n[i] = len(d.ins)
+            if d.new_lists:
+                d_nl[i, :len(d.new_lists)] = np.array(d.new_lists, dtype=np.int32)
+                d_nl_n[i] = len(d.new_lists)
+            self.op_count[i] += len(d.ops)
+            self.change_count[i] += len(d.clocks)
+
+        # One flat transfer: the tunnel charges ~10ms per host->device call,
+        # so the ten delta arrays ship as a single packed buffer.
+        parts = [d_ops, d_ops_n, offsets_ops.astype(np.int32),
+                 d_clock, d_ch_n, offsets_ch.astype(np.int32),
+                 d_ins, d_ins_n, d_nl, d_nl_n]
+        meta = tuple((p.shape, int(np.prod(p.shape))) for p in parts)
+        flat = np.concatenate([p.astype(np.int32).ravel() for p in parts])
+        return jnp.asarray(flat), meta
+
+    # ------------------------------------------------------------------
+    def apply_and_reconcile(self, changes_by_doc: dict[str, list[Change]]):
+        """Fused delta apply + reconcile: one device dispatch for the whole
+        round (scatter, survivor analysis, linearization, hashing), one
+        readback for the hashes. This is the hot path of a resident sync
+        service — per-round cost is a single host<->device roundtrip plus
+        the delta bytes."""
+        self._register_actors(changes_by_doc)
+        flat, meta = self._build_delta_arrays(changes_by_doc)
+        self.state, out = _scatter_and_apply(self.state, flat, meta,
+                                             max_fids=self.cap_fids)
+        self._out = out
+        return np.asarray(out["hash"])
+
+    def reconcile(self):
+        """Run the reconcile kernel over resident state; returns per-doc
+        uint32 hashes (numpy, aligned with doc_ids)."""
+        self._out = apply_doc(self.state, self.cap_fids)
+        return np.asarray(self._out["hash"])
+
+    def materialize(self, doc_id: str) -> Any:
+        """Decode one document from resident state + reconcile outputs."""
+        if self._out is None:
+            self.reconcile()
+        i = self.doc_index[doc_id]
+        t = self.tables[i]
+        out = {k: np.asarray(v)[i] for k, v in self._out.items()}
+        host = {k: np.asarray(v)[i] for k, v in self.state.items()}
+
+        from .batchdoc import decode_doc
+
+        class _Enc:  # adapter with the DocEncoding fields decode_doc uses
+            pass
+
+        enc = _Enc()
+        enc.fid = host["fid"]
+        enc.actor = host["actor"]
+        enc.value = host["value"]
+        enc.actors = self.actors
+        enc.objects = t.objects
+        enc.fields = t.fields
+        enc.ins_fid = host["ins_fid"]
+        enc.list_obj = host["list_obj"]
+
+        class _VT:
+            def __init__(self, values):
+                self.values = values
+        enc.value_table = _VT(t.value_list)
+        return decode_doc(enc, out)
+
+
+# ---------------------------------------------------------------------------
+# jitted state-update kernels
+
+@jax.jit
+def _remap_actors(state, perm, inv):
+    """Renumber actor ranks after a new actor joins: op/ins actor columns map
+    through `perm` (old->new); clock columns gather through `inv` (new->old,
+    -1 where no old column existed)."""
+    out = dict(state)
+    amask = state["op_mask"]
+    out["actor"] = jnp.where(amask, perm[jnp.clip(state["actor"], 0, perm.shape[0] - 1)],
+                             state["actor"])
+    imask = state["ins_mask"]
+    out["ins_actor"] = jnp.where(
+        imask, perm[jnp.clip(state["ins_actor"], 0, perm.shape[0] - 1)],
+        state["ins_actor"])
+    clock = state["clock"]
+    n_new = inv.shape[0]
+    safe = jnp.clip(inv, 0, clock.shape[-1] - 1)
+    gathered = clock[..., safe]
+    out["clock"] = jnp.where(inv[None, None, :n_new] >= 0,
+                             gathered[..., :n_new], 0)
+    return out
+
+
+def _unpack_delta(flat, meta):
+    parts = []
+    offset = 0
+    for shape, size in meta:
+        parts.append(jax.lax.slice(flat, (offset,), (offset + size,))
+                     .reshape(shape))
+        offset += size
+    return parts
+
+
+@partial(jax.jit, static_argnames=("meta",))
+def _scatter_delta(state, flat, meta):
+    (d_ops, d_ops_n, off_ops, d_clock, d_ch_n, off_ch,
+     d_ins, d_ins_n, d_nl, d_nl_n) = _unpack_delta(flat, meta)
+    out = dict(state)
+    n, max_d, _ = d_ops.shape
+    docs = jnp.arange(n)[:, None]
+
+    # op rows
+    j = jnp.arange(max_d)[None, :]
+    valid = j < d_ops_n[:, None]
+    pos = jnp.where(valid, off_ops[:, None] + j, state["op_mask"].shape[1])
+    cols = {"action": 0, "fid": 1, "actor": 2, "seq": 3, "change_idx": 4,
+            "value": 5, "fid_hash": 6, "value_hash": 7}
+    for name, ci in cols.items():
+        out[name] = out[name].at[docs, pos].set(d_ops[:, :, ci], mode="drop")
+    out["op_mask"] = out["op_mask"].at[docs, pos].set(valid, mode="drop")
+
+    # clock rows
+    _, max_c, _ = d_clock.shape
+    jc = jnp.arange(max_c)[None, :]
+    validc = jc < d_ch_n[:, None]
+    posc = jnp.where(validc, off_ch[:, None] + jc, state["clock"].shape[1])
+    out["clock"] = out["clock"].at[docs, posc].set(d_clock, mode="drop")
+
+    # ins rows (explicit (list_row, slot) indices)
+    _, max_i, _ = d_ins.shape
+    ji = jnp.arange(max_i)[None, :]
+    validi = ji < d_ins_n[:, None]
+    li = jnp.where(validi, d_ins[:, :, 0], state["ins_mask"].shape[1])
+    si = jnp.where(validi, d_ins[:, :, 1], state["ins_mask"].shape[2])
+    out["ins_elem"] = out["ins_elem"].at[docs, li, si].set(d_ins[:, :, 2], mode="drop")
+    out["ins_actor"] = out["ins_actor"].at[docs, li, si].set(d_ins[:, :, 3], mode="drop")
+    out["ins_parent"] = out["ins_parent"].at[docs, li, si].set(d_ins[:, :, 4], mode="drop")
+    out["ins_fid"] = out["ins_fid"].at[docs, li, si].set(d_ins[:, :, 5], mode="drop")
+    out["ins_mask"] = out["ins_mask"].at[docs, li, si].set(validi, mode="drop")
+
+    # new list rows
+    _, max_l, _ = d_nl.shape
+    jl = jnp.arange(max_l)[None, :]
+    validl = jl < d_nl_n[:, None]
+    lrow = jnp.where(validl, d_nl[:, :, 0], state["list_obj"].shape[1])
+    out["list_obj"] = out["list_obj"].at[docs, lrow].set(d_nl[:, :, 1], mode="drop")
+    out["list_obj_hash"] = out["list_obj_hash"].at[docs, lrow].set(d_nl[:, :, 2], mode="drop")
+    return out
+
+
+@partial(jax.jit, static_argnames=("meta", "max_fids"), donate_argnums=(0,))
+def _scatter_and_apply(state, flat, meta, *, max_fids):
+    """Fused delta scatter + full reconcile in one device dispatch. The old
+    state buffers are donated (updated in place where XLA can)."""
+    new_state = _scatter_delta.__wrapped__(state, flat, meta)
+    out = apply_doc.__wrapped__(new_state, max_fids)
+    return new_state, out

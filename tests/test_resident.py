@@ -1,0 +1,139 @@
+"""Device-resident incremental DocSet: delta application parity.
+
+The resident path must converge to exactly the same state (and the same
+canonical content hash) as the from-scratch batch path and the Python oracle,
+including across incremental rounds, new actors appearing mid-stream, list
+edits, and causal buffering of out-of-order deliveries.
+"""
+
+import numpy as np
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu.engine.batchdoc import apply_batch, oracle_state
+from automerge_tpu.engine.resident import ResidentDocSet
+from automerge_tpu.frontend.materialize import apply_changes_to_doc
+
+
+def from_scratch_hash(changes):
+    _, _, out = apply_batch([changes])
+    return int(np.asarray(out["hash"])[0])
+
+
+def oracle_of(changes):
+    doc = am.init("oracle")
+    return oracle_state(apply_changes_to_doc(doc, doc._doc.opset, changes,
+                                             incremental=False))
+
+
+class TestResidentParity:
+    def test_single_round_matches_batch(self):
+        s1 = am.change(am.init("A"), lambda d: am.assign(d, {"x": 1, "y": "two"}))
+        s2 = am.change(am.init("B"), lambda d: d.__setitem__("x", 9))
+        m = am.merge(s1, s2)
+        changes = m._doc.opset.get_missing_changes({})
+
+        r = ResidentDocSet(["doc"])
+        r.apply_changes({"doc": changes})
+        assert r.materialize("doc") == oracle_of(changes)
+        assert int(r.reconcile()[0]) == from_scratch_hash(changes)
+
+    def test_incremental_rounds(self):
+        doc = am.change(am.init("A"), lambda d: d.__setitem__("n", 0))
+        r = ResidentDocSet(["doc"])
+        r.apply_changes({"doc": doc._doc.opset.get_missing_changes({})})
+        applied = []
+        for i in range(5):
+            new = am.change(doc, lambda d, i=i: am.assign(
+                d, {"n": i + 1, f"k{i}": i}))
+            delta = new._doc.opset.get_missing_changes(
+                doc._doc.opset.clock)
+            doc = new
+            applied.extend(delta)
+            r.apply_changes({"doc": delta})
+            all_changes = doc._doc.opset.get_missing_changes({})
+            assert r.materialize("doc") == oracle_of(all_changes)
+            assert int(r.reconcile()[0]) == from_scratch_hash(all_changes)
+
+    def test_new_actor_mid_stream_remaps_ranks(self):
+        # actor "M" joins after "Z": sorted ranks must shift so LWW still
+        # breaks ties by string order
+        s_z = am.change(am.init("Z"), lambda d: d.__setitem__("f", "from Z"))
+        r = ResidentDocSet(["doc"])
+        r.apply_changes({"doc": s_z._doc.opset.get_missing_changes({})})
+
+        s_m = am.change(am.init("M"), lambda d: d.__setitem__("f", "from M"))
+        r.apply_changes({"doc": s_m._doc.opset.get_missing_changes({})})
+
+        merged = am.merge(am.merge(am.init("x"), s_z), s_m)
+        all_changes = merged._doc.opset.get_missing_changes({})
+        state = r.materialize("doc")
+        assert state["data"]["f"] == "from Z"  # Z > M wins
+        assert state == oracle_of(all_changes)
+        assert int(r.reconcile()[0]) == from_scratch_hash(all_changes)
+
+    def test_list_edits_across_rounds(self):
+        doc = am.change(am.init("A"), lambda d: d.__setitem__("xs", ["a", "b"]))
+        r = ResidentDocSet(["doc"])
+        r.apply_changes({"doc": doc._doc.opset.get_missing_changes({})})
+
+        prev = doc
+        doc = am.change(doc, lambda d: d["xs"].insert_at(1, "mid"))
+        doc = am.change(doc, lambda d: d["xs"].delete_at(0))
+        delta = doc._doc.opset.get_missing_changes(prev._doc.opset.clock)
+        r.apply_changes({"doc": delta})
+
+        all_changes = doc._doc.opset.get_missing_changes({})
+        assert r.materialize("doc") == oracle_of(all_changes)
+        assert r.materialize("doc")["data"]["xs"] == ["mid", "b"]
+        assert int(r.reconcile()[0]) == from_scratch_hash(all_changes)
+
+    def test_out_of_order_delivery_buffers(self):
+        s = am.change(am.init("A"), lambda d: d.__setitem__("a", 1))
+        s = am.change(s, lambda d: d.__setitem__("b", 2))
+        c1, c2 = s._doc.opset.get_missing_changes({})
+        r = ResidentDocSet(["doc"])
+        r.apply_changes({"doc": [c2]})  # dependency missing: buffered
+        assert r.materialize("doc")["data"] == {}
+        r.apply_changes({"doc": [c1]})  # both become visible
+        assert r.materialize("doc")["data"] == {"a": 1, "b": 2}
+
+    def test_duplicate_delivery_idempotent(self):
+        s = am.change(am.init("A"), lambda d: d.__setitem__("a", 1))
+        changes = s._doc.opset.get_missing_changes({})
+        r = ResidentDocSet(["doc"])
+        r.apply_changes({"doc": changes})
+        h1 = int(r.reconcile()[0])
+        r.apply_changes({"doc": changes})
+        assert int(r.reconcile()[0]) == h1
+
+    def test_many_docs_capacity_growth(self):
+        docs = {}
+        r = ResidentDocSet([f"d{i}" for i in range(16)])
+        for i in range(16):
+            s = am.change(am.init(f"a{i:02d}"),
+                          lambda d, i=i: am.assign(d, {"n": i, "xs": [i] * (i + 1)}))
+            docs[f"d{i}"] = s
+        r.apply_changes({k: v._doc.opset.get_missing_changes({})
+                         for k, v in docs.items()})
+        for i in (0, 7, 15):
+            all_changes = docs[f"d{i}"]._doc.opset.get_missing_changes({})
+            assert r.materialize(f"d{i}") == oracle_of(all_changes)
+
+    def test_hash_matches_across_replica_delivery_orders(self):
+        s1 = am.change(am.init("A"), lambda d: d.__setitem__("xs", ["a"]))
+        s2 = am.merge(am.init("B"), s1)
+        s1 = am.change(s1, lambda d: d["xs"].append("b"))
+        s2 = am.change(s2, lambda d: d["xs"].insert_at(0, "z"))
+        m1 = am.merge(s1, s2)
+        m2 = am.merge(s2, s1)
+        ch1 = m1._doc.opset.get_missing_changes({})
+        ch2 = m2._doc.opset.get_missing_changes({})
+
+        ra = ResidentDocSet(["d"])
+        # replica A receives its own changes first, then B's
+        ra.apply_changes({"d": ch1[:len(ch1) // 2]})
+        ra.apply_changes({"d": ch1[len(ch1) // 2:]})
+        rb = ResidentDocSet(["d"])
+        rb.apply_changes({"d": ch2})
+        assert int(ra.reconcile()[0]) == int(rb.reconcile()[0])
