@@ -45,3 +45,7 @@ __all__ = [
     "Change", "Op", "ROOT_ID", "Text", "Connection", "DocSet",
     "WatchableDoc", "uuid", "metrics", "__version__",
 ]
+
+from .storage import save_binary, load_binary, changes_from_binary  # noqa: E402
+
+__all__ += ["save_binary", "load_binary", "changes_from_binary"]

@@ -39,11 +39,22 @@ _ACTION_CODE = {"makeMap": A_MAKE_MAP, "makeList": A_MAKE_LIST,
 ASSIGN_CODES = (A_SET, A_DEL, A_LINK)
 
 
+_hash_memo: dict[str, int] = {}
+
+
 def content_hash(text: str) -> int:
-    """Stable 31-bit content hash (crc32). Used so state hashes depend on
-    string/value *content*, not on interning-table order — required for
-    incrementally-grown resident tables to agree with canonical ones."""
-    return zlib.crc32(text.encode("utf-8")) & 0x7FFFFFFF
+    """Stable 31-bit content hash (crc32), memoized — the same actor/key/
+    value strings recur across documents in a batch. Used so state hashes
+    depend on string/value *content*, not on interning-table order — required
+    for incrementally-grown resident tables to agree with canonical ones."""
+    h = _hash_memo.get(text)
+    if h is None:
+        h = zlib.crc32(text.encode("utf-8")) & 0x7FFFFFFF
+        if len(_hash_memo) < 1_000_000:
+            _hash_memo[text] = h
+        else:
+            return h
+    return h
 
 
 def _pad_to(n: int, minimum: int = 8) -> int:
@@ -80,12 +91,14 @@ class ValueTable:
         self.keys = [self.keys[i] for i in order]
         self.values = [self.values[i] for i in order]
         self.index = {k: i for i, k in enumerate(self.keys)}
+        self.hashes = [content_hash(repr(k)) for k in self.keys]
 
     def id_of(self, value: Any) -> int:
         return self.index[self._key(value)]
 
-    def hash_of(self, value: Any) -> int:
-        return content_hash(repr(self._key(value)))
+    def id_and_hash(self, value: Any) -> tuple[int, int]:
+        i = self.index[self._key(value)]
+        return i, self.hashes[i]
 
 
 @dataclass
@@ -219,6 +232,9 @@ def encode_doc(changes: list[Change], actors: list[str] | None = None) -> DocEnc
                 field_keys.add((obj_index[op.obj], op.key))
     fields = sorted(field_keys)
     fid_index = {fk: i for i, fk in enumerate(fields)}
+    obj_uuids = [oid for oid, _ in objects]
+    fid_hashes = [content_hash(f"{obj_uuids[oi]}\x00{key}")
+                  for oi, key in fields]
 
     # -- op table -----------------------------------------------------------
     n_ops = sum(len(c.ops) for c in ready)
@@ -251,14 +267,14 @@ def encode_doc(changes: list[Change], actors: list[str] | None = None) -> DocEnc
             seq_arr[i] = c.seq
             change_idx[i] = ci
             if code in ASSIGN_CODES:
-                fid[i] = fid_index[(obj_index[op.obj], op.key)]
-                fid_hash_arr[i] = content_hash(f"{op.obj}\x00{op.key}")
+                f = fid_index[(obj_index[op.obj], op.key)]
+                fid[i] = f
+                fid_hash_arr[i] = fid_hashes[f]
                 if code == A_SET:
-                    value_arr[i] = values.id_of(op.value)
-                    value_hash_arr[i] = values.hash_of(op.value)
+                    value_arr[i], value_hash_arr[i] = values.id_and_hash(op.value)
                 elif code == A_LINK:
-                    value_arr[i] = values.id_of(("__link__", op.value))
-                    value_hash_arr[i] = values.hash_of(("__link__", op.value))
+                    value_arr[i], value_hash_arr[i] = values.id_and_hash(
+                        ("__link__", op.value))
             i += 1
 
     # -- list tables --------------------------------------------------------
