@@ -358,3 +358,18 @@ def get_clock(doc) -> dict[str, int]:
 def get_actor_id(doc) -> str:
     _check_target("get_actor_id", doc)
     return doc._doc.actor_id
+
+
+def changes_from_json(data: str | bytes) -> list[Change]:
+    """Parse a JSON array of changes (the sync wire format). Uses the native
+    C++ wire codec when available, falling back to the pure-Python path."""
+    try:
+        from .native.wire import parse_changes_json
+        cols = parse_changes_json(data)
+        if cols is not None:
+            return cols.to_changes()
+    except ImportError:
+        pass
+    if isinstance(data, bytes):
+        data = data.decode("utf-8")
+    return [coerce_change(c) for c in json.loads(data)]

@@ -1,0 +1,90 @@
+"""Native runtime components (C++, bound via ctypes).
+
+The wire codec parses the JSON change wire straight into columnar integer
+arrays (the engine's native input), skipping per-op Python object
+construction — the measured host-side bottleneck of wire ingestion.
+
+The shared library is built on demand with g++ into this package's _build/
+directory; if no toolchain is available the callers fall back to the pure-
+Python path transparently (`wire.parse_changes_json` returns None).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_HERE, "_build")
+_SRC = os.path.join(_HERE, "wirecodec.cpp")
+_LIB = os.path.join(_BUILD_DIR, "libamtpuwire.so")
+
+_lock = threading.Lock()
+_lib = None
+_lib_error: str | None = None
+
+
+def _build() -> str | None:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    # Compile to a process-unique temp path and rename into place: another
+    # process may be loading (or also building) the library concurrently, and
+    # rename is atomic while g++'s output writing is not.
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        return f"toolchain unavailable: {exc}"
+    if proc.returncode != 0:
+        return f"compile failed: {proc.stderr[:500]}"
+    try:
+        os.replace(tmp, _LIB)
+    except OSError as exc:
+        return f"install failed: {exc}"
+    return None
+
+
+def get_lib():
+    """Load (building if needed) the native library, or None."""
+    global _lib, _lib_error
+    with _lock:
+        if _lib is not None or _lib_error is not None:
+            return _lib
+        if not os.path.exists(_LIB) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)):
+            err = _build()
+            if err is not None:
+                _lib_error = err
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError as exc:
+            _lib_error = str(exc)
+            return None
+
+        lib.amtpu_parse_changes.restype = ctypes.c_void_p
+        lib.amtpu_parse_changes.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_int64]
+        lib.amtpu_free.argtypes = [ctypes.c_void_p]
+        lib.amtpu_sizes.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_int64)]
+        lib.amtpu_copy_columns.argtypes = [ctypes.c_void_p] + \
+            [ctypes.c_void_p] * 15
+        lib.amtpu_copy_table.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                         ctypes.c_char_p,
+                                         ctypes.POINTER(ctypes.c_int32)]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+def native_error() -> str | None:
+    get_lib()
+    return _lib_error

@@ -1,0 +1,173 @@
+"""Python surface of the native wire codec."""
+
+from __future__ import annotations
+
+import ctypes
+from dataclasses import dataclass
+
+import numpy as np
+
+from . import get_lib
+
+V_NONE, V_NULL, V_FALSE, V_TRUE, V_INT, V_DOUBLE, V_STR, V_BIGINT = range(8)
+
+
+@dataclass
+class WireColumns:
+    """Columnar decode of a JSON change list (one contiguous parse)."""
+    change_actor: np.ndarray
+    change_seq: np.ndarray
+    change_msg: np.ndarray
+    deps_off: np.ndarray
+    deps_actor: np.ndarray
+    deps_seq: np.ndarray
+    op_off: np.ndarray
+    op_action: np.ndarray
+    op_obj: np.ndarray
+    op_key: np.ndarray
+    op_elem: np.ndarray
+    op_vtag: np.ndarray
+    op_vint: np.ndarray
+    op_vdbl: np.ndarray
+    op_vstr: np.ndarray
+    actors: list[str]
+    objects: list[str]
+    keys: list[str]
+    messages: list[str]
+    strings: list[str]
+
+    @property
+    def n_changes(self) -> int:
+        return len(self.change_actor)
+
+    def op_value(self, j: int):
+        """Decode op j's scalar value (None for absent/null)."""
+        tag = self.op_vtag[j]
+        if tag in (V_NONE, V_NULL):
+            return None
+        if tag == V_TRUE:
+            return True
+        if tag == V_FALSE:
+            return False
+        if tag == V_INT:
+            return int(self.op_vint[j])
+        if tag == V_DOUBLE:
+            return float(self.op_vdbl[j])
+        if tag == V_BIGINT:
+            # integer token outside int64 range, carried verbatim
+            return int(self.strings[self.op_vstr[j]])
+        return self.strings[self.op_vstr[j]]
+
+    def to_changes(self):
+        """Materialize Change objects from the columns. (A column-direct
+        engine ingest path that skips Change construction entirely is the
+        identified next optimization — see INTERNALS.md "Performance
+        notes"; today the engine consumes Change objects.)"""
+        from ..core.change import Change, Op
+        from ..storage import _ACTIONS
+        out = []
+        for i in range(self.n_changes):
+            deps = {self.actors[a]: int(s) for a, s in zip(
+                self.deps_actor[self.deps_off[i]:self.deps_off[i + 1]],
+                self.deps_seq[self.deps_off[i]:self.deps_off[i + 1]])}
+            ops = []
+            for j in range(int(self.op_off[i]), int(self.op_off[i + 1])):
+                action = _ACTIONS[self.op_action[j]]
+                key = self.keys[self.op_key[j]] if self.op_key[j] >= 0 else None
+                elem = int(self.op_elem[j]) if self.op_elem[j] >= 0 else None
+                if action in ("set", "link"):
+                    value = self.op_value(j)
+                else:
+                    value = None
+                ops.append(Op(action, self.objects[self.op_obj[j]],
+                              key=key, value=value, elem=elem))
+            msg = (self.messages[self.change_msg[i]]
+                   if self.change_msg[i] >= 0 else None)
+            out.append(Change(self.actors[self.change_actor[i]],
+                              int(self.change_seq[i]), deps, ops, msg))
+        return out
+
+
+def _table(lib, handle, which: int, n_items: int, blob_len: int) -> list[str]:
+    blob = ctypes.create_string_buffer(max(blob_len, 1))
+    offsets = (ctypes.c_int32 * (n_items + 1))()
+    lib.amtpu_copy_table(handle, which, blob, offsets)
+    raw = blob.raw[:blob_len]  # offsets are BYTE offsets: slice before decode
+    # surrogatepass: json.dumps happily emits lone \ud800 escapes, which the
+    # C++ side encodes as WTF-8; round-trip them like json.loads would.
+    return [raw[offsets[i]:offsets[i + 1]].decode("utf-8", "surrogatepass")
+            for i in range(n_items)]
+
+
+def parse_changes_json(data: bytes | str) -> WireColumns | None:
+    """Parse a JSON change array with the native codec; None if the native
+    library is unavailable. Raises ValueError on malformed input."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    errbuf = ctypes.create_string_buffer(512)
+    handle = lib.amtpu_parse_changes(data, len(data), errbuf, len(errbuf))
+    if not handle:
+        raise ValueError(f"wire parse error: {errbuf.value.decode()}")
+    try:
+        sizes = (ctypes.c_int64 * 13)()
+        lib.amtpu_sizes(handle, sizes)
+        (n_changes, n_ops, n_deps, n_actors, n_objects, n_keys, n_messages,
+         n_strings, b_actors, b_objects, b_keys, b_messages, b_strings) = sizes
+
+        def arr(n, dtype):
+            return np.zeros(max(n, 1), dtype=dtype)
+
+        cols = WireColumns(
+            change_actor=arr(n_changes, np.int32),
+            change_seq=arr(n_changes, np.int32),
+            change_msg=arr(n_changes, np.int32),
+            deps_off=arr(n_changes + 1, np.int32),
+            deps_actor=arr(n_deps, np.int32),
+            deps_seq=arr(n_deps, np.int32),
+            op_off=arr(n_changes + 1, np.int32),
+            op_action=arr(n_ops, np.int8),
+            op_obj=arr(n_ops, np.int32),
+            op_key=arr(n_ops, np.int32),
+            op_elem=arr(n_ops, np.int32),
+            op_vtag=arr(n_ops, np.int8),
+            op_vint=arr(n_ops, np.int64),
+            op_vdbl=arr(n_ops, np.float64),
+            op_vstr=arr(n_ops, np.int32),
+            actors=_table(lib, handle, 0, n_actors, b_actors),
+            objects=_table(lib, handle, 1, n_objects, b_objects),
+            keys=_table(lib, handle, 2, n_keys, b_keys),
+            messages=_table(lib, handle, 3, n_messages, b_messages),
+            strings=_table(lib, handle, 4, n_strings, b_strings),
+        )
+
+        def ptr(a):
+            return a.ctypes.data_as(ctypes.c_void_p)
+
+        lib.amtpu_copy_columns(
+            handle, ptr(cols.change_actor), ptr(cols.change_seq),
+            ptr(cols.change_msg), ptr(cols.deps_off), ptr(cols.deps_actor),
+            ptr(cols.deps_seq), ptr(cols.op_off), ptr(cols.op_action),
+            ptr(cols.op_obj), ptr(cols.op_key), ptr(cols.op_elem),
+            ptr(cols.op_vtag), ptr(cols.op_vint), ptr(cols.op_vdbl),
+            ptr(cols.op_vstr))
+
+        # trim the max(n,1) padding back to true sizes
+        cols.change_actor = cols.change_actor[:n_changes]
+        cols.change_seq = cols.change_seq[:n_changes]
+        cols.change_msg = cols.change_msg[:n_changes]
+        cols.deps_actor = cols.deps_actor[:n_deps]
+        cols.deps_seq = cols.deps_seq[:n_deps]
+        cols.op_action = cols.op_action[:n_ops]
+        cols.op_obj = cols.op_obj[:n_ops]
+        cols.op_key = cols.op_key[:n_ops]
+        cols.op_elem = cols.op_elem[:n_ops]
+        cols.op_vtag = cols.op_vtag[:n_ops]
+        cols.op_vint = cols.op_vint[:n_ops]
+        cols.op_vdbl = cols.op_vdbl[:n_ops]
+        cols.op_vstr = cols.op_vstr[:n_ops]
+        return cols
+    finally:
+        lib.amtpu_free(handle)

@@ -1,0 +1,120 @@
+"""Native C++ wire codec: parity with the pure-Python wire path."""
+
+import json
+
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu.core.change import coerce_change
+
+native = pytest.importorskip("automerge_tpu.native")
+if not native.native_available():
+    pytest.skip(f"native codec unavailable: {native.native_error()}",
+                allow_module_level=True)
+
+from automerge_tpu.native.wire import parse_changes_json  # noqa: E402
+
+
+def wire_of(doc):
+    return json.dumps(am.get_changes(am.init(), doc))
+
+
+def assert_wire_parity(doc):
+    wire = wire_of(doc)
+    native_changes = parse_changes_json(wire).to_changes()
+    py_changes = [coerce_change(c) for c in json.loads(wire)]
+    assert native_changes == py_changes
+
+
+class TestNativeWireCodec:
+    def test_scalars(self):
+        s = am.change(am.init("a"), lambda d: am.assign(d, {
+            "s": "str", "i": 42, "neg": -17, "f": 3.25, "t": True,
+            "fl": False, "n": None, "zero": 0, "big": 2**40}))
+        assert_wire_parity(s)
+
+    def test_unicode_and_escapes(self):
+        s = am.change(am.init("actor-ü"), 'msg "q" \\ ☃',
+                      lambda d: d.__setitem__("k", "héllo\n\t☃ \"x\" 𝄞"))
+        assert_wire_parity(s)
+
+    def test_nested_structures(self):
+        s = am.change(am.init("a"), lambda d: d.__setitem__(
+            "board", {"cards": [{"t": "one"}, "plain", 7]}))
+        assert_wire_parity(s)
+
+    def test_text_ops(self):
+        def edit(doc):
+            doc["t"] = am.Text()
+            doc["t"].insert_at(0, *"hey")
+        s = am.change(am.init("a"), edit)
+        s = am.change(s, lambda d: d["t"].delete_at(1))
+        assert_wire_parity(s)
+
+    def test_multi_actor_deps(self):
+        s1 = am.change(am.init("A"), lambda d: d.__setitem__("a", 1))
+        s2 = am.merge(am.init("B"), s1)
+        s2 = am.change(s2, lambda d: d.__setitem__("b", 2))
+        s1 = am.merge(s1, s2)
+        s1 = am.change(s1, lambda d: d.__setitem__("c", 3))
+        assert_wire_parity(s1)
+
+    def test_malformed_raises(self):
+        with pytest.raises(ValueError):
+            parse_changes_json('[{"actor": "a", "seq": }]')
+        with pytest.raises(ValueError):
+            parse_changes_json('{"not": "an array"}')
+        with pytest.raises(ValueError):
+            parse_changes_json('[{"actor": "a"}]')  # missing seq/ops
+
+    def test_public_changes_from_json(self):
+        s = am.change(am.init("a"), lambda d: d.__setitem__("x", 1))
+        wire = wire_of(s)
+        changes = am.changes_from_json(wire)
+        target = am.apply_changes(am.init(), changes)
+        assert target == {"x": 1}
+
+    def test_round_trip_through_document(self):
+        s = am.change(am.init("A"), lambda d: am.assign(d, {
+            "xs": [1, 2, 3], "meta": {"deep": {"er": "value"}}}))
+        s = am.change(s, lambda d: d["xs"].delete_at(1))
+        changes = parse_changes_json(wire_of(s)).to_changes()
+        rebuilt = am.apply_changes(am.init(), changes)
+        assert am.equals(rebuilt, s)
+
+
+class TestReviewRegressions:
+    def test_large_seq_rejected_not_truncated(self):
+        with pytest.raises(ValueError):
+            parse_changes_json(
+                '[{"actor":"a","seq":1099511627776,"deps":{},"ops":[]}]')
+
+    def test_bigint_value_preserved(self):
+        wire = json.dumps([{"actor": "a", "seq": 1, "deps": {},
+                            "ops": [{"action": "set", "obj": am.ROOT_ID,
+                                     "key": "big", "value": 2**70}]}])
+        native_changes = parse_changes_json(wire).to_changes()
+        py_changes = [coerce_change(c) for c in json.loads(wire)]
+        assert native_changes == py_changes
+        assert native_changes[0].ops[0].value == 2**70
+
+    def test_unknown_fields_ignored(self):
+        wire = json.dumps([{"actor": "a", "seq": 1, "deps": {}, "time": 123,
+                            "ops": [{"action": "set", "obj": am.ROOT_ID,
+                                     "key": "x", "value": 1, "extra": [1, {"a": 2}]}]}])
+        native_changes = parse_changes_json(wire).to_changes()
+        py_changes = [coerce_change(c) for c in json.loads(wire)]
+        assert native_changes == py_changes
+
+    def test_missing_ops_means_empty(self):
+        wire = '[{"actor":"a","seq":1,"deps":{}}]'
+        changes = parse_changes_json(wire).to_changes()
+        assert changes[0].ops == ()
+
+    def test_lone_surrogate_round_trips(self):
+        wire = json.dumps([{"actor": "a", "seq": 1, "deps": {},
+                            "ops": [{"action": "set", "obj": am.ROOT_ID,
+                                     "key": "s", "value": "x\ud800y"}]}])
+        native_changes = parse_changes_json(wire).to_changes()
+        py_changes = [coerce_change(c) for c in json.loads(wire)]
+        assert native_changes == py_changes
