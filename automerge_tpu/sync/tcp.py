@@ -1,0 +1,173 @@
+"""TCP transport for Connection sync.
+
+The reference is deliberately network-agnostic — a Connection only needs a
+`send_msg` callback and a `receive_msg` entry point (connection.js:24-39),
+with external projects supplying WebRTC/hypercore/etc transports. This module
+is the batteries-included counterpart: a minimal length-prefixed JSON framing
+over TCP sockets that carries the exact `{docId, clock, changes}` message
+schema, so two automerge_tpu processes (or an automerge_tpu process and any
+peer speaking the reference protocol over the same framing) can sync.
+
+Framing: 4-byte big-endian length, then that many bytes of UTF-8 JSON.
+
+Usage:
+    server = TcpSyncServer(doc_set, host="127.0.0.1", port=0)
+    server.start()                       # accepts any number of peers
+    client = TcpSyncClient(other_doc_set, "127.0.0.1", server.port)
+    client.start()
+    ... edit documents, call doc_set.set_doc(...) ...
+    client.close(); server.close()
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+
+from .connection import Connection
+
+_HEADER = struct.Struct(">I")
+MAX_FRAME = 256 * 1024 * 1024
+
+
+def send_frame(sock: socket.socket, msg: dict) -> None:
+    payload = json.dumps(msg).encode("utf-8")
+    sock.sendall(_HEADER.pack(len(payload)) + payload)
+
+
+def recv_frame(sock: socket.socket) -> dict | None:
+    header = _recv_exact(sock, _HEADER.size)
+    if header is None:
+        return None
+    (length,) = _HEADER.unpack(header)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame of {length} bytes exceeds limit")
+    payload = _recv_exact(sock, length)
+    if payload is None:
+        return None
+    return json.loads(payload.decode("utf-8"))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class LockedConnection(Connection):
+    """Connection safe for concurrent entry from a socket reader thread and
+    the application thread (the reference's Connection assumes a single
+    event loop; sockets give us two threads). Reentrant because receive_msg
+    can re-enter doc_changed through DocSet handler gossip."""
+
+    def __init__(self, doc_set, send_msg):
+        super().__init__(doc_set, send_msg)
+        self._lock = threading.RLock()
+
+    def receive_msg(self, msg):
+        with self._lock:
+            return super().receive_msg(msg)
+
+    def doc_changed(self, doc_id, doc):
+        with self._lock:
+            super().doc_changed(doc_id, doc)
+
+
+class _Peer:
+    """One socket bound to one Connection; reads frames on a thread."""
+
+    def __init__(self, doc_set, sock: socket.socket):
+        self.sock = sock
+        self._send_lock = threading.Lock()
+        self.connection = LockedConnection(doc_set, self._send)
+        self._thread = threading.Thread(target=self._read_loop, daemon=True)
+        self.closed = threading.Event()
+
+    def _send(self, msg: dict) -> None:
+        with self._send_lock:
+            try:
+                send_frame(self.sock, msg)
+            except OSError:
+                self.closed.set()
+
+    def start(self) -> None:
+        self.connection.open()
+        self._thread.start()
+
+    def _read_loop(self) -> None:
+        while not self.closed.is_set():
+            msg = recv_frame(self.sock)
+            if msg is None:
+                break
+            self.connection.receive_msg(msg)
+        self.close()
+
+    def close(self) -> None:
+        if not self.closed.is_set():
+            self.closed.set()
+            self.connection.close()
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+
+class TcpSyncServer:
+    """Accepts peers and syncs a DocSet with each over its own Connection."""
+
+    def __init__(self, doc_set, host: str = "127.0.0.1", port: int = 0):
+        self.doc_set = doc_set
+        self._listener = socket.create_server((host, port))
+        self.host, self.port = self._listener.getsockname()[:2]
+        self.peers: list[_Peer] = []
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._closed = threading.Event()
+
+    def start(self) -> "TcpSyncServer":
+        self._accept_thread.start()
+        return self
+
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                break
+            peer = _Peer(self.doc_set, sock)
+            self.peers.append(peer)
+            peer.start()
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for peer in self.peers:
+            peer.close()
+
+
+class TcpSyncClient:
+    """Connects a DocSet to a remote TcpSyncServer."""
+
+    def __init__(self, doc_set, host: str, port: int, timeout: float = 10.0):
+        sock = socket.create_connection((host, port), timeout=timeout)
+        sock.settimeout(None)
+        self.peer = _Peer(doc_set, sock)
+
+    def start(self) -> "TcpSyncClient":
+        self.peer.start()
+        return self
+
+    def close(self) -> None:
+        self.peer.close()
