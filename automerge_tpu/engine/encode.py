@@ -122,6 +122,7 @@ class DocEncoding:
     ins_actor: np.ndarray
     ins_parent: np.ndarray   # element slot index of parent, -1 for head
     ins_fid: np.ndarray      # fid of the element's assign field
+    ins_pos: np.ndarray      # precomputed RGA position of each element slot
     list_obj: np.ndarray     # [max_lists] object id or -1
     list_obj_hash: np.ndarray  # [max_lists] content hash of the list's uuid
     # decode tables (host side)
@@ -290,6 +291,10 @@ def encode_doc(changes: list[Change], actors: list[str] | None = None) -> DocEnc
     list_obj = np.full(max_lists, -1, dtype=np.int32)
     list_obj_hash = np.full(max_lists, -1, dtype=np.int32)
 
+    ins_pos = np.full((max_lists, max_elems), -1, dtype=np.int32)
+
+    from ..native.linearize import linearize_host
+
     for li, oi in enumerate(list_objs):
         list_obj[li] = oi
         list_obj_hash[li] = content_hash(obj_uuid[oi])
@@ -301,14 +306,18 @@ def encode_doc(changes: list[Change], actors: list[str] | None = None) -> DocEnc
             ins_actor[li, slot] = arank
             ins_parent[li, slot] = -1 if parent_eid == HEAD else slots[parent_eid]
             ins_fid[li, slot] = fid_index.get((oi, eid), -1)
+        # RGA order on the host (native linearizer; kernels use it via the
+        # host_order fast path — critical for long texts)
+        ins_pos[li] = linearize_host(ins_mask[li], ins_elem[li],
+                                     ins_actor[li], ins_parent[li])
 
     return DocEncoding(
         op_mask=op_mask, action=action, fid=fid, actor=actor_arr, seq=seq_arr,
         change_idx=change_idx, value=value_arr, fid_hash=fid_hash_arr,
         value_hash=value_hash_arr, clock=clock_mat,
         ins_mask=ins_mask, ins_elem=ins_elem, ins_actor=ins_actor,
-        ins_parent=ins_parent, ins_fid=ins_fid, list_obj=list_obj,
-        list_obj_hash=list_obj_hash,
+        ins_parent=ins_parent, ins_fid=ins_fid, ins_pos=ins_pos,
+        list_obj=list_obj, list_obj_hash=list_obj_hash,
         actors=list(actors), objects=objects,
         fields=fields, value_table=values, n_fids=len(fields), queued=queued)
 
@@ -349,6 +358,7 @@ def stack_docs(encodings: list[DocEncoding]) -> dict[str, np.ndarray]:
         "ins_actor": np.stack([pad2(e.ins_actor, max_lists, max_elems, 0) for e in encodings]),
         "ins_parent": np.stack([pad2(e.ins_parent, max_lists, max_elems, -1) for e in encodings]),
         "ins_fid": np.stack([pad2(e.ins_fid, max_lists, max_elems, -1) for e in encodings]),
+        "ins_pos": np.stack([pad2(e.ins_pos, max_lists, max_elems, -1) for e in encodings]),
         "list_obj": np.stack([pad1(e.list_obj, max_lists, -1) for e in encodings]),
         "list_obj_hash": np.stack([pad1(e.list_obj_hash, max_lists, -1) for e in encodings]),
     }

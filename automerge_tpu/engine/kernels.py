@@ -200,24 +200,32 @@ def state_hash(candidate, fid, actor, fid_hash, value_hash, fid_is_list,
 # ---------------------------------------------------------------------------
 # Whole-document kernel
 
-@partial(jax.jit, static_argnames=("max_fids",))
-def apply_doc(batch, max_fids: int):
+@partial(jax.jit, static_argnames=("max_fids", "host_order"))
+def apply_doc(batch, max_fids: int, host_order: bool = False):
     """Compute converged state for every document in a stacked batch.
 
     batch: dict of arrays with leading docs axis (see encode.stack_docs).
+    host_order=True uses precomputed RGA positions (batch["ins_pos"], from
+    the native host linearizer — the fast path for long texts in from-scratch
+    batches); False runs the device linearization scan (the resident/delta
+    path, where positions change with every round).
     Returns a dict of per-doc state arrays (see batchdoc.BatchedDocSet).
     """
+    if host_order:
+        elem_pos_all = batch["ins_pos"]
+    else:
+        elem_pos_all = jax.vmap(jax.vmap(linearize))(
+            batch["ins_mask"], batch["ins_elem"], batch["ins_actor"],
+            batch["ins_parent"])
 
     def one_doc(op_mask, action, fid, actor, seq, change_idx, value, clock,
                 fid_hash, value_hash,
                 ins_mask, ins_elem, ins_actor, ins_parent, ins_fid, list_obj,
-                list_obj_hash):
+                list_obj_hash, elem_pos):
         survivor, candidate, present, win_actor, win_value = field_states(
             op_mask, action, fid, actor, seq, change_idx, value, clock,
             max_fids)
 
-        # Linearize every list object in this doc.
-        elem_pos = jax.vmap(linearize)(ins_mask, ins_elem, ins_actor, ins_parent)
         safe_ins_fid = jnp.clip(ins_fid, 0, max_fids - 1)
         elem_visible = ins_mask & (ins_fid >= 0) & present[safe_ins_fid]
         vis_rank = jax.vmap(visible_ranks)(elem_pos, elem_visible)
@@ -257,4 +265,4 @@ def apply_doc(batch, max_fids: int):
         batch["fid_hash"], batch["value_hash"],
         batch["ins_mask"], batch["ins_elem"], batch["ins_actor"],
         batch["ins_parent"], batch["ins_fid"], batch["list_obj"],
-        batch["list_obj_hash"])
+        batch["list_obj_hash"], elem_pos_all)

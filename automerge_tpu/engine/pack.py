@@ -23,7 +23,8 @@ import jax.numpy as jnp
 # field order is the wire contract
 FIELDS = ("op_mask", "action", "fid", "actor", "seq", "change_idx", "value",
           "fid_hash", "value_hash", "clock", "ins_mask", "ins_elem",
-          "ins_actor", "ins_parent", "ins_fid", "list_obj", "list_obj_hash")
+          "ins_actor", "ins_parent", "ins_fid", "ins_pos", "list_obj",
+          "list_obj_hash")
 
 
 def pack_batch(batch: dict) -> tuple[np.ndarray, tuple]:
@@ -56,18 +57,19 @@ def unpack_batch(flat, meta: tuple) -> dict:
     return out
 
 
-@partial(jax.jit, static_argnames=("meta", "max_fids"))
-def apply_packed_hash(flat, meta: tuple, max_fids: int):
+@partial(jax.jit, static_argnames=("meta", "max_fids", "host_order"))
+def apply_packed_hash(flat, meta: tuple, max_fids: int,
+                      host_order: bool = True):
     """One reconcile pass over a packed batch, returning ONLY the per-doc
     state hashes (the minimal readback for convergence checking)."""
     from .kernels import apply_doc
     batch = unpack_batch(flat, meta)
-    return apply_doc.__wrapped__(batch, max_fids)["hash"]
+    return apply_doc.__wrapped__(batch, max_fids, host_order)["hash"]
 
 
-@partial(jax.jit, static_argnames=("meta", "max_fids"))
-def apply_packed(flat, meta: tuple, max_fids: int):
+@partial(jax.jit, static_argnames=("meta", "max_fids", "host_order"))
+def apply_packed(flat, meta: tuple, max_fids: int, host_order: bool = True):
     """Full reconcile over a packed batch (all per-doc state arrays)."""
     from .kernels import apply_doc
     batch = unpack_batch(flat, meta)
-    return apply_doc.__wrapped__(batch, max_fids)
+    return apply_doc.__wrapped__(batch, max_fids, host_order)

@@ -77,6 +77,9 @@ def get_lib():
         lib.amtpu_copy_table.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                          ctypes.c_char_p,
                                          ctypes.POINTER(ctypes.c_int32)]
+        if hasattr(lib, "amtpu_linearize"):
+            lib.amtpu_linearize.argtypes = [ctypes.c_int64] + \
+                [ctypes.c_void_p] * 5
         _lib = lib
         return _lib
 

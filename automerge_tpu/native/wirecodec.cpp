@@ -14,6 +14,7 @@
 // Exposed as a C ABI for ctypes: parse once into an arena, query sizes,
 // copy columns out into caller-provided (numpy) buffers, free.
 
+#include <algorithm>
 #include <cerrno>
 #include <climits>
 #include <cstdint>
@@ -508,3 +509,40 @@ void amtpu_copy_table(void* handle, int table, char* blob, int32_t* offsets) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Host RGA linearizer.
+//
+// The device linearizer (engine/kernels.py linearize) is a sequential
+// lax.scan — fine for the short lists of typical documents, but a wall for
+// long text (sequential typing builds a parent chain as deep as the
+// document). This native implementation runs the same algorithm at C speed:
+// process 'ins' ops ascending by (elem, actor-rank), head-inserting each
+// element immediately after its parent in a next-pointer array, then walk
+// the list once to emit positions. O(n log n) in the sort.
+
+extern "C" void amtpu_linearize(int64_t n, const int32_t* elem,
+                                const int32_t* actor, const int32_t* parent,
+                                const uint8_t* mask, int32_t* out_pos) {
+  std::vector<int32_t> order;
+  order.reserve(n);
+  for (int64_t i = 0; i < n; ++i)
+    if (mask[i]) order.push_back(static_cast<int32_t>(i));
+  std::sort(order.begin(), order.end(), [&](int32_t a, int32_t b) {
+    if (elem[a] != elem[b]) return elem[a] < elem[b];
+    return actor[a] < actor[b];
+  });
+
+  // node 0 is the head sentinel; element slot e lives at node e+1
+  std::vector<int32_t> next(n + 1, -1);
+  for (int32_t idx : order) {
+    int32_t p = parent[idx] >= 0 ? parent[idx] + 1 : 0;
+    int32_t e = idx + 1;
+    next[e] = next[p];
+    next[p] = e;
+  }
+
+  for (int64_t i = 0; i < n; ++i) out_pos[i] = -1;
+  int32_t pos = 0;
+  for (int32_t v = next[0]; v != -1; v = next[v]) out_pos[v - 1] = pos++;
+}

@@ -64,7 +64,7 @@ def sharded_apply(arrays: dict, max_fids: int, mesh: Mesh):
     sharded over docs, outputs stay sharded over docs."""
     from ..engine.kernels import apply_doc
     out_sharding = NamedSharding(mesh, P(DOCS_AXIS))
-    fn = jax.jit(lambda b: apply_doc(b, max_fids),
+    fn = jax.jit(lambda b: apply_doc(b, max_fids, host_order=True),
                  out_shardings=out_sharding)
     return fn(arrays)
 
