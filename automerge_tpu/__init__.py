@@ -47,7 +47,7 @@ __all__ = [
 ]
 
 from .storage import save_binary, load_binary, changes_from_binary  # noqa: E402
-from .api import changes_from_json  # noqa: E402
+from .api import changes_from_json, begin, Transaction  # noqa: E402
 
 __all__ += ["save_binary", "load_binary", "changes_from_binary",
-            "changes_from_json"]
+            "changes_from_json", "begin", "Transaction"]

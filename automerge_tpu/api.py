@@ -118,6 +118,47 @@ def change(doc, message_or_fn=None, fn: Callable | None = None) -> RootMap:
     return _make_change(doc, ctx.local, ctx.undo_local, message)
 
 
+class Transaction:
+    """Imperative change-building: an alternative to the change() callback.
+
+        tx = am.begin(doc)
+        tx.root["title"] = "hello"
+        tx.root["items"].append(1)
+        doc2 = tx.commit("my message")
+
+    Reads through tx.root see earlier writes. `commit` returns the new
+    document (or the original unchanged document if nothing was written);
+    `rollback` discards the working state. A committed or rolled-back
+    transaction cannot be reused.
+    """
+
+    def __init__(self, doc):
+        _check_target("begin", doc)
+        self._doc = doc
+        self._ctx = ChangeContext(doc._doc)
+        self.root = root_proxy(self._ctx)
+        self._done = False
+
+    def commit(self, message: str | None = None):
+        if self._done:
+            raise RuntimeError("transaction already finished")
+        if message is not None and not isinstance(message, str):
+            raise TypeError("Change message must be a string")
+        self._done = True
+        if not self._ctx.local:
+            return self._doc
+        return _make_change(self._doc, self._ctx.local,
+                            self._ctx.undo_local, message)
+
+    def rollback(self) -> None:
+        self._done = True
+
+
+def begin(doc) -> Transaction:
+    """Start an imperative transaction on a document."""
+    return Transaction(doc)
+
+
 def empty_change(doc, message: str | None = None) -> RootMap:
     """Commit a change containing no ops (automerge.js:186-192)."""
     _check_target("empty_change", doc)

@@ -64,6 +64,11 @@ class MapProxy:
                 out[key] = {op.actor: _read_value(ctx, op) for op in ops[1:]}
         return out
 
+    def _get(self, object_id: str):
+        """Proxy for any object in the document by its ID (the reference's
+        doc._get, proxies.js:233)."""
+        return _proxy_for(self._ctx, object_id)
+
     # -- reads --------------------------------------------------------------
 
     def __getitem__(self, key: str) -> Any:

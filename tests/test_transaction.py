@@ -1,0 +1,108 @@
+"""Imperative transaction API and remaining proxy parity."""
+
+import pytest
+
+import automerge_tpu as am
+
+
+class TestTransaction:
+    def test_basic(self):
+        doc = am.init()
+        tx = am.begin(doc)
+        tx.root["title"] = "hello"
+        tx.root["items"] = [1]
+        tx.root["items"].append(2)
+        assert tx.root["items"] == [1, 2]  # reads see writes
+        doc2 = tx.commit("setup")
+        assert doc2 == {"title": "hello", "items": [1, 2]}
+        assert doc == {}
+        assert am.get_history(doc2)[-1].change["message"] == "setup"
+
+    def test_empty_commit_returns_same_doc(self):
+        doc = am.change(am.init(), lambda d: d.__setitem__("x", 1))
+        tx = am.begin(doc)
+        assert tx.commit() is doc
+
+    def test_reuse_after_commit_raises(self):
+        tx = am.begin(am.init())
+        tx.root["x"] = 1
+        tx.commit()
+        with pytest.raises(RuntimeError):
+            tx.commit()
+
+    def test_rollback_discards(self):
+        doc = am.change(am.init(), lambda d: d.__setitem__("x", 1))
+        tx = am.begin(doc)
+        tx.root["x"] = 999
+        tx.rollback()
+        assert doc == {"x": 1}
+
+    def test_transaction_attribute_style(self):
+        tx = am.begin(am.init())
+        tx.root.name = "attr"
+        doc = tx.commit()
+        assert doc["name"] == "attr"
+
+
+class TestProxyGet:
+    def test_get_by_object_id(self):
+        doc = am.change(am.init(), lambda d: d.__setitem__("m", {"x": 1}))
+        obj_id = doc["m"]._object_id
+
+        def cb(d):
+            proxy = d._get(obj_id)
+            assert proxy["x"] == 1
+            proxy["y"] = 2
+        doc2 = am.change(doc, cb)
+        assert doc2["m"] == {"x": 1, "y": 2}
+
+
+class TestMoreConformance:
+    def test_insert_and_delete_in_same_change(self):
+        doc = am.change(am.init(), lambda d: d.__setitem__("xs", ["a", "b"]))
+
+        def cb(d):
+            d["xs"].insert_at(1, "mid")
+            d["xs"].delete_at(0)
+        doc = am.change(doc, cb)
+        assert doc == {"xs": ["mid", "b"]}
+
+    def test_link_same_object_under_two_keys_then_delete_one(self):
+        doc = am.change(am.init(), lambda d: d.__setitem__("a", {"v": 1}))
+        doc = am.change(doc, lambda d: d.__setitem__("b", d["a"]))
+        doc = am.change(doc, lambda d: d.__delitem__("a"))
+        assert doc == {"b": {"v": 1}}
+        doc = am.change(doc, lambda d: d["b"].__setitem__("v", 2))
+        assert doc == {"b": {"v": 2}}
+
+    def test_empty_change_is_undoable(self):
+        doc = am.change(am.init(), lambda d: d.__setitem__("x", 1))
+        doc = am.empty_change(doc, "noop")
+        assert am.can_undo(doc)
+        doc = am.undo(doc)  # undoing the empty change changes nothing
+        assert doc == {"x": 1}
+
+    def test_list_conflicts_via_get_conflicts(self):
+        s1 = am.change(am.init("A"), lambda d: d.__setitem__("xs", ["v"]))
+        s2 = am.merge(am.init("B"), s1)
+        s1 = am.change(s1, lambda d: d["xs"].__setitem__(0, "from A"))
+        s2 = am.change(s2, lambda d: d["xs"].__setitem__(0, "from B"))
+        m = am.merge(s1, s2)
+        conflicts = am.get_conflicts(m, m["xs"])
+        assert conflicts == [{"A": "from A"}]
+
+    def test_deeply_nested_incremental_update(self):
+        doc = am.change(am.init(), lambda d: d.__setitem__(
+            "a", {"b": {"c": {"d": {"e": 1}}}}))
+        doc2 = am.change(doc, lambda d: d["a"]["b"]["c"]["d"].__setitem__("e", 2))
+        assert doc2["a"]["b"]["c"]["d"]["e"] == 2
+        assert doc["a"]["b"]["c"]["d"]["e"] == 1
+
+    def test_concurrent_nested_object_creation_same_key(self):
+        s1 = am.change(am.init("A"), lambda d: d.__setitem__("cfg", {"a": 1}))
+        s2 = am.change(am.init("B"), lambda d: d.__setitem__("cfg", {"b": 2}))
+        m1, m2 = am.merge(s1, s2), am.merge(s2, s1)
+        # B wins; A's whole object is the conflict loser
+        assert m1 == {"cfg": {"b": 2}}
+        assert m1._conflicts["cfg"]["A"] == {"a": 1}
+        assert am.equals(m1, m2)
