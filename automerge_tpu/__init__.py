@@ -20,6 +20,7 @@ from .api import (
     load_immutable, save, equals, inspect, get_history, get_conflicts,
     get_changes, get_changes_for_actor, apply_changes, get_missing_changes,
     get_missing_deps, get_clock, get_actor_id, can_undo, undo, can_redo, redo,
+    save_transit, load_transit,
 )
 from .core.change import Change, Op
 from .utils import metrics
@@ -50,4 +51,5 @@ from .storage import save_binary, load_binary, changes_from_binary  # noqa: E402
 from .api import changes_from_json, begin, Transaction  # noqa: E402
 
 __all__ += ["save_binary", "load_binary", "changes_from_binary",
-            "changes_from_json", "begin", "Transaction"]
+            "changes_from_json", "begin", "Transaction",
+            "save_transit", "load_transit"]

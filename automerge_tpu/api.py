@@ -265,6 +265,25 @@ def save(doc) -> str:
     })
 
 
+def save_transit(doc) -> str:
+    """Serialize the history in the reference's own save format: transit
+    JSON of the change list (automerge.js:223-226, transit-immutable-js).
+    The output is loadable by the reference's ``Automerge.load``."""
+    from .interop.transit import changes_to_transit
+    _check_target("save_transit", doc)
+    return changes_to_transit(doc._doc.opset.history)
+
+
+def load_transit(data: str | bytes, actor_id: str | None = None) -> RootMap:
+    """Load a save file produced by the reference implementation
+    (``Automerge.save``, automerge.js:223-226) or by :func:`save_transit`."""
+    from .interop.transit import changes_from_transit
+    doc = init(actor_id)
+    return apply_changes_to_doc(doc, doc._doc.opset,
+                                changes_from_transit(data),
+                                incremental=False)
+
+
 def load(data: str, actor_id: str | None = None) -> RootMap:
     """Rebuild a document by replaying a saved change log."""
     payload = json.loads(data)

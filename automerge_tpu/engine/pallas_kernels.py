@@ -43,12 +43,21 @@ def _round_up(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
 
 
-def _dom_kernel(clockop_ref, onehot_ref, fid_ref, seq_ref, change_ref,
+def _dom_kernel(clockop_ref, actor_ref, fid_ref, seq_ref, change_ref,
                 amask_ref, out_ref):
     """One document: full-block domination compute in VMEM."""
-    # CJI[j, i] = clock of op j's change, evaluated at op i's actor
-    cji = jnp.dot(clockop_ref[:], onehot_ref[:].T,
-                  preferred_element_type=jnp.float32)
+    # One-hot built in-kernel from the int32 actor row (a VPU compare) so the
+    # [N, A] float matrix never hits HBM; padded rows (actor = -1) are zero.
+    a_pad = clockop_ref.shape[1]
+    n_pad = actor_ref.shape[1]
+    onehot = (jax.lax.broadcasted_iota(jnp.int32, (n_pad, a_pad), 1)
+              == actor_ref[:].T).astype(jnp.float32)
+    # CJI[j, i] = clock of op j's change, evaluated at op i's actor.
+    # Precision.HIGHEST keeps the f32 operands exact on the MXU (default
+    # single-pass bf16 would truncate clock values above 2^8).
+    cji = jnp.dot(clockop_ref[:], onehot.T,
+                  preferred_element_type=jnp.float32,
+                  precision=jax.lax.Precision.HIGHEST)
 
     fid = fid_ref[:]          # (1, N)
     seq = seq_ref[:]          # (1, N)
@@ -86,9 +95,7 @@ def dominated_pallas(clock_op, actor, fid, seq, change_idx, amask,
     clockop_f = jnp.pad(
         clock_op.astype(jnp.float32),
         ((0, 0), (0, n_pad - n), (0, a_pad - a)))
-    onehot = jax.nn.one_hot(pad2(actor, n_pad, 0), a_pad, dtype=jnp.float32)
-    # padded ops must not dominate: zero their one-hot rows via amask later;
-    # here just ensure their clock rows are zero (they are, via padding).
+    actor_p = pad2(actor, n_pad, -1)[:, None, :]
     fid_p = pad2(fid, n_pad, -1)[:, None, :]
     seq_p = pad2(seq, n_pad, 1 << 30)[:, None, :].astype(jnp.float32)
     change_p = pad2(change_idx, n_pad, -1)[:, None, :]
@@ -106,7 +113,7 @@ def dominated_pallas(clock_op, actor, fid, seq, change_idx, amask,
         grid=grid,
         in_specs=[
             spec((n_pad, a_pad)),   # clockop
-            spec((n_pad, a_pad)),   # onehot
+            spec((1, n_pad)),       # actor
             spec((1, n_pad)),       # fid
             spec((1, n_pad)),       # seq
             spec((1, n_pad)),       # change
@@ -115,6 +122,6 @@ def dominated_pallas(clock_op, actor, fid, seq, change_idx, amask,
         out_specs=spec((1, n_pad)),
         out_shape=jax.ShapeDtypeStruct((docs, 1, n_pad), jnp.int32),
         interpret=interpret,
-    )(clockop_f, onehot, fid_p, seq_p, change_p, amask_p)
+    )(clockop_f, actor_p, fid_p, seq_p, change_p, amask_p)
 
     return out[:, 0, :n].astype(bool)

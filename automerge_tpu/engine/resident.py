@@ -174,6 +174,36 @@ class ResidentDocSet:
                 s["list_obj_hash"] = pad(s["list_obj_hash"], ((0, 0), (0, d_l)), -1)
 
     # ------------------------------------------------------------------
+    def reserve(self, *, ops_per_doc: int | None = None,
+                changes_per_doc: int | None = None,
+                lists_per_doc: int | None = None,
+                elems_per_list: int | None = None,
+                actors: int | None = None,
+                fids_per_doc: int | None = None) -> None:
+        """Pre-size resident capacity so steady-state rounds never regrow.
+
+        Growing any capacity changes the resident array shapes, which forces
+        an XLA recompile of the fused scatter+apply on the next dispatch
+        (seconds, even for small shapes, on a tunneled chip). A long-lived
+        sync service should reserve for its expected horizon up front; the
+        per-delta arrays are unaffected (their shapes track the delta size).
+        """
+        grow = {}
+        for want, cap_name in ((ops_per_doc, "cap_ops"),
+                               (changes_per_doc, "cap_changes"),
+                               (elems_per_list, "cap_elems")):
+            if want and _pad_to(want) > getattr(self, cap_name):
+                grow[cap_name] = _pad_to(want)
+        if lists_per_doc and _pad_to(lists_per_doc, 1) > self.cap_lists:
+            grow["cap_lists"] = _pad_to(lists_per_doc, 1)
+        if actors and _pad_to(actors, 2) > self.cap_actors:
+            grow["cap_actors"] = _pad_to(actors, 2)
+        if grow:
+            self._grow(**grow)
+        if fids_per_doc and _pad_to(fids_per_doc) > self.cap_fids:
+            self.cap_fids = _pad_to(fids_per_doc)
+
+    # ------------------------------------------------------------------
     def _register_actors(self, changes_by_doc) -> None:
         new = {c.actor for changes in changes_by_doc.values() for c in changes}
         new -= set(self.actors)

@@ -266,6 +266,13 @@ def run_resident_rounds(doc_changes, n_rounds=6, fraction=0.2):
 
     resident = ResidentDocSet(doc_ids)
     resident.apply_changes({doc_ids[i]: doc_changes[i] for i in range(n)})
+    # Pre-size for the incremental horizon: each round appends one 1-op
+    # change per touched doc. Without the reservation a capacity doubling
+    # mid-run changes the resident shapes and forces a multi-second XLA
+    # recompile in the middle of the timed loop.
+    resident.reserve(
+        ops_per_doc=int(resident.op_count.max()) + n_rounds + 1,
+        changes_per_doc=int(resident.change_count.max()) + n_rounds + 1)
     resident.reconcile()  # warm state + compile
 
     changed = rng.sample(range(n), max(1, int(n * fraction)))

@@ -137,3 +137,34 @@ class TestResidentParity:
         rb = ResidentDocSet(["d"])
         rb.apply_changes({"d": ch2})
         assert int(ra.reconcile()[0]) == int(rb.reconcile()[0])
+
+
+class TestReserve:
+    def test_reserve_presizes_and_preserves_state(self):
+        s1 = am.change(am.init("A"), lambda d: am.assign(d, {"x": 1, "xs": [1, 2]}))
+        changes = s1._doc.opset.get_missing_changes({})
+        r = ResidentDocSet(["doc"])
+        r.apply_changes({"doc": changes})
+        before = r.materialize("doc")
+        r.reserve(ops_per_doc=64, changes_per_doc=32, elems_per_list=64,
+                  lists_per_doc=4, actors=8, fids_per_doc=64)
+        assert r.cap_ops >= 64 and r.cap_changes >= 32
+        assert r.cap_elems >= 64 and r.cap_actors >= 8
+        # state survives the resize and no regrow happens within the horizon
+        assert r.materialize("doc") == before
+        caps = (r.cap_ops, r.cap_changes, r.cap_lists, r.cap_elems)
+        doc = s1
+        for i in range(10):
+            new = am.change(doc, lambda d, i=i: d.__setitem__("n", i))
+            delta = new._doc.opset.get_missing_changes(doc._doc.opset.clock)
+            doc = new
+            r.apply_changes({"doc": delta})
+        assert (r.cap_ops, r.cap_changes, r.cap_lists, r.cap_elems) == caps
+        all_changes = doc._doc.opset.get_missing_changes({})
+        assert r.materialize("doc") == oracle_of(all_changes)
+
+    def test_reserve_noop_when_smaller(self):
+        r = ResidentDocSet(["doc"])
+        caps = (r.cap_ops, r.cap_changes, r.cap_actors)
+        r.reserve(ops_per_doc=1, changes_per_doc=1, actors=1)
+        assert (r.cap_ops, r.cap_changes, r.cap_actors) == caps

@@ -1,0 +1,145 @@
+"""Transit-format interop tests.
+
+The reference saves documents as transit JSON of the change history
+(/root/reference/src/automerge.js:223-226 via transit-immutable-js). These
+tests cover the codec itself (escapes, caching, tags) and document-level
+round-trips, including decoding a hand-built fixture in exactly the form
+transit-js emits (tag caching with ^-codes).
+"""
+
+import json
+import math
+
+import automerge_tpu as am
+from automerge_tpu.interop import transit
+
+
+class TestCodec:
+    def test_scalar_roundtrip(self):
+        for v in ["hello", "", 0, 1, -7, 1.5, True, False, None]:
+            assert transit.loads(transit.dumps(v)) == v
+
+    def test_top_level_scalar_is_quoted(self):
+        assert json.loads(transit.dumps(42)) == ["~#'", 42]
+
+    def test_escape_roundtrip(self):
+        for s in ["~tilde", "^caret", "`tick", "~~", "^ ", "~:notkw"]:
+            assert transit.loads(transit.dumps(s)) == s
+
+    def test_special_floats(self):
+        assert math.isnan(transit.loads(transit.dumps(math.nan)))
+        assert transit.loads(transit.dumps(math.inf)) == math.inf
+        assert transit.loads(transit.dumps(-math.inf)) == -math.inf
+
+    def test_big_int_precision(self):
+        big = (1 << 60) + 3
+        assert transit.loads(transit.dumps(big)) == big
+        assert f"~i{big}" in transit.dumps(big)
+
+    def test_map_and_list_tags(self):
+        doc = {"a": 1, "xs": [1, "two", None]}
+        encoded = json.loads(transit.dumps(doc))
+        assert encoded[0] == "~#iM"
+        assert transit.loads(transit.dumps(doc)) == doc
+
+    def test_tag_caching_assigns_codes_in_write_order(self):
+        # two maps inside a list: iL first (code ^0), iM second (code ^1);
+        # the second map must be emitted via the cache code.
+        val = [{"k": 1}, {"k": 2}]
+        raw = transit.dumps(val)
+        j = json.loads(raw)
+        assert j[0] == "~#iL"
+        assert j[1][0][0] == "~#iM"
+        assert j[1][1][0] == "^1"       # iL took ^0, iM took ^1
+        assert transit.loads(raw) == val
+
+    def test_decodes_keywords_and_symbols_as_strings(self):
+        assert transit.loads('["~#\'","~:actor"]') == "actor"
+        assert transit.loads('["~#\'","~$sym"]') == "sym"
+
+    def test_decodes_verbose_map(self):
+        assert transit.loads('{"a":1,"b":[1,2]}') == {"a": 1, "b": [1, 2]}
+
+    def test_decodes_caret_space_map_with_key_caching(self):
+        # map keys >3 chars are cacheable; the repeat uses the code
+        raw = '[["^ ","actorId",1],["^ ","^0",2]]'
+        assert transit.loads(raw) == [{"actorId": 1}, {"actorId": 2}]
+
+    def test_cache_reset_after_capacity(self):
+        # 44*44 distinct cacheable keys overflow the cache; the writer
+        # resets and the reader must follow the same reset rule.
+        n = 44 * 44 + 10
+        val = [{f"key{i:04d}": i} for i in range(n)] * 2
+        assert transit.loads(transit.dumps(val)) == val
+
+
+class TestReferenceFixture:
+    def test_decode_handwritten_reference_save(self):
+        """A save in the exact shape transit-js produces for a two-change
+        history: iL/iM tags cached after first use, plain-string keys in
+        iM rep arrays, scalar values inline."""
+        fixture = json.dumps([
+            "~#iL",
+            [["~#iM", ["ops",
+                       ["^0", [["^1", ["action", "set", "obj",
+                                       "00000000-0000-0000-0000-000000000000",
+                                       "key", "title", "value", "hello"]]]],
+                       "actor", "aaaa", "seq", 1,
+                       "deps", ["^1", []]]],
+             ["^1", ["ops",
+                     ["^0", [["^1", ["action", "set", "obj",
+                                     "00000000-0000-0000-0000-000000000000",
+                                     "key", "n", "value", 7]]]],
+                     "actor", "bbbb", "seq", 1,
+                     "deps", ["^1", ["aaaa", 1]]]]],
+        ], separators=(",", ":"))
+        doc = am.load_transit(fixture)
+        assert doc["title"] == "hello"
+        assert doc["n"] == 7
+        changes = transit.changes_from_transit(fixture)
+        assert [c.actor for c in changes] == ["aaaa", "bbbb"]
+        assert changes[1].deps == {"aaaa": 1}
+
+
+class TestDocumentRoundTrip:
+    def build(self):
+        d = am.change(am.init("A"), lambda doc: am.assign(doc, {
+            "title": "board", "cards": [{"t": "one", "done": False}],
+            "meta": {"n": 3, "odd~key": "^weird"},
+        }))
+        d2 = am.change(am.merge(am.init("B"), d),
+                       lambda doc: doc["cards"].append({"t": "two", "done": True}))
+        d = am.change(d, lambda doc: doc.__setitem__("title", "board!"))
+        return am.merge(d, d2)
+
+    def test_save_transit_load_transit(self):
+        doc = self.build()
+        data = am.save_transit(doc)
+        loaded = am.load_transit(data, "C")
+        assert am.equals(loaded, doc)
+        # history survives byte-for-byte: re-save matches
+        assert am.save_transit(loaded) == data
+
+    def test_transit_save_matches_json_save_semantics(self):
+        doc = self.build()
+        via_transit = am.load_transit(am.save_transit(doc), "C")
+        via_json = am.load(am.save(doc), "C")
+        assert am.equals(via_transit, via_json)
+
+    def test_text_and_message_roundtrip(self):
+        def mk(doc):
+            doc["t"] = am.Text()
+            doc["t"].insert_at(0, *"hi~^`there")
+        d = am.change(am.init("A"), "made text", mk)
+        loaded = am.load_transit(am.save_transit(d))
+        assert "".join(loaded["t"]) == "hi~^`there"
+        assert am.get_history(loaded)[-1].change["message"] == "made text"
+
+    def test_conflicts_survive_roundtrip(self):
+        # test/test.js:1107-1116: conflicts must survive save/load
+        d1 = am.change(am.init("A"), lambda d: d.__setitem__("x", "from A"))
+        d2 = am.change(am.init("B"), lambda d: d.__setitem__("x", "from B"))
+        m = am.merge(d1, d2)
+        loaded = am.load_transit(am.save_transit(m))
+        assert loaded["x"] == m["x"]
+        assert am.get_conflicts(loaded, loaded) == am.get_conflicts(m, m)
