@@ -73,3 +73,79 @@ def apply_packed(flat, meta: tuple, max_fids: int, host_order: bool = True):
     from .kernels import apply_doc
     batch = unpack_batch(flat, meta)
     return apply_doc.__wrapped__(batch, max_fids, host_order)
+
+
+# ---------------------------------------------------------------------------
+# Docs-minor row wire format (the pallas megakernel's native layout)
+
+# Row-buffer column groups, in wire order. `ins_elem/ins_actor/ins_parent`
+# are deliberately absent: the hash path uses host-linearized positions
+# (ins_pos), so the RGA tree columns never need to cross the wire.
+ROW_FIELDS = ("op_mask", "action", "fid", "actor", "seq", "change_idx",
+              "fid_hash", "value_hash", "clock", "ins_mask", "ins_fid",
+              "ins_pos", "elem_objhash")
+
+# Per-doc dims above which the unrolled kernel's VMEM blocks get too big
+# (or its static unrolling too long); callers fall back to the packed XLA
+# path. The clock cap matters because actors are interned batch-globally, so
+# a DocSet where every doc has its own actor makes C*A huge even when each
+# doc is tiny.
+ROWS_MAX_OPS = 64
+ROWS_MAX_ELEMS = 64
+ROWS_MAX_FIDS = 64
+ROWS_MAX_CLOCK = 512
+
+
+def rows_eligible(batch: dict, max_fids: int) -> bool:
+    d, i = batch["op_mask"].shape
+    c, a = batch["clock"].shape[1:]
+    l, e = batch["ins_mask"].shape[1:]
+    return (i <= ROWS_MAX_OPS and l * e <= ROWS_MAX_ELEMS
+            and max_fids <= ROWS_MAX_FIDS and c * a <= ROWS_MAX_CLOCK)
+
+
+def pack_rows(batch: dict, max_fids: int) -> tuple[np.ndarray, tuple, int]:
+    """Repack a stacked batch (docs-major dict) into the docs-minor
+    [ROWS, D_pad] int32 row buffer + static dims for reconcile_rows_hash.
+
+    Returns (rows, dims, n_docs). D_pad rounds the doc count up to a
+    multiple of 128 (the TPU lane width); padded docs hash to garbage and
+    are sliced off after readback.
+    """
+    from .encode import A_DEL, A_SET
+
+    d, i = batch["op_mask"].shape
+    c, a = batch["clock"].shape[1:]
+    l, e = batch["ins_mask"].shape[1:]
+    d_pad = ((d + 127) // 128) * 128
+
+    def rowify(arr, fill=0):
+        """[d, ...] -> [prod(...), d_pad] int32, docs minor."""
+        arr = np.asarray(arr).astype(np.int32)
+        flat = arr.reshape(d, -1).T
+        if d_pad > d:
+            flat = np.pad(flat, ((0, 0), (0, d_pad - d)),
+                          constant_values=fill)
+        return flat
+
+    elem_objhash = np.broadcast_to(
+        np.asarray(batch["list_obj_hash"])[:, :, None], (d, l, e))
+    parts = [
+        rowify(batch["op_mask"]), rowify(batch["action"], -1),
+        rowify(batch["fid"], -1), rowify(batch["actor"]),
+        rowify(batch["seq"]), rowify(batch["change_idx"]),
+        rowify(batch["fid_hash"]), rowify(batch["value_hash"]),
+        rowify(batch["clock"]), rowify(batch["ins_mask"]),
+        rowify(batch["ins_fid"], -1), rowify(batch["ins_pos"]),
+        rowify(elem_objhash, -1),
+    ]
+    rows = np.concatenate(parts, axis=0)
+    dims = (i, c, a, l, e, max_fids, int(A_SET), int(A_DEL))
+    return rows, dims, d
+
+
+def apply_rows_hash(rows, dims: tuple, n_docs: int, interpret: bool = False):
+    """Per-doc state hashes from a row buffer via the pallas megakernel
+    (TPU) or its interpreter (tests/CPU). Returns uint32 [n_docs]."""
+    from .pallas_kernels import reconcile_rows_hash
+    return reconcile_rows_hash(rows, dims, interpret)[:n_docs]

@@ -28,6 +28,8 @@ from __future__ import annotations
 
 import functools
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -41,6 +43,183 @@ except Exception:  # pragma: no cover
 
 def _round_up(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
+
+
+# ---------------------------------------------------------------------------
+# Fused reconcile megakernel over a docs-minor row buffer
+#
+# Motivation (measured on the tunneled chip this repo benches on): every XLA
+# op dispatched against device buffers carries a multi-ms fixed cost there,
+# and relayout ops (reshape/transpose of [docs, small] arrays) cost tens of
+# ms — so the ~60-op fused XLA reconcile pays ~100ms+ per pass regardless of
+# batch size, while the arithmetic itself is microseconds. The fix is to make
+# the *wire format* the kernel's native layout: one int32 [ROWS, D_pad]
+# buffer, documents minor (lane axis), every logical column a static row
+# range. The whole reconcile — survivor analysis, LWW winner select,
+# visibility ranks, state hash (kernels.py semantics, op_set.js:179-209 and
+# 343-397 in the reference) — then runs as ONE pallas_call on 128-doc column
+# blocks entirely in VMEM, with zero relayouts and zero glue ops.
+#
+# Row layout (all int32; see pack.pack_rows):
+#   op_mask[I] action[I] fid[I] actor[I] seq[I] change_idx[I]
+#   fid_hash[I] value_hash[I] clock[C*A] ins_mask[L*E] ins_fid[L*E]
+#   ins_pos[L*E] elem_objhash[L*E]
+# The hash must stay bit-identical to kernels.state_hash, so the murmur
+# finalizer is reproduced in int32 arithmetic (wraparound add/mul and
+# logical shifts give the same bits as the uint32 original).
+
+_M1 = np.int32(np.uint32(0x85EBCA6B).astype(np.int64) - (1 << 32))
+_M2 = np.int32(np.uint32(0xC2B2AE35).astype(np.int64) - (1 << 32))
+_GOLD = np.int32(np.uint32(0x9E3779B9).astype(np.int64) - (1 << 32))
+
+
+def _mix_i32(h):
+    h = h ^ jax.lax.shift_right_logical(h, 16)
+    h = h * _M1
+    h = h ^ jax.lax.shift_right_logical(h, 13)
+    h = h * _M2
+    h = h ^ jax.lax.shift_right_logical(h, 16)
+    return h
+
+
+def _mix4_i32(a, b, c, d):
+    h = _mix_i32(a + _GOLD)
+    h = _mix_i32(h ^ b)
+    h = _mix_i32(h ^ c)
+    h = _mix_i32(h ^ d)
+    return h
+
+
+def _make_reconcile_kernel(I, C, A, L, E, F, a_set, a_del):
+    """Build the fused kernel body for static per-doc dims."""
+    LE = L * E
+    r_om, r_ac, r_fid, r_act, r_seq, r_chg, r_fh, r_vh = (
+        0, I, 2 * I, 3 * I, 4 * I, 5 * I, 6 * I, 7 * I)
+    r_clock = 8 * I
+    r_imask = r_clock + C * A
+    r_ifid = r_imask + LE
+    r_ipos = r_ifid + LE
+    r_iobj = r_ipos + LE
+
+    def kernel(x_ref, o_ref):
+        om = x_ref[r_om:r_om + I, :]
+        action = x_ref[r_ac:r_ac + I, :]
+        fid = x_ref[r_fid:r_fid + I, :]
+        actor = x_ref[r_act:r_act + I, :]
+        seq = x_ref[r_seq:r_seq + I, :]
+        chg = x_ref[r_chg:r_chg + I, :]
+        fh = x_ref[r_fh:r_fh + I, :]
+        vh = x_ref[r_vh:r_vh + I, :]
+
+        amask = (om > 0) & (action >= a_set)
+
+        # cji[j, i] = clock(change of op j) at actor of op i    [I, I, 128]
+        # via static one-hot loops over the tiny C and A axes.
+        cj_by_a = []
+        for a in range(A):
+            acc = jnp.zeros_like(seq)
+            for c in range(C):
+                row = x_ref[r_clock + c * A + a, :]
+                acc = acc + jnp.where(chg == c, row[None, :], 0)
+            cj_by_a.append(acc)                      # [I, 128]
+        # dominated[i] = any_j (amask_j & amask_i & fid_j==fid_i
+        #                & cji >= seq_i & chg_j != chg_i)
+        dominated = jnp.zeros_like(amask)
+        for j in range(I):
+            cji_j = jnp.zeros_like(seq)              # [I(i), 128]
+            for a in range(A):
+                cji_j = cji_j + jnp.where(actor == a,
+                                          cj_by_a[a][j][None, :], 0)
+            dom_j = (amask[j][None, :] & amask
+                     & (fid[j][None, :] == fid)
+                     & (cji_j >= seq)
+                     & (chg[j][None, :] != chg))
+            dominated = dominated | dom_j
+        survivor = amask & ~dominated
+        candidate = survivor & (action != a_del)
+
+        # per-fid presence (the hash path only needs whether a field has a
+        # surviving value, not the winner's identity)       [F rows of 128]
+        present = []
+        for f in range(F):
+            m_f = (fid == f) & amask
+            wa_f = jnp.max(jnp.where(m_f & candidate, actor, -1),
+                           axis=0, keepdims=True)    # [1, 128]
+            present.append(wa_f >= 0)
+
+        if LE > 0:
+            imask = x_ref[r_imask:r_imask + LE, :]
+            ifid = x_ref[r_ifid:r_ifid + LE, :]
+            ipos = x_ref[r_ipos:r_ipos + LE, :]
+            iobj = x_ref[r_iobj:r_iobj + LE, :]
+            el_valid = (imask > 0) & (ifid >= 0)
+            pae = jnp.zeros_like(imask, dtype=jnp.bool_)
+            for f in range(F):
+                pae = pae | ((ifid == f) & present[f])
+            elem_visible = el_valid & pae
+            # visible rank inside each list           [L*E rows of 128]
+            ranks = []
+            for l in range(L):
+                pos_l = ipos[l * E:(l + 1) * E, :]
+                vis_l = elem_visible[l * E:(l + 1) * E, :]
+                acc = jnp.zeros_like(pos_l)
+                for e in range(E):
+                    lt = (pos_l[e][None, :] < pos_l)
+                    acc = acc + jnp.where(vis_l[e][None, :] & lt, 1, 0)
+                ranks.append(acc)
+            vis_rank = jnp.where(elem_visible,
+                                 jnp.concatenate(ranks, axis=0), -1)
+            # fid -> (is_list, owning-object hash, visible rank)
+            op_is_list = jnp.zeros_like(amask)
+            op_objhash = jnp.zeros_like(fid)
+            op_rank = jnp.zeros_like(fid)
+            for f in range(F):
+                efm = (ifid == f) & el_valid
+                isl = jnp.any(efm, axis=0, keepdims=True)
+                oh = jnp.max(jnp.where(efm, iobj, -1), axis=0, keepdims=True)
+                rk = jnp.max(jnp.where(efm, vis_rank, -1), axis=0,
+                             keepdims=True)
+                m_f = (fid == f) & amask
+                op_is_list = op_is_list | (m_f & isl)
+                op_objhash = op_objhash + jnp.where(m_f, oh, 0)
+                op_rank = op_rank + jnp.where(m_f, rk, 0)
+            key1 = jnp.where(op_is_list, op_objhash, jnp.int32(-7))
+            key2 = jnp.where(op_is_list, op_rank, fh)
+        else:
+            key1 = jnp.full_like(fh, -7)
+            key2 = fh
+
+        contrib = _mix4_i32(key1, key2, actor, vh)
+        o_ref[:] = jnp.sum(jnp.where(candidate, contrib, 0), axis=0,
+                           keepdims=True)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("dims", "interpret"))
+def reconcile_rows_hash(rows, dims: tuple, interpret: bool = False):
+    """Fused reconcile + state hash over a docs-minor row buffer.
+
+    rows: [ROWS, D_pad] int32 (see pack.pack_rows); dims is the static
+    (I, C, A, L, E, F, a_set, a_del) tuple. Returns [D_pad] uint32 per-doc
+    state hashes, bit-identical to kernels.apply_doc(...)["hash"].
+    """
+    if not HAVE_PALLAS:
+        raise RuntimeError("pallas unavailable on this backend")
+    I, C, A, L, E, F, a_set, a_del = dims
+    rows_n, d_pad = rows.shape
+    kernel = _make_reconcile_kernel(I, C, A, L, E, F, a_set, a_del)
+    out = pl.pallas_call(
+        kernel,
+        grid=(d_pad // 128,),
+        in_specs=[pl.BlockSpec((rows_n, 128), lambda d: (0, d),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, 128), lambda d: (0, d),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, d_pad), jnp.int32),
+        interpret=interpret,
+    )(rows)
+    return jax.lax.bitcast_convert_type(out[0], jnp.uint32)
 
 
 def _dom_kernel(clockop_ref, actor_ref, fid_ref, seq_ref, change_ref,
