@@ -13,6 +13,7 @@ from typing import Any, Iterator
 
 from ..core import opset as O
 from ..core.ids import ROOT_ID
+from .array_ops import ArrayReadOps
 from .context import ChangeContext, parse_list_index
 
 
@@ -147,7 +148,7 @@ class MapProxy:
             self[key] = value
 
 
-class ListProxy:
+class ListProxy(ArrayReadOps):
     __slots__ = ("_ctx", "_oid")
 
     def __init__(self, ctx: ChangeContext, object_id: str):

@@ -15,6 +15,8 @@ from __future__ import annotations
 
 from typing import Any
 
+from .array_ops import ArrayReadOps
+
 _READONLY_MSG = ("this document snapshot is read-only. "
                  "Use change() to get a writable version.")
 
@@ -91,7 +93,7 @@ class FrozenMap(dict):
         return (dict, (dict(self),))
 
 
-class FrozenList(list):
+class FrozenList(list, ArrayReadOps):
     """Immutable list snapshot; == plain lists with the same contents.
 
     `_conflicts` is a list aligned with the elements: each entry is None or a

@@ -14,8 +14,10 @@ from __future__ import annotations
 
 from typing import Any, Iterator
 
+from .array_ops import ArrayReadOps
 
-class Text:
+
+class Text(ArrayReadOps):
     __slots__ = ("_values", "_elem_ids", "_object_id_attr")
 
     def __init__(self, values=(), elem_ids=(), object_id: str | None = None):

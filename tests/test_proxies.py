@@ -177,3 +177,50 @@ class TestReviewRegressions:
         payload["automerge_tpu"] = 99
         with pytest.raises(ValueError):
             am.load(_json.dumps(payload))
+
+
+class TestArrayReadOps:
+    """The 16 delegated read-only Array methods of the reference
+    (proxies.js:82-89, text.js:35-42) on snapshots, proxies, and Text."""
+
+    def _doc(self):
+        return am.change(am.init("A"),
+                         lambda d: d.__setitem__("xs", [3, 1, 4, 1, 5]))
+
+    def test_snapshot_reads(self):
+        xs = self._doc()["xs"]
+        assert xs.includes(4) and not xs.includes(9)
+        assert xs.index_of(1) == 1 and xs.last_index_of(1) == 3
+        assert xs.find(lambda v: v > 3) == 4
+        assert xs.find_index(lambda v: v > 3) == 2
+        assert xs.every(lambda v: v > 0) and xs.some(lambda v: v == 5)
+        assert xs.filter(lambda v: v != 1) == [3, 4, 5]
+        assert xs.map(lambda v: v * 2) == [6, 2, 8, 2, 10]
+        assert xs.reduce(lambda a, b: a + b) == 14
+        assert xs.reduce_right(lambda a, b: a - b) == -4  # 5-1-4-1-3
+        assert xs.slice(1, 3) == [1, 4]
+        assert xs.concat([9], 10) == [3, 1, 4, 1, 5, 9, 10]
+        assert xs.join("-") == "3-1-4-1-5"
+        assert xs.to_string() == "3,1,4,1,5"
+        seen = []
+        xs.for_each(seen.append)
+        assert seen == [3, 1, 4, 1, 5]
+
+    def test_proxy_reads_inside_change(self):
+        out = {}
+
+        def cb(d):
+            out["inc"] = d["xs"].includes(4)
+            out["fi"] = d["xs"].find_index(lambda v: v == 5)
+            out["sl"] = d["xs"].slice(0, 2)
+        am.change(self._doc(), cb)
+        assert out == {"inc": True, "fi": 4, "sl": [3, 1]}
+
+    def test_text_reads(self):
+        t = am.change(am.init("A"), lambda d: d.__setitem__("t", am.Text()))
+        t = am.change(t, lambda d: d["t"].insert_at(0, *"abcb"))
+        tt = t["t"]
+        assert tt.includes("c") and tt.last_index_of("b") == 3
+        assert tt.join() == "abcb"  # Text keeps its ""-separator default
+        assert tt.map(str.upper) == ["A", "B", "C", "B"]
+        assert tt.slice(1, 3) == ["b", "c"]
