@@ -88,6 +88,7 @@ class Delta:
         self.clocks: list[np.ndarray] = []  # rows [n_actors]
         self.ins: list[tuple] = []        # (list_row, slot, elem, actor, parent_slot, fid)
         self.new_lists: list[tuple] = []  # (list_row, obj_idx, obj_hash)
+        self.changes: list[Change] = []   # causally-admitted changes, in order
 
 
 class ResidentDocSet:
@@ -252,6 +253,7 @@ class ResidentDocSet:
             pending = still
         t.queue = pending
 
+        delta.changes = ready
         n_actors = self.cap_actors
         for c in ready:
             # transitive clock

@@ -1,0 +1,355 @@
+"""Device-resident DocSet state in the megakernel's docs-minor row layout.
+
+`resident.py` keeps docs-major columnar tables and re-runs the multi-op XLA
+reconcile per sync round — one dispatch per round. On hardware where each
+dispatch carries a large fixed cost (see INTERNALS.md §4) a streaming sync
+service wants the opposite shape: state held as the single [ROWS, D_pad]
+int32 buffer that `pallas_kernels.reconcile_rows_hash` consumes natively,
+deltas applied as point scatters, and MANY rounds processed in ONE dispatch
+(`lax.scan` over stacked per-round scatter triplets, reconciling after each
+round). Per round the device work is one scatter + one fused kernel; the
+host keeps an authoritative numpy mirror, so structural events (capacity
+growth, new actors) rebuild host-side and re-upload once.
+
+Causal admission, interning, and LWW actor ranking reuse the host machinery
+of `resident.ResidentDocSet` (the reference semantics live in
+op_set.js:254-270 and op_set.js:201). List order is maintained host-side via
+the native RGA linearizer and shipped as position rows, exactly like the
+from-scratch batch path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .encode import _pad_to
+from .resident import ResidentDocSet
+from .pallas_kernels import reconcile_rows_hash
+
+
+def _ceil128(n: int) -> int:
+    return ((n + 127) // 128) * 128
+
+
+class ResidentRowsDocSet(ResidentDocSet):
+    """Resident DocSet whose device state IS the megakernel row buffer."""
+
+    def __init__(self, doc_ids, actors: list[str] = ()):  # noqa: B006
+        self._rows_ready = False
+        super().__init__(doc_ids)
+        self.n_pad = _ceil128(max(len(self.doc_ids), 1))
+        # per-doc: list_row -> [(slot, elem, arank, parent_slot), ...]
+        self.ins_log: list[dict[int, list[tuple]]] = [
+            {} for _ in self.doc_ids]
+        # per-doc: list_row -> owning-object content hash
+        self.list_hash: list[dict[int, int]] = [{} for _ in self.doc_ids]
+        # per-doc admitted change log (for materialization/debugging)
+        self.change_log: list[list] = [[] for _ in self.doc_ids]
+        if actors:
+            # Pre-registering the expected actor set avoids a mirror remap +
+            # re-upload when they first appear in deltas.
+            self.actors = sorted(actors)
+            self.actor_rank = {a: i for i, a in enumerate(self.actors)}
+            if len(self.actors) > self.cap_actors:
+                self.cap_actors = _pad_to(len(self.actors), 2)
+        self._rows_ready = True
+        self._alloc_rows()
+        self.rows_dev = None
+        self._dirty = True
+
+    # ------------------------------------------------------------------
+    # row layout
+
+    def _bases(self):
+        I, C, A = self.cap_ops, self.cap_changes, self.cap_actors
+        LE = self.cap_lists * self.cap_elems
+        om = 0
+        return {
+            "om": om, "ac": om + I, "fid": om + 2 * I, "act": om + 3 * I,
+            "seq": om + 4 * I, "chg": om + 5 * I, "fh": om + 6 * I,
+            "vh": om + 7 * I, "clk": 8 * I, "im": 8 * I + C * A,
+            "if": 8 * I + C * A + LE, "ip": 8 * I + C * A + 2 * LE,
+            "io": 8 * I + C * A + 3 * LE, "rows": 8 * I + C * A + 4 * LE,
+        }
+
+    def dims(self) -> tuple:
+        from .encode import A_DEL, A_SET
+        return (self.cap_ops, self.cap_changes, self.cap_actors,
+                self.cap_lists, self.cap_elems, self.cap_fids,
+                int(A_SET), int(A_DEL))
+
+    def _alloc_rows(self):
+        b = self._bases()
+        self.rows_host = np.zeros((b["rows"], self.n_pad), dtype=np.int32)
+        self.rows_host[b["ac"]:b["ac"] + self.cap_ops] = -1
+        self.rows_host[b["fid"]:b["fid"] + self.cap_ops] = -1
+        le = self.cap_lists * self.cap_elems
+        self.rows_host[b["if"]:b["if"] + le] = -1
+        self.rows_host[b["io"]:b["io"] + le] = -1
+
+    # the docs-major device state of the base class is never built
+    def _alloc(self):
+        self.state = {}
+
+    def _grow(self, **caps):
+        """Re-layout the host mirror for new capacities; device re-uploads."""
+        if not getattr(self, "_rows_ready", False):
+            for k, v in caps.items():
+                setattr(self, k, v)
+            return
+        old_b = self._bases()
+        old = self.rows_host
+        old_caps = dict(I=self.cap_ops, C=self.cap_changes, A=self.cap_actors,
+                        L=self.cap_lists, E=self.cap_elems)
+        for k, v in caps.items():
+            setattr(self, k, v)
+        b = self._bases()
+        self._alloc_rows()
+        new = self.rows_host
+        I0, C0, A0 = old_caps["I"], old_caps["C"], old_caps["A"]
+        L0, E0 = old_caps["L"], old_caps["E"]
+        for g in ("om", "ac", "fid", "act", "seq", "chg", "fh", "vh"):
+            new[b[g]:b[g] + I0] = old[old_b[g]:old_b[g] + I0]
+        # clock rows re-stride from (C0, A0) to (C, A)
+        clk = old[old_b["clk"]:old_b["clk"] + C0 * A0].reshape(C0, A0, -1)
+        new[b["clk"]:b["clk"] + self.cap_changes * self.cap_actors] \
+            .reshape(self.cap_changes, self.cap_actors, -1)[:C0, :A0] = clk
+        for g in ("im", "if", "ip", "io"):
+            src = old[old_b[g]:old_b[g] + L0 * E0].reshape(L0, E0, -1)
+            new[b[g]:b[g] + self.cap_lists * self.cap_elems] \
+                .reshape(self.cap_lists, self.cap_elems, -1)[:L0, :E0] = src
+        self._dirty = True
+
+    def _register_actors(self, changes_by_doc) -> None:
+        """Host-mirror version of the base remap (act rows through perm,
+        clock columns re-gathered)."""
+        new = {c.actor for changes in changes_by_doc.values()
+               for c in changes}
+        new -= set(self.actors)
+        if not new:
+            return
+        old_actors = list(self.actors)
+        self.actors = sorted(set(self.actors) | new)
+        self.actor_rank = {a: i for i, a in enumerate(self.actors)}
+        if len(self.actors) > self.cap_actors:
+            self._grow(cap_actors=_pad_to(len(self.actors), 2))
+        if not old_actors or not getattr(self, "_rows_ready", False):
+            return
+        b = self._bases()
+        I, C, A = self.cap_ops, self.cap_changes, self.cap_actors
+        perm = np.array([self.actor_rank[a] for a in old_actors],
+                        dtype=np.int32)
+        act = self.rows_host[b["act"]:b["act"] + I]
+        om = self.rows_host[b["om"]:b["om"] + I]
+        safe = np.clip(act, 0, len(perm) - 1)
+        self.rows_host[b["act"]:b["act"] + I] = np.where(
+            om > 0, perm[safe], act)
+        clk = self.rows_host[b["clk"]:b["clk"] + C * A].reshape(C, A, -1)
+        remapped = np.zeros_like(clk)
+        for old_rank, new_rank in enumerate(perm):
+            remapped[:, new_rank] = clk[:, old_rank]
+        self.rows_host[b["clk"]:b["clk"] + C * A] = remapped.reshape(C * A, -1)
+        # actor ranks inside ins_log entries must follow the remap too
+        for log in self.ins_log:
+            for lrow, entries in log.items():
+                log[lrow] = [(s, e, int(perm[a]) if a < len(perm) else a, p)
+                             for (s, e, a, p) in entries]
+        self._dirty = True
+
+    # ------------------------------------------------------------------
+    # delta encoding to scatter triplets
+
+    def _reserve_for(self, rounds) -> None:
+        """Upper-bound capacity growth so row offsets stay fixed across the
+        whole micro-batch. Counts submitted changes PLUS every change still
+        buffered in the per-doc causal queues — a delta in this batch can
+        release queued changes from earlier calls, so admitted counts are
+        bounded by (queued + submitted), not by this batch alone."""
+        need_ops = self.op_count.copy()
+        need_ch = self.change_count.copy()
+        n_elems = {}
+        new_fids = {}
+        n_lists = {}
+
+        def count(i, c):
+            need_ch[i] += 1
+            need_ops[i] += len(c.ops)
+            # every op can mint at most one new field id (assigns on
+            # fresh keys, inserts minting their element's fid)
+            new_fids[i] = new_fids.get(i, 0) + len(c.ops)
+            for op in c.ops:
+                if op.action == "ins":
+                    n_elems[i] = n_elems.get(i, 0) + 1
+                elif op.action in ("makeList", "makeText"):
+                    n_lists[i] = n_lists.get(i, 0) + 1
+
+        for i, t in enumerate(self.tables):
+            for c in t.queue:
+                count(i, c)
+        for r in rounds:
+            for doc_id, changes in r.items():
+                i = self.doc_index[doc_id]
+                for c in changes:
+                    count(i, c)
+        grow = {}
+        if need_ops.max(initial=0) > self.cap_ops:
+            grow["cap_ops"] = _pad_to(int(need_ops.max()))
+        if need_ch.max(initial=0) > self.cap_changes:
+            grow["cap_changes"] = _pad_to(int(need_ch.max()))
+        cur_elems = max((len(s) for t in self.tables
+                         for s in t.elem_slots.values()), default=0)
+        add_elems = max(n_elems.values(), default=0)
+        if cur_elems + add_elems > self.cap_elems:
+            grow["cap_elems"] = _pad_to(cur_elems + add_elems)
+        cur_lists = max((len(t.list_rows) for t in self.tables), default=0)
+        add_lists = max(n_lists.values(), default=0)
+        if cur_lists + add_lists > self.cap_lists:
+            grow["cap_lists"] = _pad_to(cur_lists + add_lists, 1)
+        need_fids = max((len(self.tables[i].fields) + n
+                         for i, n in new_fids.items()), default=0)
+        if need_fids > self.cap_fids:
+            # cap_fids is only a static kernel parameter (field ids live in
+            # the rows themselves), so growing it costs a recompile, nothing
+            # else.
+            self.cap_fids = _pad_to(need_fids)
+        if grow:
+            self._grow(**grow)
+
+    def _round_triplets(self, changes_by_doc) -> np.ndarray:
+        """Encode one round into (P, 3) int32 scatter triplets
+        (row, doc, value) and apply them to the host mirror."""
+        b = self._bases()
+        A, E = self.cap_actors, self.cap_elems
+        rows, docs, vals = [], [], []
+
+        def put(r, d, v):
+            rows.append(r); docs.append(d); vals.append(int(v))
+
+        for doc_id, changes in changes_by_doc.items():
+            i = self.doc_index[doc_id]
+            delta = self._encode_delta(i, changes)
+            self.change_log[i].extend(delta.changes)
+            s0 = int(self.op_count[i])
+            for k, (code, fid, arank, seq, chg, _value, fh, vh) in enumerate(
+                    delta.ops):
+                s = s0 + k
+                put(b["om"] + s, i, 1)
+                put(b["ac"] + s, i, code)
+                put(b["fid"] + s, i, fid)
+                put(b["act"] + s, i, arank)
+                put(b["seq"] + s, i, seq)
+                put(b["chg"] + s, i, chg)
+                put(b["fh"] + s, i, fh)
+                put(b["vh"] + s, i, vh)
+            c0 = int(self.change_count[i])
+            for k, row in enumerate(delta.clocks):
+                c = c0 + k
+                for a in np.nonzero(row)[0]:
+                    put(b["clk"] + c * A + int(a), i, row[a])
+            for (lrow, oi, objhash) in delta.new_lists:
+                self.list_hash[i][lrow] = objhash
+            touched_lists = set()
+            for (lrow, slot, elem, arank, parent_slot, fid) in delta.ins:
+                self.ins_log[i].setdefault(lrow, []).append(
+                    (slot, elem, arank, parent_slot))
+                le = lrow * E + slot
+                put(b["im"] + le, i, 1)
+                put(b["if"] + le, i, fid)
+                put(b["io"] + le, i, self.list_hash[i][lrow])
+                touched_lists.add(lrow)
+            # re-linearize touched lists; ship fresh position rows
+            from ..native.linearize import linearize_host
+            for lrow in touched_lists:
+                entries = self.ins_log[i][lrow]
+                n = len(entries)
+                mask = np.ones(n, dtype=bool)
+                elem = np.array([e for (_, e, _, _) in entries], np.int32)
+                arank = np.array([a for (_, _, a, _) in entries], np.int32)
+                parent = np.array([p for (_, _, _, p) in entries], np.int32)
+                slots = [s for (s, _, _, _) in entries]
+                pos_by_order = linearize_host(mask, elem, arank, parent)
+                for idx, s in enumerate(slots):
+                    put(b["ip"] + lrow * E + s, i, pos_by_order[idx])
+            self.op_count[i] += len(delta.ops)
+            self.change_count[i] += len(delta.clocks)
+
+        trips = np.stack([np.asarray(rows, np.int32),
+                          np.asarray(docs, np.int32),
+                          np.asarray(vals, np.int32)], axis=1) \
+            if rows else np.zeros((0, 3), np.int32)
+        # mirror update
+        self.rows_host[trips[:, 0], trips[:, 1]] = trips[:, 2]
+        return trips
+
+    # ------------------------------------------------------------------
+    # device path
+
+    def apply_rounds(self, rounds, interpret: bool | None = None):
+        """Apply a micro-batch of sync rounds in ONE device dispatch.
+
+        rounds: list of {doc_id: [Change]} — applied in order, reconciling
+        after each. Returns np.ndarray [len(rounds), n_docs] uint32 state
+        hashes (one row per round).
+        """
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        for r in rounds:
+            self._register_actors(r)
+        self._reserve_for(rounds)
+        pre_dirty = self._dirty
+        pre_rows = self.rows_host.copy() if pre_dirty or self.rows_dev is None \
+            else None
+        trip_list = [self._round_triplets(r) for r in rounds]
+        p = _pad_to(max((len(t) for t in trip_list), default=1), 8)
+        oob = self._bases()["rows"]  # out-of-range row => dropped by scatter
+        stacked = np.full((len(rounds), p, 3), 0, dtype=np.int32)
+        for k, t in enumerate(trip_list):
+            stacked[k, :len(t)] = t
+            stacked[k, len(t):, 0] = oob
+        if pre_rows is not None:
+            self.rows_dev = jnp.asarray(pre_rows)
+            self._dirty = False
+        self.rows_dev, hashes = _scan_rounds(
+            self.rows_dev, jnp.asarray(stacked), self.dims(), interpret)
+        return np.asarray(hashes)[:, :len(self.doc_ids)]
+
+    def hashes(self, interpret: bool | None = None) -> np.ndarray:
+        """Current per-doc state hashes from resident state."""
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        if self.rows_dev is None or self._dirty:
+            self.rows_dev = jnp.asarray(self.rows_host)
+            self._dirty = False
+        return np.asarray(reconcile_rows_hash(
+            self.rows_dev, self.dims(), interpret))[:len(self.doc_ids)]
+
+    def materialize(self, doc_id: str):
+        """Snapshot one document by replaying its admitted change log
+        through the interpretive frontend (the slow/cold path; the hot path
+        is hash-only)."""
+        from .. import api
+        from ..frontend.materialize import apply_changes_to_doc
+
+        i = self.doc_index[doc_id]
+        doc = api.init("resident-view")
+        doc = apply_changes_to_doc(doc, doc._doc.opset, self.change_log[i],
+                                   incremental=False)
+        from .batchdoc import oracle_state
+        return oracle_state(doc)
+
+
+@partial(jax.jit, static_argnames=("dims", "interpret"),
+         donate_argnums=(0,))
+def _scan_rounds(rows, trips, dims, interpret):
+    """lax.scan over rounds: point-scatter the round's triplets, then
+    reconcile+hash — one dispatch for the whole micro-batch."""
+    def body(st, tr):
+        st = st.at[tr[:, 0], tr[:, 1]].set(tr[:, 2], mode="drop")
+        h = reconcile_rows_hash.__wrapped__(st, dims, interpret)
+        return st, h
+    return jax.lax.scan(body, rows, trips)

@@ -20,11 +20,14 @@ class ArrayReadOps:
     __slots__ = ()
 
     def concat(self, *others):
-        # JS Array.concat spreads arrays one level, everything else appends
-        # as a single element (strings/dicts/sets are NOT spread).
+        # JS Array.concat spreads arrays one level; everything else —
+        # including Text, which is not an Array in the reference — appends
+        # as a single element.
         out = list(self)
         for o in others:
-            if isinstance(o, (list, tuple, ArrayReadOps)):
+            if isinstance(o, (list, tuple)) or (
+                    isinstance(o, ArrayReadOps)
+                    and getattr(o, "_type", None) == "list"):
                 out.extend(o)
             else:
                 out.append(o)

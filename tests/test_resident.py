@@ -168,3 +168,154 @@ class TestReserve:
         caps = (r.cap_ops, r.cap_changes, r.cap_actors)
         r.reserve(ops_per_doc=1, changes_per_doc=1, actors=1)
         assert (r.cap_ops, r.cap_changes, r.cap_actors) == caps
+
+
+class TestResidentRows:
+    """Docs-minor resident state + micro-batched rounds (resident_rows.py)."""
+
+    def _mk_docs(self, n=4):
+        docs, logs = [], []
+        for i in range(n):
+            d1 = am.change(am.init("A"), lambda d, i=i: am.assign(
+                d, {"n": i, "xs": [1, 2]}))
+            d2 = am.merge(am.init("B"), d1)
+            d1 = am.change(d1, lambda d: d["xs"].insert_at(1, 99))
+            d2 = am.change(d2, lambda d, i=i: d.__setitem__("n", -i))
+            m = am.merge(d1, d2)
+            docs.append(m)
+            logs.append(m._doc.opset.get_missing_changes({}))
+        return docs, logs
+
+    def _from_scratch_hashes(self, logs):
+        from automerge_tpu.engine.encode import encode_doc, stack_docs
+        from automerge_tpu.engine.pack import apply_packed_hash, pack_batch
+        import jax
+        aa = sorted({c.actor for c2 in logs for c in c2})
+        b = stack_docs([encode_doc(c, aa) for c in logs])
+        mf = b.pop("max_fids")
+        flat, meta = pack_batch(b)
+        return np.asarray(apply_packed_hash(jax.numpy.asarray(flat), meta, mf))
+
+    def test_rounds_converge_with_from_scratch(self):
+        from automerge_tpu.engine.resident_rows import ResidentRowsDocSet
+        docs, logs = self._mk_docs()
+        ids = [f"d{i}" for i in range(len(docs))]
+        rset = ResidentRowsDocSet(ids)
+        rset.apply_rounds([{ids[i]: logs[i] for i in range(len(ids))}])
+        rounds = []
+        for rnd in range(3):
+            deltas = {}
+            for i in (0, 2):
+                prev = docs[i]
+                new = am.change(prev, lambda d, rnd=rnd, i=i: d.__setitem__(
+                    "n", rnd * 100 + i))
+                deltas[ids[i]] = new._doc.opset.get_missing_changes(
+                    prev._doc.opset.clock)
+                docs[i] = new
+            rounds.append(deltas)
+        hs = rset.apply_rounds(rounds)
+        assert hs.shape == (3, len(ids))
+        full = [d._doc.opset.get_missing_changes({}) for d in docs]
+        np.testing.assert_array_equal(hs[-1], self._from_scratch_hashes(full))
+
+    def test_new_actor_mid_flight_remaps(self):
+        from automerge_tpu.engine.resident_rows import ResidentRowsDocSet
+        docs, logs = self._mk_docs(2)
+        ids = ["d0", "d1"]
+        rset = ResidentRowsDocSet(ids)
+        rset.apply_rounds([{ids[i]: logs[i] for i in range(2)}])
+        # actor "AA" sorts before "B" but after "A": ranks shift
+        prev = docs[0]
+        other = am.merge(am.init("AA"), prev)
+        other = am.change(other, lambda d: d.__setitem__("n", 777))
+        merged = am.merge(prev, other)
+        delta = merged._doc.opset.get_missing_changes(prev._doc.opset.clock)
+        docs[0] = merged
+        hs = rset.apply_rounds([{ids[0]: delta}])
+        full = [d._doc.opset.get_missing_changes({}) for d in docs]
+        np.testing.assert_array_equal(hs[-1], self._from_scratch_hashes(full))
+
+    def test_capacity_growth_mid_batch(self):
+        from automerge_tpu.engine.resident_rows import ResidentRowsDocSet
+        docs, logs = self._mk_docs(2)
+        ids = ["d0", "d1"]
+        rset = ResidentRowsDocSet(ids)
+        rset.apply_rounds([{ids[i]: logs[i] for i in range(2)}])
+        cap_before = rset.cap_ops
+        rounds = []
+        for rnd in range(max(cap_before, 8)):
+            prev = docs[1]
+            new = am.change(prev, lambda d, rnd=rnd: d["xs"].insert_at(
+                0, rnd))
+            rounds.append({ids[1]: new._doc.opset.get_missing_changes(
+                prev._doc.opset.clock)})
+            docs[1] = new
+        hs = rset.apply_rounds(rounds)
+        assert rset.cap_ops > cap_before
+        full = [d._doc.opset.get_missing_changes({}) for d in docs]
+        np.testing.assert_array_equal(hs[-1], self._from_scratch_hashes(full))
+
+    def test_causal_buffering_across_rounds(self):
+        from automerge_tpu.engine.resident_rows import ResidentRowsDocSet
+        docs, logs = self._mk_docs(1)
+        ids = ["d0"]
+        rset = ResidentRowsDocSet(ids)
+        rset.apply_rounds([{ids[0]: logs[0]}])
+        prev = docs[0]
+        s1 = am.change(prev, lambda d: d.__setitem__("a", 1))
+        s2 = am.change(s1, lambda d: d.__setitem__("a", 2))
+        c1 = s1._doc.opset.get_missing_changes(prev._doc.opset.clock)
+        c2 = s2._doc.opset.get_missing_changes(s1._doc.opset.clock)
+        # deliver the later change first: round 1 must leave state unchanged
+        h_before = rset.hashes()
+        hs = rset.apply_rounds([{ids[0]: c2}, {ids[0]: c1}])
+        np.testing.assert_array_equal(hs[0], h_before)
+        full = [s2._doc.opset.get_missing_changes({})]
+        np.testing.assert_array_equal(hs[-1], self._from_scratch_hashes(full))
+
+    def test_materialize_matches_oracle(self):
+        from automerge_tpu.engine.batchdoc import oracle_state
+        from automerge_tpu.engine.resident_rows import ResidentRowsDocSet
+        from automerge_tpu.frontend.materialize import apply_changes_to_doc
+        docs, logs = self._mk_docs(2)
+        ids = ["d0", "d1"]
+        rset = ResidentRowsDocSet(ids)
+        rset.apply_rounds([{ids[i]: logs[i] for i in range(2)}])
+        for i in range(2):
+            doc = apply_changes_to_doc(am.init("o"), am.init("o")._doc.opset,
+                                       logs[i], incremental=False)
+            assert rset.materialize(ids[i]) == oracle_state(doc)
+
+    def test_second_list_reserves_cap_lists(self):
+        from automerge_tpu.engine.resident_rows import ResidentRowsDocSet
+        docs, logs = self._mk_docs(1)
+        ids = ["d0"]
+        rset = ResidentRowsDocSet(ids)
+        rset.apply_rounds([{ids[0]: logs[0]}])
+        prev = docs[0]
+        new = am.change(prev, lambda d: d.__setitem__("ys", [7, 8]))
+        delta = new._doc.opset.get_missing_changes(prev._doc.opset.clock)
+        docs[0] = new
+        hs = rset.apply_rounds([{ids[0]: delta}])
+        assert rset.cap_lists >= 2
+        full = [d._doc.opset.get_missing_changes({}) for d in docs]
+        np.testing.assert_array_equal(hs[-1], self._from_scratch_hashes(full))
+
+    def test_queued_changes_count_toward_reservation(self):
+        from automerge_tpu.engine.resident_rows import ResidentRowsDocSet
+        docs, logs = self._mk_docs(1)
+        ids = ["d0"]
+        rset = ResidentRowsDocSet(ids)
+        rset.apply_rounds([{ids[0]: logs[0]}])
+        prev = docs[0]
+        # c2 has many ops and depends on c1; deliver c2 first so it queues
+        s1 = am.change(prev, lambda d: d.__setitem__("k", 0))
+        s2 = am.change(s1, lambda d: am.assign(
+            d, {f"q{j}": j for j in range(12)}))
+        c1 = s1._doc.opset.get_missing_changes(prev._doc.opset.clock)
+        c2 = s2._doc.opset.get_missing_changes(s1._doc.opset.clock)
+        rset.apply_rounds([{ids[0]: c2}])           # buffers in the queue
+        hs = rset.apply_rounds([{ids[0]: c1}])      # releases c1 AND c2
+        assert int(rset.op_count[0]) <= rset.cap_ops
+        full = [s2._doc.opset.get_missing_changes({})]
+        np.testing.assert_array_equal(hs[-1], self._from_scratch_hashes(full))
