@@ -49,12 +49,41 @@ def content_hash(text: str) -> int:
     for incrementally-grown resident tables to agree with canonical ones."""
     h = _hash_memo.get(text)
     if h is None:
-        h = zlib.crc32(text.encode("utf-8")) & 0x7FFFFFFF
+        h = zlib.crc32(text.encode("utf-8", "surrogatepass")) & 0x7FFFFFFF
         if len(_hash_memo) < 1_000_000:
             _hash_memo[text] = h
         else:
             return h
     return h
+
+
+def value_bytes(value) -> bytes:
+    """Canonical type-tagged byte form of a scalar value, the input to
+    `value_hash_of`. Deliberately language-neutral (decimal ints, raw IEEE754
+    bits for floats, UTF-8/WTF-8 for strings) so the native C++ encoder
+    (native/deltaenc.cpp) produces identical hashes from the wire tokens
+    without reproducing Python repr()."""
+    if isinstance(value, tuple) and len(value) == 2 and value[0] == "__link__":
+        return b"l:" + value[1].encode("utf-8", "surrogatepass")
+    if value is None:
+        return b"n"
+    if value is True:
+        return b"b:1"
+    if value is False:
+        return b"b:0"
+    if isinstance(value, int):
+        return b"i:%d" % value
+    if isinstance(value, float):
+        import struct
+        return b"d:" + struct.pack("<d", value)
+    if isinstance(value, str):
+        return b"s:" + value.encode("utf-8", "surrogatepass")
+    return b"r:" + repr(value).encode("utf-8", "surrogatepass")
+
+
+def value_hash_of(value) -> int:
+    """31-bit content hash of a scalar value (see value_bytes)."""
+    return zlib.crc32(value_bytes(value)) & 0x7FFFFFFF
 
 
 def _pad_to(n: int, minimum: int = 8) -> int:
@@ -91,7 +120,7 @@ class ValueTable:
         self.keys = [self.keys[i] for i in order]
         self.values = [self.values[i] for i in order]
         self.index = {k: i for i, k in enumerate(self.keys)}
-        self.hashes = [content_hash(repr(k)) for k in self.keys]
+        self.hashes = [value_hash_of(v) for v in self.values]
 
     def id_of(self, value: Any) -> int:
         return self.index[self._key(value)]
