@@ -3,7 +3,7 @@
 The reference maintains this index as a persistent order-statistic skip list
 (/root/reference/src/skip_list.js) giving O(log n) key<->index queries. The
 TPU-native design replaces rank queries with tombstone bitmaps + prefix scans
-in the columnar engine (automerge_tpu/engine/listkernel.py); this host-side
+in the columnar engine (automerge_tpu/engine/kernels.py); this host-side
 structure only serves the interactive single-document frontend, where a flat
 array with a position dictionary is simpler and fast enough (O(n) worst-case
 updates, O(1) lookups). The public surface mirrors the skip list's:

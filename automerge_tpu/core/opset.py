@@ -265,7 +265,7 @@ def iter_list_elem_ids(state, object_id: str) -> Iterator[str]:
     deleted ones). Iterative preorder walk of the insertion tree — sequential
     text insertions form a chain as deep as the document, so recursion is not
     an option (the columnar engine linearizes the same tree with a sort-based
-    kernel instead, see engine/listkernel.py)."""
+    kernel instead, see engine/kernels.py)."""
     stack = [iter(insertions_after(state, object_id, HEAD))]
     while stack:
         nxt = next(stack[-1], None)
@@ -516,7 +516,7 @@ class OpSet:
     """Immutable CRDT state for one document (op_set.js:272-285).
 
     undo_pos / undo_stack / redo_stack live here (as in the reference) but are
-    maintained by the change-assembly layer (automerge_tpu/frontend/api.py),
+    maintained by the change-assembly layer (automerge_tpu/api.py),
     mirroring auto_api.js:41-111.
     """
 

@@ -60,9 +60,9 @@ def content_hash(text: str) -> int:
 def value_bytes(value) -> bytes:
     """Canonical type-tagged byte form of a scalar value, the input to
     `value_hash_of`. Deliberately language-neutral (decimal ints, raw IEEE754
-    bits for floats, UTF-8/WTF-8 for strings) so the native C++ encoder
-    (native/deltaenc.cpp) produces identical hashes from the wire tokens
-    without reproducing Python repr()."""
+    bits for floats, UTF-8/WTF-8 for strings) so a native C++ encoder can
+    produce identical hashes from the wire tokens without reproducing
+    Python repr()."""
     if isinstance(value, tuple) and len(value) == 2 and value[0] == "__link__":
         return b"l:" + value[1].encode("utf-8", "surrogatepass")
     if value is None:

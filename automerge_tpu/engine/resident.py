@@ -37,7 +37,7 @@ from ..core.change import Change
 from ..core.ids import ROOT_ID, HEAD, make_elem_id
 from .encode import (A_DEL, A_INS, A_LINK, A_MAKE_LIST, A_MAKE_MAP,
                      A_MAKE_TEXT, A_SET, ASSIGN_CODES, _ACTION_CODE,
-                     ValueTable, content_hash, _pad_to)
+                     ValueTable, content_hash, value_hash_of, _pad_to)
 from .kernels import apply_doc
 
 OP_COLS = ("op_mask", "action", "fid", "actor", "seq", "change_idx", "value",
@@ -315,10 +315,10 @@ class ResidentDocSet:
                     fh = content_hash(f"{op.obj}\x00{op.key}")
                     if code == A_SET:
                         value = t.value_id(op.value)
-                        vh = content_hash(repr(ValueTable._key(op.value)))
+                        vh = value_hash_of(op.value)
                     elif code == A_LINK:
                         value = t.value_id(("__link__", op.value))
-                        vh = content_hash(repr(ValueTable._key(("__link__", op.value))))
+                        vh = value_hash_of(("__link__", op.value))
                     else:
                         value = -1
                         vh = 0

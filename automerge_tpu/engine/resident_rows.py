@@ -295,6 +295,16 @@ class ResidentRowsDocSet(ResidentDocSet):
         rounds: list of {doc_id: [Change]} — applied in order, reconciling
         after each. Returns np.ndarray [len(rounds), n_docs] uint32 state
         hashes (one row per round).
+
+        Actor ranks are the sorted-string ranks of the WHOLE micro-batch's
+        actor universe (all rounds are registered before any is encoded, so
+        the scan runs as one device dispatch over fixed-shape rows).
+        Consequence: the hash reported for an intermediate round k is
+        computed under ranks that may include actors first appearing in
+        rounds > k, so it is only comparable to hashes produced under the
+        same final actor universe (e.g. other rows of this same call, or a
+        `hashes()` call after the batch). The FINAL round's hash always
+        equals the canonical post-batch hash.
         """
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
