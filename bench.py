@@ -21,22 +21,43 @@ Usage:
 
 Prints ONE final JSON line:
   {"metric": ..., "value": N, "unit": "ops/sec", "vs_baseline": N, ...}
+
+Robustness contract (VERDICT r1 #1): the top-level invocation NEVER crashes
+and ALWAYS emits the final JSON line, exit 0. The measurement itself runs in
+a worker subprocess (`--worker`): the TPU tunnel can hang (not just raise)
+during backend init, and a hang inside a C extension cannot be interrupted
+in-process. The parent enforces a wall-clock timeout, harvests per-config
+partial results the worker flushes as it goes, retries once on the default
+backend, then falls back to a CPU worker (`--force-cpu`, which must use
+`jax.config.update("jax_platforms", "cpu")` — the axon TPU plugin wins over
+the JAX_PLATFORMS env var in this image) to fill whatever is missing.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)) or ".")
 
-import numpy as np
+HEADLINE_METRIC = ("ops-applied/sec, 10K-doc DocSet merge with "
+                   "state-hash convergence parity")
 
-import automerge_tpu as am
-from automerge_tpu.engine.batchdoc import apply_batch, decode_doc, oracle_state
-from automerge_tpu.frontend.materialize import apply_changes_to_doc
+
+def _load_package():
+    """Import numpy/jax/automerge_tpu into module globals. Deferred so the
+    parent process never touches jax (backend init is the risky part) and so
+    a worker can pin the platform first."""
+    global np, am, apply_batch, decode_doc, oracle_state, apply_changes_to_doc
+    import numpy as np
+    import automerge_tpu as am
+    from automerge_tpu.engine.batchdoc import (apply_batch, decode_doc,
+                                               oracle_state)
+    from automerge_tpu.frontend.materialize import apply_changes_to_doc
 
 
 # ---------------------------------------------------------------------------
@@ -153,15 +174,32 @@ def count_ops(doc_changes):
     return sum(len(c.ops) for changes in doc_changes for c in changes)
 
 
-def run_oracle(doc_changes, repeat=1):
-    """Single-threaded interpretive baseline: full from-scratch apply +
+def _oracle_apply(doc_changes):
+    """One interpretive-baseline pass: full from-scratch apply +
     materialization per document (what the JS reference does on load/merge)."""
+    for changes in doc_changes:
+        doc = am.init("bench")
+        apply_changes_to_doc(doc, doc._doc.opset, changes, incremental=False)
+
+
+def run_oracle(doc_changes, repeat=1):
     t0 = time.perf_counter()
     for _ in range(repeat):
-        for changes in doc_changes:
-            doc = am.init("bench")
-            apply_changes_to_doc(doc, doc._doc.opset, changes, incremental=False)
+        _oracle_apply(doc_changes)
     return (time.perf_counter() - t0) / repeat
+
+
+def run_oracle_split(doc_changes):
+    """Like run_oracle but times the two halves of the single pass
+    separately, so per-doc linearity can be checked without re-running
+    anything. Returns (total_s, first_half_s, second_half_s, n_first)."""
+    n_first = max(1, len(doc_changes) // 2)
+    t0 = time.perf_counter()
+    _oracle_apply(doc_changes[:n_first])
+    t1 = time.perf_counter()
+    _oracle_apply(doc_changes[n_first:])
+    t2 = time.perf_counter()
+    return t2 - t0, t1 - t0, t2 - t1, n_first
 
 
 def run_engine(doc_changes, repeat=10):
@@ -418,13 +456,24 @@ def run_config(cfg: int, n_docs: int | None = None, oracle_cap_docs=1000):
     gen_time = time.perf_counter() - gen_t0
     ops = count_ops(doc_changes)
 
-    # Oracle on a capped subset, extrapolated linearly (it is O(n) in docs).
+    # Oracle on a capped subset, extrapolated linearly. The linearity is
+    # *checked empirically* each run (VERDICT r1 weak #5): the single oracle
+    # pass is timed in two halves and the per-doc ratio second/first is
+    # reported as oracle_linearity (1.0 = perfectly linear; >1 means per-doc
+    # cost GROWS with docs processed, so linear extrapolation UNDERestimates
+    # the full-size oracle and the reported speedup is conservative; <1 the
+    # reverse).
+    linearity = None
     if len(doc_changes) > oracle_cap_docs:
         subset = doc_changes[:oracle_cap_docs]
         scale = len(doc_changes) / len(subset)
+        cap_time, first_s, second_s, n_first = run_oracle_split(subset)
+        linearity = round((second_s / max(len(subset) - n_first, 1))
+                          / (first_s / n_first), 3)
+        oracle_time = cap_time * scale
     else:
         subset, scale = doc_changes, 1.0
-    oracle_time = run_oracle(subset) * scale
+        oracle_time = run_oracle(subset)
 
     engine_time, device_time, encode_time = run_engine(doc_changes)
     check_parity(doc_changes)
@@ -451,6 +500,8 @@ def run_config(cfg: int, n_docs: int | None = None, oracle_cap_docs=1000):
         "name": name,
         "docs": len(doc_changes),
         "ops": ops,
+        **({"oracle_linearity": linearity,
+            "oracle_extrapolated_from": len(subset)} if linearity else {}),
         "gen_s": round(gen_time, 3),
         "encode_s": round(encode_time, 4),
         "oracle_s": round(oracle_time, 4),
@@ -465,45 +516,200 @@ def run_config(cfg: int, n_docs: int | None = None, oracle_cap_docs=1000):
     }
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--config", type=int, default=5)
-    ap.add_argument("--docs", type=int, default=None)
-    ap.add_argument("--all", action="store_true")
-    args = ap.parse_args()
+def _final_record(results_by_cfg: dict, backend: str | None, attempts: list):
+    """Assemble the single final JSON record from whatever completed."""
+    results = [results_by_cfg[k] for k in sorted(results_by_cfg)]
+    headline = results_by_cfg.get(5) or (results[-1] if results else None)
+    rec = {
+        "metric": HEADLINE_METRIC,
+        "value": headline["engine_ops_per_s"] if headline else 0,
+        # Backend the HEADLINE number was measured on (per-config backends
+        # are in "configs" — attempts can mix tpu and cpu-fallback results).
+        "backend": (headline or {}).get("backend") or backend or "none",
+        "unit": "ops/sec",
+        "vs_baseline": headline["speedup"] if headline else 0.0,
+        "baseline": ("single-threaded interpretive engine "
+                     "(no Node in image; see bench.py docstring)"),
+        "configs": {str(r["config"]): {"speedup": r["speedup"],
+                                       "device_speedup": r["device_speedup"],
+                                       "engine_ops_per_s": r["engine_ops_per_s"],
+                                       "backend": r.get("backend")}
+                    for r in results},
+    }
+    if headline:
+        rec["device_resident_ops_per_s"] = headline["device_ops_per_s"]
+        rec["device_resident_vs_baseline"] = headline["device_speedup"]
+        rec["incremental_sync"] = {
+            k: headline[k] for k in
+            ("resident_round_s", "resident_oracle_round_s",
+             "resident_round_ops", "resident_speedup") if k in headline}
+        if "oracle_linearity" in headline:
+            rec["oracle_linearity"] = headline["oracle_linearity"]
+        rec["note"] = ("end-to-end figure is dominated by the tunneled "
+                       "single-chip host<->device roundtrip (~100ms/pass); "
+                       "the device reconcile itself takes device_s")
+    if attempts:
+        rec["attempts"] = attempts
+    return rec
 
-    results = []
+
+def worker_main(args):
+    """Run the measurements. Streams one `RESULT {json}` line per finished
+    config and a `FINAL {json}` line at the end, all flushed immediately so
+    the parent keeps partial results if a later config hangs or dies."""
+    import jax
+    if args.force_cpu:
+        # The axon TPU plugin overrides the JAX_PLATFORMS env var in this
+        # image; only the config API reliably pins the CPU backend.
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        backend = jax.default_backend()
+    except Exception as e:  # plugin raised at init: pin CPU and go on
+        print(f"# backend init failed ({e!r}); pinning cpu", file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+        backend = jax.default_backend()
+    print(f"BACKEND {backend}", flush=True)
+    _load_package()
+
+    rc = 0
     configs = list(CONFIGS) if args.all else [args.config]
     for cfg in configs:
-        r = run_config(cfg, n_docs=args.docs)
-        results.append(r)
+        if cfg in args.skip:
+            continue
+        try:
+            r = run_config(cfg, n_docs=args.docs)
+            r["backend"] = backend
+        except Exception as e:
+            rc = 1
+            print(f"ERROR {json.dumps({'config': cfg, 'error': repr(e)[:400]})}",
+                  flush=True)
+            continue
         print(f"# config {cfg} [{r['name']}]: {r['ops']} ops, "
               f"oracle {r['oracle_s']:.3f}s, engine {r['engine_s']:.3f}s "
               f"(device {r['device_s']*1000:.2f}ms), "
               f"speedup {r['speedup']}x end-to-end / {r['device_speedup']}x "
               f"device-resident, parity OK", file=sys.stderr)
+        print(f"RESULT {json.dumps(r)}", flush=True)
+    print("FINAL done", flush=True)
+    sys.exit(rc)
 
-    headline = next((r for r in results if r["config"] == 5), results[-1])
-    import jax
-    print(json.dumps({
-        "metric": "ops-applied/sec, 10K-doc DocSet merge with state-hash convergence parity",
-        "value": headline["engine_ops_per_s"],
-        "unit": "ops/sec",
-        "vs_baseline": headline["speedup"],
-        "baseline": "single-threaded interpretive engine (no Node in image; see bench.py docstring)",
-        "backend": jax.default_backend(),
-        "device_resident_ops_per_s": headline["device_ops_per_s"],
-        "device_resident_vs_baseline": headline["device_speedup"],
-        "incremental_sync": {k: headline[k] for k in
-                             ("resident_round_s", "resident_oracle_round_s",
-                              "resident_round_ops", "resident_speedup")
-                             if k in headline},
-        "note": "end-to-end figure is dominated by the tunneled single-chip host<->device roundtrip (~100ms/pass); the device reconcile itself takes device_s",
-        "configs": {str(r["config"]): {"speedup": r["speedup"],
-                                       "device_speedup": r["device_speedup"],
-                                       "engine_ops_per_s": r["engine_ops_per_s"]}
-                    for r in results},
-    }))
+
+def parent_main(args, passthrough: list[str]):
+    """Never-crash orchestrator: worker subprocess per attempt, wall-clock
+    timeout, partial-result harvesting, CPU fallback, exit 0 always."""
+    # Total wall-clock budget shared by all attempts (deadline-based: a hung
+    # TPU attempt consumes only its share, leaving room for the CPU fallback).
+    total_budget = int(os.environ.get("AMTPU_BENCH_TIMEOUT", "3000"))
+    deadline = time.time() + total_budget
+    results_by_cfg: dict[int, dict] = {}
+    errors: list[dict] = []
+    attempts: list[dict] = []
+    backend_used = None
+
+    plan = ((1, False), (2, False), (3, True))
+    for attempt, force_cpu in plan:
+        done_cfgs = set(results_by_cfg)
+        want = set(CONFIGS) if args.all else {args.config}
+        if want <= done_cfgs:
+            break
+        remaining = deadline - time.time()
+        if remaining < 20:
+            break
+        # Short on time: spend what's left on the reliable CPU attempt
+        # rather than burning it on a possibly-hanging TPU tunnel.
+        if remaining < 240 and not force_cpu:
+            continue
+        attempts_left = len(plan) - attempt + 1
+        budget = (max(20, int(remaining)) if force_cpu
+                  else max(60, int(remaining / attempts_left)))
+        cmd = [sys.executable, os.path.abspath(__file__), "--worker",
+               *passthrough,
+               "--skip", ",".join(str(c) for c in sorted(done_cfgs))]
+        if force_cpu:
+            cmd.append("--force-cpu")
+        t0 = time.time()
+        backend = None
+        finished = False
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=budget)
+            out, err, rc = proc.stdout, proc.stderr, proc.returncode
+        except subprocess.TimeoutExpired as e:
+            out = (e.stdout or b"").decode("utf-8", "replace") \
+                if isinstance(e.stdout, bytes) else (e.stdout or "")
+            err = (e.stderr or b"").decode("utf-8", "replace") \
+                if isinstance(e.stderr, bytes) else (e.stderr or "")
+            rc = "timeout"
+        except Exception as e:  # spawn failure itself
+            out, err, rc = "", repr(e), "spawn-error"
+        for line in err.splitlines()[-40:]:
+            print(f"[worker {attempt}] {line}", file=sys.stderr)
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                try:
+                    r = json.loads(line[len("RESULT "):])
+                    # Keep the first (preferred-backend) result per config.
+                    results_by_cfg.setdefault(r["config"], r)
+                except Exception:
+                    pass
+            elif line.startswith("ERROR "):
+                try:
+                    errors.append(json.loads(line[len("ERROR "):]))
+                except Exception:
+                    pass
+            elif line.startswith("BACKEND "):
+                backend = line.split(None, 1)[1].strip()
+                backend_used = backend_used or backend
+            elif line.startswith("FINAL "):
+                finished = True
+        attempts.append({"attempt": attempt, "force_cpu": force_cpu,
+                         "rc": rc, "backend": backend,
+                         "elapsed_s": round(time.time() - t0, 1)})
+        if finished and rc == 0:
+            break
+
+    rec = _final_record(results_by_cfg, backend_used, attempts)
+    # Only report errors for configs that never produced a result (a retry
+    # or the CPU fallback may have succeeded after an earlier failure).
+    unresolved = [e for e in errors if e.get("config") not in results_by_cfg]
+    if unresolved:
+        rec["errors"] = unresolved[:10]
+    print(json.dumps(rec))
+    sys.exit(0)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, default=5)
+    ap.add_argument("--docs", type=int, default=None)
+    ap.add_argument("--all", action="store_true")
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--force-cpu", action="store_true")
+    ap.add_argument("--skip", type=lambda s: {int(x) for x in s.split(",") if x},
+                    default=set())
+    args = ap.parse_args()
+
+    if args.worker:
+        worker_main(args)
+        return
+
+    passthrough = []
+    if args.all:
+        passthrough.append("--all")
+    else:
+        passthrough += ["--config", str(args.config)]
+    if args.docs:
+        passthrough += ["--docs", str(args.docs)]
+    try:
+        parent_main(args, passthrough)
+    except SystemExit:
+        raise
+    except Exception as e:  # absolute backstop: still one JSON line, rc 0
+        print(json.dumps({"metric": HEADLINE_METRIC, "value": 0,
+                          "unit": "ops/sec", "vs_baseline": 0.0,
+                          "backend": "unknown",
+                          "error": repr(e)[:500]}))
+        sys.exit(0)
 
 
 if __name__ == "__main__":
