@@ -109,9 +109,13 @@ class ResidentDocSet:
         self.cap_elems = 8
         self.cap_actors = 2
         self.cap_fids = 8
+        # Doc-axis capacity: exact at construction (a fixed fleet pays no
+        # padding), grown with pow2 slack by add_docs so a service
+        # auto-creating docs recompiles O(log n) times, not per doc.
+        self.cap_docs = max(n, 1)
 
-        self.op_count = np.zeros(n, dtype=np.int64)
-        self.change_count = np.zeros(n, dtype=np.int64)
+        self.op_count = np.zeros(self.cap_docs, dtype=np.int64)
+        self.change_count = np.zeros(self.cap_docs, dtype=np.int64)
 
         self.state: dict[str, jnp.ndarray] = {}
         self._alloc()
@@ -119,7 +123,7 @@ class ResidentDocSet:
 
     # ------------------------------------------------------------------
     def _alloc(self):
-        n = len(self.doc_ids)
+        n = self.cap_docs
         z = jnp.zeros
         self.state = {
             "op_mask": z((n, self.cap_ops), dtype=bool),
@@ -173,6 +177,37 @@ class ResidentDocSet:
             if d_l:
                 s["list_obj"] = pad(s["list_obj"], ((0, 0), (0, d_l)), -1)
                 s["list_obj_hash"] = pad(s["list_obj_hash"], ((0, 0), (0, d_l)), -1)
+
+    # ------------------------------------------------------------------
+    def add_docs(self, new_ids: list[str]) -> None:
+        """Grow the document axis (a sync service auto-creates docs the way
+        DocSet.apply_changes does, doc_set.js:24-29). Capacity doubles past
+        the current cap, so array shapes — and therefore XLA compilations —
+        change O(log n) times as docs trickle in; rows between len(doc_ids)
+        and cap_docs are valid empty documents."""
+        fresh = [d for d in new_ids if d not in self.doc_index]
+        if not fresh:
+            return
+        for d in fresh:
+            self.doc_index[d] = len(self.doc_ids)
+            self.doc_ids.append(d)
+            self.tables.append(DocTables())
+        if len(self.doc_ids) <= self.cap_docs:
+            self._out = None
+            return
+        k = _pad_to(len(self.doc_ids), 8) - self.cap_docs
+        self.cap_docs += k
+        self.op_count = np.concatenate([self.op_count, np.zeros(k, np.int64)])
+        self.change_count = np.concatenate([self.change_count,
+                                            np.zeros(k, np.int64)])
+        fills = {"op_mask": False, "action": -1, "fid": -1, "value": -1,
+                 "ins_mask": False, "ins_parent": -1, "ins_fid": -1,
+                 "list_obj": -1, "list_obj_hash": -1}
+        self.state = {
+            name: jnp.pad(arr, ((0, k),) + ((0, 0),) * (arr.ndim - 1),
+                          constant_values=fills.get(name, 0))
+            for name, arr in self.state.items()}
+        self._out = None
 
     # ------------------------------------------------------------------
     def reserve(self, *, ops_per_doc: int | None = None,
@@ -336,11 +371,13 @@ class ResidentDocSet:
         self._out = None
 
     def _build_delta_arrays(self, changes_by_doc: dict[str, list[Change]]):
-        n = len(self.doc_ids)
+        n = self.cap_docs
         deltas = [Delta() for _ in range(n)]
+        self.last_admitted: dict[str, list[Change]] = {}
         for doc_id, changes in changes_by_doc.items():
             i = self.doc_index[doc_id]
             deltas[i] = self._encode_delta(i, changes)
+            self.last_admitted[doc_id] = deltas[i].changes
 
         # capacity checks
         need_ops = int(max((self.op_count[i] + len(d.ops)
@@ -419,13 +456,21 @@ class ResidentDocSet:
         self.state, out = _scatter_and_apply(self.state, flat, meta,
                                              max_fids=self.cap_fids)
         self._out = out
-        return np.asarray(out["hash"])
+        return np.asarray(out["hash"])[:len(self.doc_ids)]
 
     def reconcile(self):
         """Run the reconcile kernel over resident state; returns per-doc
         uint32 hashes (numpy, aligned with doc_ids)."""
         self._out = apply_doc(self.state, self.cap_fids)
-        return np.asarray(self._out["hash"])
+        return np.asarray(self._out["hash"])[:len(self.doc_ids)]
+
+    def hashes(self) -> np.ndarray:
+        """Per-doc state hashes, reusing the cached reconcile output when no
+        delta has been applied since (a polling consumer should not pay a
+        device dispatch per read)."""
+        if self._out is None:
+            return self.reconcile()
+        return np.asarray(self._out["hash"])[:len(self.doc_ids)]
 
     def materialize(self, doc_id: str) -> Any:
         """Decode one document from resident state + reconcile outputs."""

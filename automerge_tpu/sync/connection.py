@@ -30,9 +30,20 @@ from ..core.change import coerce_change
 
 
 class Connection:
-    def __init__(self, doc_set, send_msg: Callable[[dict], None]):
+    def __init__(self, doc_set, send_msg: Callable[[dict], None],
+                 wire: str = "json"):
+        """wire="json" sends changes as reference-protocol per-op dicts;
+        wire="columnar" sends them as one binary columnar frame per message
+        (msg["frame"], see sync/frames.py). automerge_tpu receivers
+        auto-detect the form, so two automerge_tpu nodes interoperate
+        whatever each side emits. A genuine reference-JS peer only parses
+        JSON: talk to it with wire="json" (its messages are always accepted
+        here; the mode only selects what THIS side emits)."""
+        if wire not in ("json", "columnar"):
+            raise ValueError(f"unknown wire mode {wire!r}")
         self._doc_set = doc_set
         self._send_msg = send_msg
+        self._wire = wire
         self._their_clock: dict[str, dict[str, int]] = {}
         self._our_clock: dict[str, dict[str, int]] = {}
 
@@ -58,7 +69,11 @@ class Connection:
         msg: dict = {"docId": doc_id, "clock": dict(clock)}
         self._our_clock = self._clock_union(self._our_clock, doc_id, clock)
         if changes is not None:
-            msg["changes"] = [c.to_dict() for c in changes]
+            if self._wire == "columnar":
+                from .frames import encode_frame
+                msg["frame"] = encode_frame(changes)
+            else:
+                msg["changes"] = [c.to_dict() for c in changes]
         self._send_msg(msg)
 
     def maybe_send_changes(self, doc_id: str) -> None:
@@ -101,6 +116,15 @@ class Connection:
         if msg.get("clock") is not None:
             self._their_clock = self._clock_union(self._their_clock, doc_id,
                                                   msg["clock"])
+        if msg.get("frame") is not None:
+            from .frames import decode_frame
+            cols = decode_frame(msg["frame"])
+            # DocSets exposing a column ingress get the decoded columns
+            # as-is (the engine service's native-encoder seam); plain
+            # DocSets materialize changes from them.
+            if hasattr(self._doc_set, "apply_columns"):
+                return self._doc_set.apply_columns(doc_id, cols)
+            return self._doc_set.apply_changes(doc_id, cols.to_changes())
         if msg.get("changes") is not None:
             return self._doc_set.apply_changes(
                 doc_id, [coerce_change(c) for c in msg["changes"]])

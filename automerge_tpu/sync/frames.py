@@ -1,0 +1,256 @@
+"""Columnar wire frames — the native sync wire format.
+
+The reference ships changes as per-op JSON objects
+(/root/reference/src/connection.js:58-63 via getChanges/applyChanges,
+README.md:349-360). A TPU-native sync service wants the opposite shape: the
+wire IS the columnar batch. A frame is a self-contained binary serialization
+of a change list as struct-of-arrays — integer columns plus frame-local
+string tables — so that:
+
+- decode is a handful of `np.frombuffer` views (no per-op parsing at all);
+- the receiver can feed columns straight to the engine's delta encoder
+  (ResidentDocSet.apply_columns / the native deltaenc) without materializing
+  per-op Python objects;
+- relaying a frame to another peer is `columns_to_bytes` over the already-
+  decoded columns — again no per-op work;
+- values keep their exact types (int vs float vs bool survive, unlike JSON).
+
+The column schema is exactly `native.wire.WireColumns` — the same layout the
+native JSON parser produces — so JSON ingress and frame ingress meet in one
+representation.
+
+Layout (little-endian):
+    magic  b"AMW1"
+    u32 x 8   n_changes n_ops n_deps n_actors n_objects n_keys n_messages n_strings
+    i32[n_changes]    change_actor
+    i32[n_changes]    change_seq
+    i32[n_changes]    change_msg      (-1 = no message)
+    i32[n_changes+1]  deps_off
+    i32[n_deps]       deps_actor
+    i32[n_deps]       deps_seq
+    i32[n_changes+1]  op_off
+    i8 [n_ops]        op_action       (storage._ACTIONS index)
+    i32[n_ops]        op_obj
+    i32[n_ops]        op_key          (-1 = none)
+    i32[n_ops]        op_elem         (-1 = none)
+    i8 [n_ops]        op_vtag         (native.wire V_* tag)
+    i64[n_ops]        op_vint
+    f64[n_ops]        op_vdbl
+    i32[n_ops]        op_vstr
+    5 string tables (actors, objects, keys, messages, strings), each:
+        i32[n+1] byte offsets, then the UTF-8/WTF-8 blob (offsets[n] bytes)
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from ..core.change import Change
+from ..native.wire import (V_BIGINT, V_DOUBLE, V_FALSE, V_INT, V_NONE, V_NULL,
+                           V_STR, V_TRUE, WireColumns)
+from ..storage import _ACTION_IDX
+
+FRAME_MAGIC = b"AMW1"
+
+_I64_MIN = -(2 ** 63)
+_I64_MAX = 2 ** 63 - 1
+
+
+class _Interner:
+    """Frame-local string table (insertion-ordered)."""
+
+    def __init__(self):
+        self.index: dict[str, int] = {}
+        self.items: list[str] = []
+
+    def add(self, s: str) -> int:
+        i = self.index.get(s)
+        if i is None:
+            i = len(self.items)
+            self.index[s] = i
+            self.items.append(s)
+        return i
+
+
+def changes_to_columns(changes: list[Change]) -> WireColumns:
+    """Encode Change objects as columns (the send-side per-op pass — the
+    analog of the per-op dict building JSON senders pay in to_dict)."""
+    actors, objects, keys, messages, strings = (
+        _Interner(), _Interner(), _Interner(), _Interner(), _Interner())
+    n = len(changes)
+    change_actor = np.zeros(n, np.int32)
+    change_seq = np.zeros(n, np.int32)
+    change_msg = np.full(n, -1, np.int32)
+    deps_off = np.zeros(n + 1, np.int32)
+    op_off = np.zeros(n + 1, np.int32)
+    deps_actor: list[int] = []
+    deps_seq: list[int] = []
+    op_action: list[int] = []
+    op_obj: list[int] = []
+    op_key: list[int] = []
+    op_elem: list[int] = []
+    op_vtag: list[int] = []
+    op_vint: list[int] = []
+    op_vdbl: list[float] = []
+    op_vstr: list[int] = []
+
+    for i, c in enumerate(changes):
+        change_actor[i] = actors.add(c.actor)
+        change_seq[i] = c.seq
+        if c.message is not None:
+            change_msg[i] = messages.add(c.message)
+        for a, s in c.deps.items():
+            deps_actor.append(actors.add(a))
+            deps_seq.append(int(s))
+        deps_off[i + 1] = len(deps_actor)
+        for op in c.ops:
+            op_action.append(_ACTION_IDX[op.action])
+            op_obj.append(objects.add(op.obj))
+            op_key.append(keys.add(op.key) if op.key is not None else -1)
+            op_elem.append(int(op.elem) if op.elem is not None else -1)
+            tag, vi, vd, vs = _encode_value(op, strings)
+            op_vtag.append(tag)
+            op_vint.append(vi)
+            op_vdbl.append(vd)
+            op_vstr.append(vs)
+        op_off[i + 1] = len(op_action)
+
+    return WireColumns(
+        change_actor=change_actor, change_seq=change_seq,
+        change_msg=change_msg, deps_off=deps_off,
+        deps_actor=np.asarray(deps_actor, np.int32),
+        deps_seq=np.asarray(deps_seq, np.int32),
+        op_off=op_off,
+        op_action=np.asarray(op_action, np.int8),
+        op_obj=np.asarray(op_obj, np.int32),
+        op_key=np.asarray(op_key, np.int32),
+        op_elem=np.asarray(op_elem, np.int32),
+        op_vtag=np.asarray(op_vtag, np.int8),
+        op_vint=np.asarray(op_vint, np.int64),
+        op_vdbl=np.asarray(op_vdbl, np.float64),
+        op_vstr=np.asarray(op_vstr, np.int32),
+        actors=actors.items, objects=objects.items, keys=keys.items,
+        messages=messages.items, strings=strings.items)
+
+
+def _encode_value(op, strings: _Interner):
+    """(vtag, vint, vdbl, vstr) for one op, matching WireColumns.op_value."""
+    if op.action not in ("set", "link"):
+        return V_NONE, 0, 0.0, -1
+    v = op.value
+    if v is None:
+        return V_NULL, 0, 0.0, -1
+    if v is True:
+        return V_TRUE, 0, 0.0, -1
+    if v is False:
+        return V_FALSE, 0, 0.0, -1
+    if isinstance(v, int):
+        if _I64_MIN <= v <= _I64_MAX:
+            return V_INT, v, 0.0, -1
+        return V_BIGINT, 0, 0.0, strings.add(str(v))
+    if isinstance(v, float):
+        return V_DOUBLE, 0, float(v), -1
+    if isinstance(v, str):
+        return V_STR, 0, 0.0, strings.add(v)
+    raise TypeError(f"unsupported scalar value on the wire: {type(v).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# columns <-> bytes
+
+def _blob(items: list[str]) -> tuple[np.ndarray, bytes]:
+    offsets = np.zeros(len(items) + 1, np.int32)
+    parts = []
+    pos = 0
+    for i, s in enumerate(items):
+        b = s.encode("utf-8", "surrogatepass")
+        parts.append(b)
+        pos += len(b)
+        offsets[i + 1] = pos
+    return offsets, b"".join(parts)
+
+
+def columns_to_bytes(cols: WireColumns) -> bytes:
+    """Serialize columns into one frame. No per-op work — numpy buffer
+    concatenation, so relaying a decoded frame costs O(columns), not O(ops)."""
+    n_changes = len(cols.change_actor)
+    n_ops = len(cols.op_action)
+    n_deps = len(cols.deps_actor)
+    head = FRAME_MAGIC + struct.pack(
+        "<8I", n_changes, n_ops, n_deps, len(cols.actors), len(cols.objects),
+        len(cols.keys), len(cols.messages), len(cols.strings))
+    parts = [head]
+    for arr, dtype in (
+            (cols.change_actor, np.int32), (cols.change_seq, np.int32),
+            (cols.change_msg, np.int32), (cols.deps_off, np.int32),
+            (cols.deps_actor, np.int32), (cols.deps_seq, np.int32),
+            (cols.op_off, np.int32), (cols.op_action, np.int8),
+            (cols.op_obj, np.int32), (cols.op_key, np.int32),
+            (cols.op_elem, np.int32), (cols.op_vtag, np.int8),
+            (cols.op_vint, np.int64), (cols.op_vdbl, np.float64),
+            (cols.op_vstr, np.int32)):
+        parts.append(np.ascontiguousarray(arr, dtype=dtype).tobytes())
+    for items in (cols.actors, cols.objects, cols.keys, cols.messages,
+                  cols.strings):
+        offsets, blob = _blob(items)
+        parts.append(offsets.tobytes())
+        parts.append(blob)
+    return b"".join(parts)
+
+
+def bytes_to_columns(data: bytes) -> WireColumns:
+    """Deserialize a frame: `np.frombuffer` views over the payload (copy-free
+    for the integer columns) plus the five string tables."""
+    if data[:4] != FRAME_MAGIC:
+        raise ValueError("not a columnar wire frame (bad magic)")
+    (n_changes, n_ops, n_deps, n_actors, n_objects, n_keys, n_messages,
+     n_strings) = struct.unpack_from("<8I", data, 4)
+    pos = 4 + 32
+
+    def arr(n, dtype):
+        nonlocal pos
+        nbytes = n * np.dtype(dtype).itemsize
+        out = np.frombuffer(data, dtype=dtype, count=n, offset=pos)
+        pos += nbytes
+        return out
+
+    def table(n):
+        nonlocal pos
+        offsets = arr(n + 1, np.int32)
+        blob_len = int(offsets[-1]) if n else 0
+        blob = data[pos:pos + blob_len]
+        pos += blob_len
+        return [blob[offsets[i]:offsets[i + 1]].decode("utf-8", "surrogatepass")
+                for i in range(n)]
+
+    cols = WireColumns(
+        change_actor=arr(n_changes, np.int32),
+        change_seq=arr(n_changes, np.int32),
+        change_msg=arr(n_changes, np.int32),
+        deps_off=arr(n_changes + 1, np.int32),
+        deps_actor=arr(n_deps, np.int32),
+        deps_seq=arr(n_deps, np.int32),
+        op_off=arr(n_changes + 1, np.int32),
+        op_action=arr(n_ops, np.int8),
+        op_obj=arr(n_ops, np.int32),
+        op_key=arr(n_ops, np.int32),
+        op_elem=arr(n_ops, np.int32),
+        op_vtag=arr(n_ops, np.int8),
+        op_vint=arr(n_ops, np.int64),
+        op_vdbl=arr(n_ops, np.float64),
+        op_vstr=arr(n_ops, np.int32),
+        actors=table(n_actors), objects=table(n_objects), keys=table(n_keys),
+        messages=table(n_messages), strings=table(n_strings))
+    if pos != len(data):
+        raise ValueError(f"frame has {len(data) - pos} trailing bytes")
+    return cols
+
+
+def encode_frame(changes: list[Change]) -> bytes:
+    return columns_to_bytes(changes_to_columns(changes))
+
+
+def decode_frame(data: bytes) -> WireColumns:
+    return bytes_to_columns(data)

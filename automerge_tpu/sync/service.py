@@ -1,0 +1,159 @@
+"""EngineDocSet: a DocSet whose truth lives in the device-resident engine.
+
+This is the keystone of the columnar-wire design (VERDICT r1 #3): a sync
+node where the documents are NOT interpretive host objects but rows of a
+`ResidentDocSet` — columnar op tables resident in device memory, reconciled
+by the fused survivor-analysis kernel. Peers talk to it through the ordinary
+`Connection` protocol (src/connection.js:58-113 message schema); with
+`wire="columnar"` the changes cross the network as binary columnar frames
+(sync/frames.py) and are scattered into device state without ever becoming
+per-op JSON.
+
+What stays on the host: the per-doc admitted change log (required to re-serve
+`getMissingChanges` to lagging peers — the reference keeps the same log in
+`states`, src/op_set.js:279) and the per-doc clocks that drive the
+anti-entropy protocol. What lives on the device: every op/clock/insertion row
+plus the converged state and its hash.
+
+Duck-typing contract with Connection: `doc_ids`, `get_doc` (returns a handle
+whose `._doc.opset` exposes `clock` / `get_missing_changes`),
+`apply_changes`, `apply_columns` (columnar fast path), `register_handler` /
+`unregister_handler`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from ..core.change import Change
+from ..engine.resident import ResidentDocSet
+
+
+class _HandleOpSet:
+    """The slice of the OpSet read surface the sync protocol needs."""
+
+    def __init__(self, service: "EngineDocSet", doc_id: str):
+        self._service = service
+        self._doc_id = doc_id
+
+    @property
+    def clock(self) -> dict[str, int]:
+        return self._service.clock_of(self._doc_id)
+
+    def get_missing_changes(self, clock: dict[str, int]) -> list[Change]:
+        return self._service.missing_changes(self._doc_id, clock)
+
+
+class DocHandle:
+    """Lightweight stand-in for an interactive document: enough surface for
+    Connection (doc._doc.opset) plus on-demand materialization."""
+
+    def __init__(self, service: "EngineDocSet", doc_id: str):
+        self._service = service
+        self.doc_id = doc_id
+        self.opset = _HandleOpSet(service, doc_id)
+
+    @property
+    def _doc(self) -> "DocHandle":
+        return self
+
+    def materialize(self):
+        return self._service.materialize(self.doc_id)
+
+
+class EngineDocSet:
+    def __init__(self, doc_ids: list[str] | None = None):
+        self._resident = ResidentDocSet(list(doc_ids or []))
+        # per doc: actor -> changes ordered by seq (admission guarantees
+        # in-order per actor). This is the re-serve log, op_set.js:308-317.
+        self._log: dict[str, dict[str, list[Change]]] = {
+            d: {} for d in self._resident.doc_ids}
+        self._handles: dict[str, DocHandle] = {}
+        self.handlers: list[Callable] = []
+        # One node can serve several transport peers (TcpSyncServer spawns a
+        # reader thread per socket); the resident engine is not re-entrant.
+        self._lock = threading.RLock()
+
+    # -- registry surface (doc_set.js:5-38) ---------------------------------
+
+    @property
+    def doc_ids(self) -> list[str]:
+        return list(self._resident.doc_ids)
+
+    def get_doc(self, doc_id: str) -> DocHandle | None:
+        if doc_id not in self._resident.doc_index:
+            return None
+        if doc_id not in self._handles:
+            self._handles[doc_id] = DocHandle(self, doc_id)
+        return self._handles[doc_id]
+
+    def add_doc(self, doc_id: str) -> DocHandle:
+        if doc_id not in self._resident.doc_index:
+            self._resident.add_docs([doc_id])
+            self._log[doc_id] = {}
+        return self.get_doc(doc_id)
+
+    def register_handler(self, handler: Callable) -> None:
+        if handler not in self.handlers:
+            self.handlers.append(handler)
+
+    def unregister_handler(self, handler: Callable) -> None:
+        if handler in self.handlers:
+            self.handlers.remove(handler)
+
+    # -- ingress ------------------------------------------------------------
+
+    def apply_changes(self, doc_id: str, changes: list[Change]) -> DocHandle:
+        """Admit a change batch into resident state (causal buffering and
+        duplicate-drop happen in the engine's delta encoder) and notify
+        handlers so attached Connections gossip the update."""
+        with self._lock:
+            self.add_doc(doc_id)
+            self._resident.apply_changes({doc_id: changes})
+            admitted = self._resident.last_admitted.get(doc_id, [])
+            log = self._log[doc_id]
+            for c in admitted:
+                log.setdefault(c.actor, []).append(c)
+            handle = self.get_doc(doc_id)
+        if admitted:
+            for handler in list(self.handlers):
+                handler(doc_id, handle)
+        return handle
+
+    def apply_columns(self, doc_id: str, cols) -> DocHandle:
+        """Columnar-frame ingress (sync/frames.py). This is the seam where
+        the native column-direct delta encoder plugs in; TODAY it
+        materializes Change objects once from the columns (one pass, no JSON)
+        and shares apply_changes."""
+        return self.apply_changes(doc_id, cols.to_changes())
+
+    # -- protocol reads -------------------------------------------------------
+
+    def clock_of(self, doc_id: str) -> dict[str, int]:
+        with self._lock:
+            i = self._resident.doc_index[doc_id]
+            return dict(self._resident.tables[i].clock)
+
+    def missing_changes(self, doc_id: str, clock: dict[str, int]) -> list[Change]:
+        """Per-actor suffixes newer than `clock` (op_set.js:299-306)."""
+        with self._lock:
+            out: list[Change] = []
+            for actor, changes in self._log.get(doc_id, {}).items():
+                have = clock.get(actor, 0)
+                out.extend(c for c in changes if c.seq > have)
+            return out
+
+    # -- engine reads ---------------------------------------------------------
+
+    def hashes(self) -> dict[str, int]:
+        """Converged per-doc state hashes (cached between deltas — polling
+        this does not re-dispatch the reconcile kernel)."""
+        with self._lock:
+            h = self._resident.hashes()
+            return {d: int(h[i]) for d, i in self._resident.doc_index.items()}
+
+    def materialize(self, doc_id: str):
+        """Decode one document's converged state from the device."""
+        with self._lock:
+            return self._resident.materialize(doc_id)

@@ -8,7 +8,13 @@ over TCP sockets that carries the exact `{docId, clock, changes}` message
 schema, so two automerge_tpu processes (or an automerge_tpu process and any
 peer speaking the reference protocol over the same framing) can sync.
 
-Framing: 4-byte big-endian length, then that many bytes of UTF-8 JSON.
+Framing: 4-byte big-endian length, then the payload. A payload starting with
+b"AMWM" is a binary columnar message (header JSON carrying docId/clock + a
+sync/frames.py columnar change frame); anything else is parsed as UTF-8 JSON.
+An automerge_tpu server therefore accepts JSON and columnar senders on one
+port. `wire=` selects what THIS side emits — keep the default "json" when
+the remote peer is a reference-protocol implementation that can't parse the
+binary envelope; use "columnar" between automerge_tpu nodes.
 
 Usage:
     server = TcpSyncServer(doc_set, host="127.0.0.1", port=0)
@@ -28,12 +34,48 @@ import threading
 
 from .connection import Connection
 
+def _sync_lock_of(doc_set) -> threading.RLock:
+    """The doc_set-wide reentrant lock serializing transport entry points."""
+    lock = getattr(doc_set, "_sync_lock", None)
+    if lock is None:
+        lock = threading.RLock()
+        try:
+            doc_set._sync_lock = lock
+        except AttributeError:  # doc_set with __slots__: per-call lock
+            pass
+    return lock
+
+
 _HEADER = struct.Struct(">I")
+_MSG_MAGIC = b"AMWM"
+_MSG_HDR = struct.Struct("<I")
 MAX_FRAME = 256 * 1024 * 1024
 
 
+def encode_msg(msg: dict) -> bytes:
+    """Message dict -> wire payload. Messages carrying a binary columnar
+    frame (msg["frame"]) use the AMWM binary envelope; everything else is
+    plain JSON (byte-compatible with the reference protocol)."""
+    frame = msg.get("frame")
+    if frame is None:
+        return json.dumps(msg).encode("utf-8")
+    head = json.dumps({k: v for k, v in msg.items() if k != "frame"}
+                      ).encode("utf-8")
+    return _MSG_MAGIC + _MSG_HDR.pack(len(head)) + head + frame
+
+
+def decode_msg(payload: bytes) -> dict:
+    if payload[:4] != _MSG_MAGIC:
+        return json.loads(payload.decode("utf-8"))
+    (head_len,) = _MSG_HDR.unpack_from(payload, 4)
+    body = 4 + _MSG_HDR.size + head_len
+    msg = json.loads(payload[4 + _MSG_HDR.size:body].decode("utf-8"))
+    msg["frame"] = payload[body:]
+    return msg
+
+
 def send_frame(sock: socket.socket, msg: dict) -> None:
-    payload = json.dumps(msg).encode("utf-8")
+    payload = encode_msg(msg)
     sock.sendall(_HEADER.pack(len(payload)) + payload)
 
 
@@ -47,7 +89,7 @@ def recv_frame(sock: socket.socket) -> dict | None:
     payload = _recv_exact(sock, length)
     if payload is None:
         return None
-    return json.loads(payload.decode("utf-8"))
+    return decode_msg(payload)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
@@ -67,11 +109,17 @@ class LockedConnection(Connection):
     """Connection safe for concurrent entry from a socket reader thread and
     the application thread (the reference's Connection assumes a single
     event loop; sockets give us two threads). Reentrant because receive_msg
-    can re-enter doc_changed through DocSet handler gossip."""
+    can re-enter doc_changed through DocSet handler gossip.
 
-    def __init__(self, doc_set, send_msg):
-        super().__init__(doc_set, send_msg)
-        self._lock = threading.RLock()
+    The lock is SHARED by every connection attached to the same doc_set
+    (one lock per doc_set, held for the whole receive->apply->gossip chain).
+    Per-connection locks would deadlock: two reader threads each holding
+    their own connection's lock while gossip tries to enter the other's
+    (classic ABBA through DocSet handlers)."""
+
+    def __init__(self, doc_set, send_msg, wire: str = "json"):
+        super().__init__(doc_set, send_msg, wire=wire)
+        self._lock = _sync_lock_of(doc_set)
 
     def receive_msg(self, msg):
         with self._lock:
@@ -85,10 +133,10 @@ class LockedConnection(Connection):
 class _Peer:
     """One socket bound to one Connection; reads frames on a thread."""
 
-    def __init__(self, doc_set, sock: socket.socket):
+    def __init__(self, doc_set, sock: socket.socket, wire: str = "json"):
         self.sock = sock
         self._send_lock = threading.Lock()
-        self.connection = LockedConnection(doc_set, self._send)
+        self.connection = LockedConnection(doc_set, self._send, wire=wire)
         self._thread = threading.Thread(target=self._read_loop, daemon=True)
         self.closed = threading.Event()
 
@@ -124,8 +172,10 @@ class _Peer:
 class TcpSyncServer:
     """Accepts peers and syncs a DocSet with each over its own Connection."""
 
-    def __init__(self, doc_set, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, doc_set, host: str = "127.0.0.1", port: int = 0,
+                 wire: str = "json"):
         self.doc_set = doc_set
+        self.wire = wire
         self._listener = socket.create_server((host, port))
         self.host, self.port = self._listener.getsockname()[:2]
         self.peers: list[_Peer] = []
@@ -143,7 +193,7 @@ class TcpSyncServer:
                 sock, _ = self._listener.accept()
             except OSError:
                 break
-            peer = _Peer(self.doc_set, sock)
+            peer = _Peer(self.doc_set, sock, wire=self.wire)
             self.peers.append(peer)
             peer.start()
 
@@ -160,10 +210,11 @@ class TcpSyncServer:
 class TcpSyncClient:
     """Connects a DocSet to a remote TcpSyncServer."""
 
-    def __init__(self, doc_set, host: str, port: int, timeout: float = 10.0):
+    def __init__(self, doc_set, host: str, port: int, timeout: float = 10.0,
+                 wire: str = "json"):
         sock = socket.create_connection((host, port), timeout=timeout)
         sock.settimeout(None)
-        self.peer = _Peer(doc_set, sock)
+        self.peer = _Peer(doc_set, sock, wire=wire)
 
     def start(self) -> "TcpSyncClient":
         self.peer.start()

@@ -1,0 +1,203 @@
+"""EngineDocSet: device-resident DocSet service syncing over columnar frames.
+
+The r1 verdict's missing keystone: peers exchanging packed columnar deltas
+end-to-end with the engine as the document store (VERDICT r1 #3). These
+tests pin hash parity between engine-backed nodes and the oracle, protocol
+behavior (auto-create, request-unknown-doc, duplicate/drop tolerance), and
+the TCP transport carrying real binary frames.
+"""
+
+import numpy as np
+
+import automerge_tpu as am
+from automerge_tpu.engine.batchdoc import apply_batch
+from automerge_tpu.sync.connection import Connection
+from automerge_tpu.sync.service import EngineDocSet
+from automerge_tpu.sync.tcp import TcpSyncClient, TcpSyncServer
+
+
+def oracle_hash(changes):
+    _, _, out = apply_batch([changes])
+    return int(np.asarray(out["hash"])[0])
+
+
+def two_replica_trace():
+    a = am.change(am.init("A"),
+                  lambda d: am.assign(d, {"x": 1, "tags": ["p", "q"]}))
+    b = am.merge(am.init("B"), a)
+    a = am.change(a, lambda d: d.__setitem__("x", 5))
+    b = am.change(b, lambda d: d["tags"].append("r"))
+    merged = am.merge(a, b)
+    return (a._doc.opset.get_missing_changes({}),
+            b._doc.opset.get_missing_changes({}),
+            merged._doc.opset.get_missing_changes({}))
+
+
+def drain(qa, ca, qb, cb, rounds=30):
+    n_frames = 0
+    for _ in range(rounds):
+        moved = False
+        while qa:
+            m = qa.pop(0)
+            n_frames += 1 if m.get("frame") is not None else 0
+            cb.receive_msg(m)
+            moved = True
+        while qb:
+            m = qb.pop(0)
+            n_frames += 1 if m.get("frame") is not None else 0
+            ca.receive_msg(m)
+            moved = True
+        if not moved:
+            break
+    return n_frames
+
+
+class TestEngineService:
+    def test_columnar_sync_hash_parity(self):
+        chs_a, chs_b, chs_all = two_replica_trace()
+        qa, qb = [], []
+        ea, eb = EngineDocSet(), EngineDocSet()
+        ca = Connection(ea, qa.append, wire="columnar")
+        cb = Connection(eb, qb.append, wire="columnar")
+        ca.open(); cb.open()
+        ea.apply_changes("doc", chs_a)
+        eb.apply_changes("doc", chs_b)
+        n_frames = drain(qa, ca, qb, cb)
+        assert n_frames >= 2  # both directions actually shipped columns
+        assert ea.hashes()["doc"] == eb.hashes()["doc"] == oracle_hash(chs_all)
+
+    def test_materialized_state_matches_oracle(self):
+        chs_a, chs_b, chs_all = two_replica_trace()
+        qa, qb = [], []
+        ea, eb = EngineDocSet(), EngineDocSet()
+        ca = Connection(ea, qa.append, wire="columnar")
+        cb = Connection(eb, qb.append, wire="columnar")
+        ca.open(); cb.open()
+        ea.apply_changes("doc", chs_a)
+        eb.apply_changes("doc", chs_b)
+        drain(qa, ca, qb, cb)
+        state = ea.materialize("doc")
+        assert state["data"] == {"x": 5, "tags": ["p", "q", "r"]}
+
+    def test_engine_node_syncs_with_interactive_json_peer(self):
+        """An engine node and a plain interpretive DocSet (reference
+        protocol, JSON wire) converge in both directions."""
+        chs_a, chs_b, chs_all = two_replica_trace()
+        qa, qb = [], []
+        engine = EngineDocSet()
+        plain = am.DocSet()
+        ce = Connection(engine, qa.append, wire="columnar")
+        cp = Connection(plain, qb.append, wire="json")
+        ce.open(); cp.open()
+        engine.apply_changes("doc", chs_a)
+        plain.apply_changes("doc", chs_b)
+        drain(qa, ce, qb, cp)
+        doc = plain.get_doc("doc")
+        assert engine.hashes()["doc"] == oracle_hash(chs_all)
+        assert dict(doc)["x"] == 5 and list(doc["tags"]) == ["p", "q", "r"]
+
+    def test_duplicate_and_out_of_order_delivery(self):
+        chs_a, chs_b, chs_all = two_replica_trace()
+        e = EngineDocSet()
+        # deliver b's changes first (deps on a's unseen changes buffer),
+        # then a's, then everything again (idempotent redelivery)
+        e.apply_changes("doc", chs_b)
+        e.apply_changes("doc", chs_a)
+        e.apply_changes("doc", chs_a + chs_b)
+        assert e.hashes()["doc"] == oracle_hash(chs_all)
+
+    def test_unknown_doc_requested_and_filled(self):
+        chs_a, _, _ = two_replica_trace()
+        qa, qb = [], []
+        have, want = EngineDocSet(), EngineDocSet()
+        ch = Connection(have, qa.append, wire="columnar")
+        cw = Connection(want, qb.append, wire="columnar")
+        have.apply_changes("doc", chs_a)
+        ch.open(); cw.open()
+        # `have` advertises; `want` doesn't know the doc and requests it
+        drain(qa, ch, qb, cw)
+        assert want.get_doc("doc") is not None
+        assert want.hashes()["doc"] == have.hashes()["doc"]
+
+    def test_missing_changes_per_actor_suffix(self):
+        chs_a, chs_b, _ = two_replica_trace()
+        e = EngineDocSet()
+        e.apply_changes("doc", chs_a + chs_b)
+        full_clock = e.clock_of("doc")
+        assert e.missing_changes("doc", full_clock) == []
+        got = e.missing_changes("doc", {})
+        assert {(c.actor, c.seq) for c in got} == \
+            {(c.actor, c.seq) for c in chs_a + chs_b}
+
+    def test_doc_axis_grows_pow2(self):
+        """Auto-created docs must not change resident shapes per doc
+        (VERDICT r2 review: O(log n) recompiles, not O(n))."""
+        e = EngineDocSet()
+        shapes = set()
+        for i in range(20):
+            d = am.change(am.init("A"), lambda x, i=i: x.__setitem__("n", i))
+            e.apply_changes(f"doc{i}", d._doc.opset.get_missing_changes({}))
+            shapes.add(e._resident.cap_docs)
+        assert len(shapes) <= 4  # 1 -> 8 -> 16 -> 32, not 20 distinct sizes
+        # padding rows don't corrupt real ones
+        d0 = am.change(am.init("A"), lambda x: x.__setitem__("n", 0))
+        assert e.hashes()["doc0"] == oracle_hash(
+            d0._doc.opset.get_missing_changes({}))
+
+    def test_hashes_cached_between_deltas(self):
+        chs_a, _, _ = two_replica_trace()
+        e = EngineDocSet()
+        e.apply_changes("doc", chs_a)
+        h1 = e.hashes()
+        out_ref = e._resident._out
+        h2 = e.hashes()
+        assert h1 == h2 and e._resident._out is out_ref  # no re-dispatch
+
+    def test_two_peer_tcp_no_deadlock(self):
+        """Two clients ingesting into one server concurrently must not
+        ABBA-deadlock across connection locks (gossip re-enters the other
+        peer's connection from inside a locked receive)."""
+        import time
+        chs_a, chs_b, chs_all = two_replica_trace()
+        hub = EngineDocSet()
+        pa, pb = EngineDocSet(), EngineDocSet()
+        pa.apply_changes("doc", chs_a)
+        pb.apply_changes("doc", chs_b)
+        server = TcpSyncServer(hub, wire="columnar").start()
+        ca = TcpSyncClient(pa, server.host, server.port, wire="columnar").start()
+        cb = TcpSyncClient(pb, server.host, server.port, wire="columnar").start()
+        try:
+            target = oracle_hash(chs_all)
+            deadline = time.time() + 25
+            sets = (hub, pa, pb)
+            while time.time() < deadline:
+                if all(s.get_doc("doc") is not None
+                       and s.hashes()["doc"] == target for s in sets):
+                    break
+                time.sleep(0.05)
+            assert [s.hashes()["doc"] for s in sets] == [target] * 3
+        finally:
+            ca.close(); cb.close(); server.close()
+
+    def test_tcp_columnar_sync(self):
+        chs_a, chs_b, chs_all = two_replica_trace()
+        server_set, client_set = EngineDocSet(), EngineDocSet()
+        server_set.apply_changes("doc", chs_a)
+        client_set.apply_changes("doc", chs_b)
+        server = TcpSyncServer(server_set, wire="columnar").start()
+        client = TcpSyncClient(client_set, server.host, server.port,
+                               wire="columnar").start()
+        try:
+            import time
+            deadline = time.time() + 20
+            target = oracle_hash(chs_all)
+            while time.time() < deadline:
+                if (server_set.clock_of("doc") == client_set.clock_of("doc")
+                        and server_set.hashes()["doc"] == target):
+                    break
+                time.sleep(0.05)
+            assert server_set.hashes()["doc"] == target
+            assert client_set.hashes()["doc"] == target
+        finally:
+            client.close()
+            server.close()

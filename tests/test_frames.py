@@ -1,0 +1,157 @@
+"""Columnar wire frame codec + transport envelope tests.
+
+The frame is the TPU-native replacement for the reference's per-op JSON
+change wire (src/connection.js:58-63); these tests pin (a) lossless
+round-trip of every wire-visible value type, (b) relay re-encode without
+change materialization, (c) the AMWM binary envelope used over TCP, and
+(d) JSON<->columnar interop at the Connection level.
+"""
+
+import automerge_tpu as am
+from automerge_tpu.core.change import Change, Op
+from automerge_tpu.sync.connection import Connection
+from automerge_tpu.sync.frames import (FRAME_MAGIC, bytes_to_columns,
+                                       changes_to_columns, columns_to_bytes,
+                                       decode_frame, encode_frame)
+from automerge_tpu.sync.tcp import decode_msg, encode_msg
+
+import pytest
+
+
+def trace_changes():
+    d = am.change(am.init("A"), lambda d: am.assign(d, {
+        "i": 7, "f": 3.25, "b": True, "s": "héllo\ud800x", "big": 2 ** 70,
+        "neg": -(2 ** 63), "null": None,
+        "nest": {"deep": [1, "two", False]}}))
+    d = am.change(d, lambda doc: doc.__delitem__("i"))
+    d = am.change(d, lambda doc: doc.__setitem__("t", am.Text()))
+    d = am.change(d, "a message", lambda doc: doc["t"].insert_at(0, *"ab"))
+    e = am.merge(am.init("B"), d)
+    e = am.change(e, lambda doc: doc["t"].delete_at(0))
+    m = am.merge(d, e)
+    return m, m._doc.opset.get_missing_changes({})
+
+
+class TestFrameCodec:
+    def test_round_trip_all_value_types(self):
+        _, chs = trace_changes()
+        assert decode_frame(encode_frame(chs)).to_changes() == chs
+
+    def test_relay_reencode_without_changes(self):
+        """Forwarding re-serializes decoded columns directly."""
+        _, chs = trace_changes()
+        cols = decode_frame(encode_frame(chs))
+        assert decode_frame(columns_to_bytes(cols)).to_changes() == chs
+
+    def test_empty_change_list(self):
+        assert decode_frame(encode_frame([])).to_changes() == []
+
+    def test_magic_check(self):
+        with pytest.raises(ValueError, match="magic"):
+            decode_frame(b"JUNKJUNKJUNK")
+
+    def test_trailing_bytes_rejected(self):
+        _, chs = trace_changes()
+        with pytest.raises(ValueError, match="trailing"):
+            decode_frame(encode_frame(chs) + b"x")
+
+    def test_frame_magic_prefix(self):
+        assert encode_frame([]).startswith(FRAME_MAGIC)
+
+    def test_type_fidelity_beats_json(self):
+        """int/float/bool distinctions survive (JSON would blur 1 vs 1.0)."""
+        chs = [Change("A", 1, {}, [
+            Op("set", am.ROOT_ID, key="a", value=1),
+            Op("set", am.ROOT_ID, key="b", value=1.0),
+            Op("set", am.ROOT_ID, key="c", value=True)])]
+        back = decode_frame(encode_frame(chs))[0] \
+            if False else decode_frame(encode_frame(chs)).to_changes()
+        vals = [op.value for op in back[0].ops]
+        assert vals == [1, 1.0, True]
+        assert [type(v) for v in vals] == [int, float, bool]
+
+    def test_message_and_deps_preserved(self):
+        chs = [Change("A", 3, {"B": 2, "C": 9}, [
+            Op("set", am.ROOT_ID, key="k", value="v")], "why not")]
+        assert decode_frame(encode_frame(chs)).to_changes() == chs
+
+    def test_columns_match_native_json_parser_schema(self):
+        """Frame columns and the native JSON parser produce the same
+        WireColumns decode for the same changes (shared representation)."""
+        import json
+        from automerge_tpu.native.wire import parse_changes_json
+        _, chs = trace_changes()
+        native = parse_changes_json(json.dumps([c.to_dict() for c in chs]))
+        if native is None:  # no toolchain: schema equivalence via to_changes
+            pytest.skip("native codec unavailable")
+        ours = changes_to_columns(chs)
+        assert native.to_changes() == ours.to_changes() == chs
+
+
+class TestTcpEnvelope:
+    def test_json_msg_passthrough(self):
+        msg = {"docId": "d", "clock": {"A": 2}}
+        payload = encode_msg(msg)
+        assert payload.startswith(b"{")  # byte-compatible with reference JSON
+        assert decode_msg(payload) == msg
+
+    def test_binary_envelope_round_trip(self):
+        _, chs = trace_changes()
+        msg = {"docId": "d", "clock": {"A": 2}, "frame": encode_frame(chs)}
+        payload = encode_msg(msg)
+        assert payload.startswith(b"AMWM")
+        out = decode_msg(payload)
+        assert out["docId"] == "d" and out["clock"] == {"A": 2}
+        assert decode_frame(out["frame"]).to_changes() == chs
+
+
+class TestConnectionWireModes:
+    def _drain(self, qa, ca, qb, cb):
+        for _ in range(30):
+            moved = False
+            while qa:
+                cb.receive_msg(qa.pop(0)); moved = True
+            while qb:
+                ca.receive_msg(qb.pop(0)); moved = True
+            if not moved:
+                break
+
+    def _sync_pair(self, wire_a, wire_b):
+        qa, qb = [], []
+        sa, sb = am.DocSet(), am.DocSet()
+        ca = Connection(sa, qa.append, wire=wire_a)
+        cb = Connection(sb, qb.append, wire=wire_b)
+        ca.open(); cb.open()
+        sa.set_doc("doc", am.change(am.init("A"),
+                                    lambda d: d.__setitem__("x", 1)))
+        self._drain(qa, ca, qb, cb)
+        da = am.change(sa.get_doc("doc"), lambda d: d.__setitem__("a", "A"))
+        db = am.change(sb.get_doc("doc"), lambda d: d.__setitem__("b", "B"))
+        sa.set_doc("doc", da); sb.set_doc("doc", db)
+        self._drain(qa, ca, qb, cb)
+        assert am.equals(sa.get_doc("doc"), sb.get_doc("doc"))
+        return sa.get_doc("doc")
+
+    def test_columnar_both_sides(self):
+        doc = self._sync_pair("columnar", "columnar")
+        assert dict(doc) == {"x": 1, "a": "A", "b": "B"}
+
+    def test_columnar_talks_to_json_peer(self):
+        self._sync_pair("columnar", "json")
+        self._sync_pair("json", "columnar")
+
+    def test_columnar_payload_actually_used(self):
+        sent = []
+        sa = am.DocSet()
+        ca = Connection(sa, sent.append, wire="columnar")
+        ca.open()
+        sa.set_doc("doc", am.change(am.init("A"),
+                                    lambda d: d.__setitem__("x", 1)))
+        # peer advertised an empty clock -> push must carry a frame
+        ca.receive_msg({"docId": "doc", "clock": {}})
+        with_changes = [m for m in sent if "frame" in m or "changes" in m]
+        assert with_changes and all("frame" in m for m in with_changes)
+
+    def test_unknown_wire_mode_rejected(self):
+        with pytest.raises(ValueError):
+            Connection(am.DocSet(), lambda m: None, wire="protobuf")
