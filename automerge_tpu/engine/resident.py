@@ -60,9 +60,12 @@ class DocTables:
         self.state_clocks: dict[tuple[str, int], dict[str, int]] = {}
         self.clock: dict[str, int] = {}
         self.seen: set[tuple[str, int]] = set()
-        self.queue: list[Change] = []
+        self.queue: list = []  # _Pending records awaiting admission
         self.n_changes = 0
         self.n_ops = 0
+        # capacity stats (mirrored by both the Python and native encoders)
+        self.n_lists = 0
+        self.max_elems = 0
 
     # arrival-ordered value interning (ValueTable sorts; we can't)
     def value_id(self, value) -> int:
@@ -81,20 +84,63 @@ class DocTables:
 
 
 class Delta:
-    """Delta rows for one document (plain Python lists, stacked later)."""
+    """Delta rows for one document (lists of tuples from the Python encoder
+    or numpy row arrays from the native one; stacked later)."""
 
     def __init__(self):
-        self.ops: list[tuple] = []        # rows matching OP_COLS[1:]
+        self.ops = []        # rows matching OP_COLS[1:]
         self.clocks: list[np.ndarray] = []  # rows [n_actors]
-        self.ins: list[tuple] = []        # (list_row, slot, elem, actor, parent_slot, fid)
-        self.new_lists: list[tuple] = []  # (list_row, obj_idx, obj_hash)
-        self.changes: list[Change] = []   # causally-admitted changes, in order
+        self.ins = []        # (list_row, slot, elem, actor, parent_slot, fid)
+        self.new_lists = []  # (list_row, obj_idx, obj_hash)
+        self.changes = []    # admitted changes (Change or AdmittedRef), in order
+
+
+class _Pending:
+    """A change awaiting causal admission: protocol header + payload
+    (a Change, or (cols, idx) into a columnar frame)."""
+    __slots__ = ("actor", "seq", "deps", "payload")
+
+    def __init__(self, actor: str, seq: int, deps: dict, payload):
+        self.actor = actor
+        self.seq = seq
+        self.deps = deps
+        self.payload = payload
+
+
+class AdmittedRef:
+    """Lazy handle to an admitted change living in a columnar frame — lets
+    the sync layer log and re-serve changes without materializing per-op
+    Python objects unless a lagging peer actually needs them."""
+    __slots__ = ("cols", "idx")
+
+    def __init__(self, cols, idx: int):
+        self.cols = cols
+        self.idx = idx
+
+    @property
+    def actor(self) -> str:
+        return self.cols.actors[self.cols.change_actor[self.idx]]
+
+    @property
+    def seq(self) -> int:
+        return int(self.cols.change_seq[self.idx])
+
+    def change(self) -> Change:
+        return self.cols.change_at(self.idx)
 
 
 class ResidentDocSet:
-    """A DocSet whose columnar state lives on the device."""
+    """A DocSet whose columnar state lives on the device.
 
-    def __init__(self, doc_ids: list[str]):
+    Ingress runs through ONE delta encoder per instance: the native C++ one
+    (native/deltaenc.cpp — interning, hashing and row building with no
+    per-op Python) when the toolchain is available, else the pure-Python
+    `_encode_delta`. Change-object ingress is converted to columns first on
+    the native path so the C++ tables stay authoritative; mixing encoders on
+    one instance would desynchronize interning state.
+    """
+
+    def __init__(self, doc_ids: list[str], native: bool | None = None):
         self.doc_ids = list(doc_ids)
         self.doc_index = {d: i for i, d in enumerate(self.doc_ids)}
         n = len(self.doc_ids)
@@ -120,6 +166,13 @@ class ResidentDocSet:
         self.state: dict[str, jnp.ndarray] = {}
         self._alloc()
         self._out = None
+
+        self._native = None
+        if native is not False:
+            from ..native.delta import NativeDeltaEncoder
+            self._native = NativeDeltaEncoder.create()
+        if native is True and self._native is None:
+            raise RuntimeError("native delta encoder requested but unavailable")
 
     # ------------------------------------------------------------------
     def _alloc(self):
@@ -241,8 +294,11 @@ class ResidentDocSet:
 
     # ------------------------------------------------------------------
     def _register_actors(self, changes_by_doc) -> None:
-        new = {c.actor for changes in changes_by_doc.values() for c in changes}
-        new -= set(self.actors)
+        self._register_actor_names(
+            {c.actor for changes in changes_by_doc.values() for c in changes})
+
+    def _register_actor_names(self, names: set) -> None:
+        new = set(names) - set(self.actors)
         if not new:
             return
         old_actors = list(self.actors)
@@ -260,57 +316,68 @@ class ResidentDocSet:
         self.state = _remap_actors(self.state, jnp.asarray(perm), jnp.asarray(inv))
 
     # ------------------------------------------------------------------
-    def _encode_delta(self, doc_idx: int, changes: list[Change]) -> Delta:
-        t = self.tables[doc_idx]
-        delta = Delta()
-        # causal admission
+    def _admit(self, t: DocTables, incoming: list[_Pending]) -> list[_Pending]:
+        """Causal admission fixpoint over the doc's queue + `incoming`
+        (op_set.js:254-270 analog); duplicates drop idempotently."""
         pending = list(t.queue)
-        for c in changes:
-            key = (c.actor, c.seq)
+        for p in incoming:
+            key = (p.actor, p.seq)
             if key in t.seen:
                 continue
-            pending.append(c)
+            pending.append(p)
             t.seen.add(key)
-        ready: list[Change] = []
+        ready: list[_Pending] = []
         progress = True
         while progress:
             progress = False
             still = []
-            for c in pending:
-                deps = dict(c.deps)
-                deps[c.actor] = c.seq - 1
+            for p in pending:
+                deps = dict(p.deps)
+                deps[p.actor] = p.seq - 1
                 if all(t.clock.get(a, 0) >= s for a, s in deps.items()):
-                    ready.append(c)
-                    t.clock[c.actor] = max(t.clock.get(c.actor, 0), c.seq)
+                    ready.append(p)
+                    t.clock[p.actor] = max(t.clock.get(p.actor, 0), p.seq)
                     progress = True
                 else:
-                    still.append(c)
+                    still.append(p)
             pending = still
         t.queue = pending
+        return ready
 
-        delta.changes = ready
-        n_actors = self.cap_actors
-        for c in ready:
-            # transitive clock
-            base = dict(c.deps)
-            base[c.actor] = c.seq - 1
-            full: dict[str, int] = {}
-            for a, s in base.items():
-                if s <= 0:
-                    continue
-                trans = t.state_clocks.get((a, s))
-                if trans:
-                    for a2, s2 in trans.items():
-                        if s2 > full.get(a2, 0):
-                            full[a2] = s2
-                full[a] = s
-            t.state_clocks[(c.actor, c.seq)] = full
-            row = np.zeros(n_actors, dtype=np.int32)
-            for a, s in full.items():
-                row[self.actor_rank[a]] = s
+    def _clock_row(self, t: DocTables, actor: str, seq: int,
+                   deps: dict) -> np.ndarray:
+        """Transitive clock row for one admitted change; also advances the
+        per-doc state-clock memo and change counter."""
+        base = dict(deps)
+        base[actor] = seq - 1
+        full: dict[str, int] = {}
+        for a, s in base.items():
+            if s <= 0:
+                continue
+            trans = t.state_clocks.get((a, s))
+            if trans:
+                for a2, s2 in trans.items():
+                    if s2 > full.get(a2, 0):
+                        full[a2] = s2
+            full[a] = s
+        t.state_clocks[(actor, seq)] = full
+        row = np.zeros(self.cap_actors, dtype=np.int32)
+        for a, s in full.items():
+            row[self.actor_rank[a]] = s
+        return row
+
+    def _encode_delta(self, doc_idx: int, changes: list[Change]) -> Delta:
+        """Pure-Python delta encode (the native fallback)."""
+        t = self.tables[doc_idx]
+        delta = Delta()
+        ready = self._admit(t, [
+            _Pending(c.actor, c.seq, dict(c.deps), c) for c in changes])
+        delta.changes = [p.payload for p in ready]
+        for p in ready:
+            c: Change = p.payload
+            delta.clocks.append(self._clock_row(t, c.actor, c.seq, c.deps))
             change_idx = t.n_changes
             t.n_changes += 1
-            delta.clocks.append(row)
 
             arank = self.actor_rank[c.actor]
             for op in c.ops:
@@ -360,33 +427,164 @@ class ResidentDocSet:
                 delta.ops.append((code, fid, arank, c.seq, change_idx,
                                   value, fh, vh))
                 t.n_ops += 1
+        t.n_lists = len(t.list_rows)
+        if t.elem_slots:
+            t.max_elems = max(len(s) for s in t.elem_slots.values())
         return delta
 
     # ------------------------------------------------------------------
     def apply_changes(self, changes_by_doc: dict[str, list[Change]]) -> None:
         """Encode + scatter a delta batch into resident state."""
+        if self._native is not None:
+            from ..native.wire import changes_to_columns
+            self.apply_columns({d: changes_to_columns(chs)
+                                for d, chs in changes_by_doc.items()})
+            return
         self._register_actors(changes_by_doc)
         flat, meta = self._build_delta_arrays(changes_by_doc)
         self.state = _scatter_delta(self.state, flat, meta)
         self._out = None
 
+    def apply_columns(self, cols_by_doc: dict) -> None:
+        """Columnar-frame ingress: encode + scatter without per-op Python
+        (native path); falls back through Change objects otherwise."""
+        if self._native is None:
+            self.apply_changes({d: c.to_changes()
+                                for d, c in cols_by_doc.items()})
+            return
+        self._register_actors_cols(cols_by_doc)
+        flat, meta = self._build_delta_arrays_cols(cols_by_doc)
+        self.state = _scatter_delta(self.state, flat, meta)
+        self._out = None
+
+    def apply_and_reconcile_columns(self, cols_by_doc: dict):
+        """Fused columnar apply + reconcile (one device dispatch)."""
+        if self._native is None:
+            return self.apply_and_reconcile(
+                {d: c.to_changes() for d, c in cols_by_doc.items()})
+        self._register_actors_cols(cols_by_doc)
+        flat, meta = self._build_delta_arrays_cols(cols_by_doc)
+        self.state, out = _scatter_and_apply(self.state, flat, meta,
+                                             max_fids=self.cap_fids)
+        self._out = out
+        return np.asarray(out["hash"])[:len(self.doc_ids)]
+
+    def _register_actors_cols(self, cols_by_doc: dict) -> None:
+        new = set()
+        for cols in cols_by_doc.values():
+            for i in set(np.asarray(cols.change_actor).tolist()):
+                new.add(cols.actors[i])
+        self._register_actor_names(new)
+
     def _build_delta_arrays(self, changes_by_doc: dict[str, list[Change]]):
         n = self.cap_docs
         deltas = [Delta() for _ in range(n)]
-        self.last_admitted: dict[str, list[Change]] = {}
+        self.last_admitted = {}
         for doc_id, changes in changes_by_doc.items():
             i = self.doc_index[doc_id]
             deltas[i] = self._encode_delta(i, changes)
             self.last_admitted[doc_id] = deltas[i].changes
+        return self._stack_deltas(deltas)
 
-        # capacity checks
+    def _build_delta_arrays_cols(self, cols_by_doc: dict):
+        """Columnar round encode: admission + clock rows in Python (per
+        change), ONE batched native call set for all per-op work (interning,
+        hashing, row building) across every document in the round. The C++
+        side reads the raw AMW1 frame bytes directly — the wire format IS
+        the encoder input, so ingest pays no Python-side merge or re-blob."""
+        from ..native.delta import frame_bytes_of
+
+        n = self.cap_docs
+        deltas = [Delta() for _ in range(n)]
+        self.last_admitted = {}
+
+        # 1. causal admission + clock rows, per doc (doc order fixed so the
+        # native batch emits doc-grouped rows we can slice by searchsorted)
+        ready_by_doc: list[tuple[int, list[_Pending]]] = []
+        for doc_id in sorted(cols_by_doc, key=lambda d: self.doc_index[d]):
+            cols = cols_by_doc[doc_id]
+            i = self.doc_index[doc_id]
+            t = self.tables[i]
+            ready = self._admit(t, [
+                _Pending(cols.actors[cols.change_actor[j]],
+                         int(cols.change_seq[j]), cols.deps_at(j), (cols, j))
+                for j in range(cols.n_changes)])
+            deltas[i].changes = [AdmittedRef(*p.payload) for p in ready]
+            self.last_admitted[doc_id] = deltas[i].changes
+            for p in ready:
+                deltas[i].clocks.append(
+                    self._clock_row(t, p.actor, p.seq, p.deps))
+            if ready:
+                ready_by_doc.append((i, ready))
+        if not ready_by_doc:
+            return self._stack_deltas(deltas)
+
+        # 2. collect the frames that actually had admissions (queued changes
+        # may reference frames from earlier rounds)
+        frames: list[bytes] = []
+        frame_of: dict[int, int] = {}
+        for _, ready in ready_by_doc:
+            for p in ready:
+                c = p.payload[0]
+                if id(c) not in frame_of:
+                    frame_of[id(c)] = len(frames)
+                    frames.append(frame_bytes_of(c))
+
+        # 3. admitted metadata arrays (admission order, grouped by doc)
+        adm_frame, adm_idx, adm_doc, aranks, seqs, cidxs = [], [], [], [], [], []
+        for i, ready in ready_by_doc:
+            t = self.tables[i]
+            for p in ready:
+                c, j = p.payload
+                adm_frame.append(frame_of[id(c)])
+                adm_idx.append(j)
+                adm_doc.append(i)
+                aranks.append(self.actor_rank[p.actor])
+                seqs.append(p.seq)
+                cidxs.append(t.n_changes)
+                t.n_changes += 1
+
+        # 4. one native batch straight from frame bytes
+        self._native.ensure_docs(len(self.doc_ids))
+        self._native.begin()
+        self._native.apply_frames(frames, adm_frame, adm_idx, adm_doc,
+                                  aranks, seqs, cidxs)
+        bd = self._native.finish()
+
+        # 5. slice doc-grouped rows into per-doc deltas
+        for rows, attr in ((bd.op_rows, "ops"), (bd.ins_rows, "ins"),
+                           (bd.newlist_rows, "new_lists")):
+            if len(rows):
+                bounds = np.searchsorted(rows[:, 0], np.arange(n + 1))
+                for i in range(n):
+                    lo, hi = bounds[i], bounds[i + 1]
+                    if hi > lo:
+                        setattr(deltas[i], attr, rows[lo:hi, 1:])
+        # mirror table additions + capacity stats
+        for d, name, kind in bd.new_objects:
+            self.tables[d].objects.append((name, kind))
+        for d, oi, key in bd.new_fields:
+            self.tables[d].fields.append((oi, key))
+        for d, v in bd.new_values:
+            self.tables[d].value_list.append(v)
+        for i in range(min(len(self.tables), len(bd.stats))):
+            t = self.tables[i]
+            t.n_lists = int(bd.stats[i, 0])
+            t.max_elems = int(bd.stats[i, 1])
+        for i, _ in ready_by_doc:
+            self.tables[i].n_ops += len(deltas[i].ops)
+        return self._stack_deltas(deltas)
+
+    def _stack_deltas(self, deltas: list[Delta]):
+        n = self.cap_docs
+        # capacity checks (n_lists/max_elems/fields are per-table scalars
+        # maintained by both encoders)
         need_ops = int(max((self.op_count[i] + len(d.ops)
                             for i, d in enumerate(deltas)), default=0))
         need_ch = int(max((self.change_count[i] + len(d.clocks)
                            for i, d in enumerate(deltas)), default=0))
-        need_lists = max((len(t.list_rows) for t in self.tables), default=0)
-        need_elems = max((len(s) for t in self.tables
-                          for s in t.elem_slots.values()), default=0)
+        need_lists = max((t.n_lists for t in self.tables), default=0)
+        need_elems = max((t.max_elems for t in self.tables), default=0)
         need_fids = max((len(t.fields) for t in self.tables), default=0)
         grow = {}
         if need_ops > self.cap_ops:
@@ -420,17 +618,18 @@ class ResidentDocSet:
         offsets_ch = self.change_count.astype(np.int32)
 
         for i, d in enumerate(deltas):
-            if d.ops:
-                d_ops[i, :len(d.ops)] = np.array(d.ops, dtype=np.int32)
+            if len(d.ops):
+                d_ops[i, :len(d.ops)] = np.asarray(d.ops, dtype=np.int32)
                 d_ops_n[i] = len(d.ops)
-            if d.clocks:
+            if len(d.clocks):
                 d_clock[i, :len(d.clocks)] = np.stack(d.clocks)
                 d_ch_n[i] = len(d.clocks)
-            if d.ins:
-                d_ins[i, :len(d.ins)] = np.array(d.ins, dtype=np.int32)
+            if len(d.ins):
+                d_ins[i, :len(d.ins)] = np.asarray(d.ins, dtype=np.int32)
                 d_ins_n[i] = len(d.ins)
-            if d.new_lists:
-                d_nl[i, :len(d.new_lists)] = np.array(d.new_lists, dtype=np.int32)
+            if len(d.new_lists):
+                d_nl[i, :len(d.new_lists)] = np.asarray(d.new_lists,
+                                                        dtype=np.int32)
                 d_nl_n[i] = len(d.new_lists)
             self.op_count[i] += len(d.ops)
             self.change_count[i] += len(d.clocks)
@@ -451,6 +650,11 @@ class ResidentDocSet:
         readback for the hashes. This is the hot path of a resident sync
         service — per-round cost is a single host<->device roundtrip plus
         the delta bytes."""
+        if self._native is not None:
+            from ..native.wire import changes_to_columns
+            return self.apply_and_reconcile_columns(
+                {d: changes_to_columns(chs)
+                 for d, chs in changes_by_doc.items()})
         self._register_actors(changes_by_doc)
         flat, meta = self._build_delta_arrays(changes_by_doc)
         self.state, out = _scatter_and_apply(self.state, flat, meta,

@@ -41,7 +41,11 @@ class ResidentRowsDocSet(ResidentDocSet):
 
     def __init__(self, doc_ids, actors: list[str] = ()):  # noqa: B006
         self._rows_ready = False
-        super().__init__(doc_ids)
+        # The rows flow drives _encode_delta with Change objects directly
+        # (docs-minor triplets have their own scatter layout); the native
+        # columnar encoder has no rows output mode yet, so pin the Python
+        # path — mixing encoders on one instance desyncs interning tables.
+        super().__init__(doc_ids, native=False)
         self.n_pad = _ceil128(max(len(self.doc_ids), 1))
         # per-doc: list_row -> [(slot, elem, arank, parent_slot), ...]
         self.ins_log: list[dict[int, list[tuple]]] = [
@@ -189,8 +193,8 @@ class ResidentRowsDocSet(ResidentDocSet):
                     n_lists[i] = n_lists.get(i, 0) + 1
 
         for i, t in enumerate(self.tables):
-            for c in t.queue:
-                count(i, c)
+            for p in t.queue:  # _Pending records; rows path payloads are Changes
+                count(i, p.payload)
         for r in rounds:
             for doc_id, changes in r.items():
                 i = self.doc_index[doc_id]

@@ -27,13 +27,14 @@ _lib = None
 _lib_error: str | None = None
 
 
-def _build() -> str | None:
+def _build_shared(src: str, lib_path: str) -> str | None:
+    """Compile one .cpp into a shared library, atomically installed."""
     os.makedirs(_BUILD_DIR, exist_ok=True)
     # Compile to a process-unique temp path and rename into place: another
     # process may be loading (or also building) the library concurrently, and
     # rename is atomic while g++'s output writing is not.
-    tmp = f"{_LIB}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    tmp = f"{lib_path}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src, "-o", tmp]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired) as exc:
@@ -41,14 +42,41 @@ def _build() -> str | None:
     if proc.returncode != 0:
         return f"compile failed: {proc.stderr[:500]}"
     try:
-        os.replace(tmp, _LIB)
+        os.replace(tmp, lib_path)
     except OSError as exc:
         return f"install failed: {exc}"
     return None
 
 
+def load_shared(src_name: str, lib_name: str,
+                state: dict) -> "ctypes.CDLL | None":
+    """Build-if-stale + load a native library; `state` caches the result
+    (keys: lib, error) so each library is attempted once per process."""
+    if state.get("lib") is not None or state.get("error") is not None:
+        return state.get("lib")
+    src = os.path.join(_HERE, src_name)
+    lib_path = os.path.join(_BUILD_DIR, lib_name)
+    if not os.path.exists(lib_path) or (
+            os.path.exists(src)
+            and os.path.getmtime(src) > os.path.getmtime(lib_path)):
+        err = _build_shared(src, lib_path)
+        if err is not None:
+            state["error"] = err
+            return None
+    try:
+        state["lib"] = ctypes.CDLL(lib_path)
+    except OSError as exc:
+        state["error"] = str(exc)
+        return None
+    return state["lib"]
+
+
+def _build() -> str | None:
+    return _build_shared(_SRC, _LIB)
+
+
 def get_lib():
-    """Load (building if needed) the native library, or None."""
+    """Load (building if needed) the native wire codec library, or None."""
     global _lib, _lib_error
     with _lock:
         if _lib is not None or _lib_error is not None:

@@ -58,34 +58,142 @@ class WireColumns:
             return int(self.strings[self.op_vstr[j]])
         return self.strings[self.op_vstr[j]]
 
-    def to_changes(self):
-        """Materialize Change objects from the columns. (A column-direct
-        engine ingest path that skips Change construction entirely is the
-        identified next optimization — see INTERNALS.md "Performance
-        notes"; today the engine consumes Change objects.)"""
+    def deps_at(self, i: int) -> dict:
+        """Change i's dependency frontier as {actor: seq}."""
+        return {self.actors[a]: int(s) for a, s in zip(
+            self.deps_actor[self.deps_off[i]:self.deps_off[i + 1]],
+            self.deps_seq[self.deps_off[i]:self.deps_off[i + 1]])}
+
+    def change_at(self, i: int):
+        """Materialize one Change object from the columns."""
         from ..core.change import Change, Op
         from ..storage import _ACTIONS
-        out = []
-        for i in range(self.n_changes):
-            deps = {self.actors[a]: int(s) for a, s in zip(
-                self.deps_actor[self.deps_off[i]:self.deps_off[i + 1]],
-                self.deps_seq[self.deps_off[i]:self.deps_off[i + 1]])}
-            ops = []
-            for j in range(int(self.op_off[i]), int(self.op_off[i + 1])):
-                action = _ACTIONS[self.op_action[j]]
-                key = self.keys[self.op_key[j]] if self.op_key[j] >= 0 else None
-                elem = int(self.op_elem[j]) if self.op_elem[j] >= 0 else None
-                if action in ("set", "link"):
-                    value = self.op_value(j)
-                else:
-                    value = None
-                ops.append(Op(action, self.objects[self.op_obj[j]],
-                              key=key, value=value, elem=elem))
-            msg = (self.messages[self.change_msg[i]]
-                   if self.change_msg[i] >= 0 else None)
-            out.append(Change(self.actors[self.change_actor[i]],
-                              int(self.change_seq[i]), deps, ops, msg))
-        return out
+        ops = []
+        for j in range(int(self.op_off[i]), int(self.op_off[i + 1])):
+            action = _ACTIONS[self.op_action[j]]
+            key = self.keys[self.op_key[j]] if self.op_key[j] >= 0 else None
+            elem = int(self.op_elem[j]) if self.op_elem[j] >= 0 else None
+            if action in ("set", "link"):
+                value = self.op_value(j)
+            else:
+                value = None
+            ops.append(Op(action, self.objects[self.op_obj[j]],
+                          key=key, value=value, elem=elem))
+        msg = (self.messages[self.change_msg[i]]
+               if self.change_msg[i] >= 0 else None)
+        return Change(self.actors[self.change_actor[i]],
+                      int(self.change_seq[i]), self.deps_at(i), ops, msg)
+
+    def to_changes(self):
+        """Materialize Change objects from the columns. (The column-direct
+        engine ingest path that skips Change construction entirely is
+        native/delta.py + ResidentDocSet.apply_columns; this is the
+        interactive-frontend fallback.)"""
+        return [self.change_at(i) for i in range(self.n_changes)]
+
+
+_I64_MIN = -(2 ** 63)
+_I64_MAX = 2 ** 63 - 1
+
+
+class _Interner:
+    """Frame-local string table (insertion-ordered)."""
+
+    def __init__(self):
+        self.index: dict[str, int] = {}
+        self.items: list[str] = []
+
+    def add(self, s: str) -> int:
+        i = self.index.get(s)
+        if i is None:
+            i = len(self.items)
+            self.index[s] = i
+            self.items.append(s)
+        return i
+
+
+def _encode_value(op, strings: _Interner):
+    """(vtag, vint, vdbl, vstr) for one op, matching WireColumns.op_value."""
+    if op.action not in ("set", "link"):
+        return V_NONE, 0, 0.0, -1
+    v = op.value
+    if v is None:
+        return V_NULL, 0, 0.0, -1
+    if v is True:
+        return V_TRUE, 0, 0.0, -1
+    if v is False:
+        return V_FALSE, 0, 0.0, -1
+    if isinstance(v, int):
+        if _I64_MIN <= v <= _I64_MAX:
+            return V_INT, v, 0.0, -1
+        return V_BIGINT, 0, 0.0, strings.add(str(v))
+    if isinstance(v, float):
+        return V_DOUBLE, 0, float(v), -1
+    if isinstance(v, str):
+        return V_STR, 0, 0.0, strings.add(v)
+    raise TypeError(f"unsupported scalar value on the wire: {type(v).__name__}")
+
+
+def changes_to_columns(changes) -> WireColumns:
+    """Encode Change objects as columns (the send-side per-op pass — the
+    analog of the per-op dict building JSON senders pay in to_dict)."""
+    from ..storage import _ACTION_IDX
+    actors, objects, keys, messages, strings = (
+        _Interner(), _Interner(), _Interner(), _Interner(), _Interner())
+    n = len(changes)
+    change_actor = np.zeros(n, np.int32)
+    change_seq = np.zeros(n, np.int32)
+    change_msg = np.full(n, -1, np.int32)
+    deps_off = np.zeros(n + 1, np.int32)
+    op_off = np.zeros(n + 1, np.int32)
+    deps_actor: list[int] = []
+    deps_seq: list[int] = []
+    op_action: list[int] = []
+    op_obj: list[int] = []
+    op_key: list[int] = []
+    op_elem: list[int] = []
+    op_vtag: list[int] = []
+    op_vint: list[int] = []
+    op_vdbl: list[float] = []
+    op_vstr: list[int] = []
+
+    for i, c in enumerate(changes):
+        change_actor[i] = actors.add(c.actor)
+        change_seq[i] = c.seq
+        if c.message is not None:
+            change_msg[i] = messages.add(c.message)
+        for a, s in c.deps.items():
+            deps_actor.append(actors.add(a))
+            deps_seq.append(int(s))
+        deps_off[i + 1] = len(deps_actor)
+        for op in c.ops:
+            op_action.append(_ACTION_IDX[op.action])
+            op_obj.append(objects.add(op.obj))
+            op_key.append(keys.add(op.key) if op.key is not None else -1)
+            op_elem.append(int(op.elem) if op.elem is not None else -1)
+            tag, vi, vd, vs = _encode_value(op, strings)
+            op_vtag.append(tag)
+            op_vint.append(vi)
+            op_vdbl.append(vd)
+            op_vstr.append(vs)
+        op_off[i + 1] = len(op_action)
+
+    return WireColumns(
+        change_actor=change_actor, change_seq=change_seq,
+        change_msg=change_msg, deps_off=deps_off,
+        deps_actor=np.asarray(deps_actor, np.int32),
+        deps_seq=np.asarray(deps_seq, np.int32),
+        op_off=op_off,
+        op_action=np.asarray(op_action, np.int8),
+        op_obj=np.asarray(op_obj, np.int32),
+        op_key=np.asarray(op_key, np.int32),
+        op_elem=np.asarray(op_elem, np.int32),
+        op_vtag=np.asarray(op_vtag, np.int8),
+        op_vint=np.asarray(op_vint, np.int64),
+        op_vdbl=np.asarray(op_vdbl, np.float64),
+        op_vstr=np.asarray(op_vstr, np.int32),
+        actors=actors.items, objects=objects.items, keys=keys.items,
+        messages=messages.items, strings=strings.items)
 
 
 def _table(lib, handle, which: int, n_items: int, blob_len: int) -> list[str]:

@@ -48,113 +48,11 @@ import struct
 import numpy as np
 
 from ..core.change import Change
-from ..native.wire import (V_BIGINT, V_DOUBLE, V_FALSE, V_INT, V_NONE, V_NULL,
-                           V_STR, V_TRUE, WireColumns)
-from ..storage import _ACTION_IDX
+from ..native.wire import WireColumns, changes_to_columns  # noqa: F401
+# changes_to_columns is re-exported: it lives beside WireColumns so the
+# engine can use it without importing the sync package.
 
 FRAME_MAGIC = b"AMW1"
-
-_I64_MIN = -(2 ** 63)
-_I64_MAX = 2 ** 63 - 1
-
-
-class _Interner:
-    """Frame-local string table (insertion-ordered)."""
-
-    def __init__(self):
-        self.index: dict[str, int] = {}
-        self.items: list[str] = []
-
-    def add(self, s: str) -> int:
-        i = self.index.get(s)
-        if i is None:
-            i = len(self.items)
-            self.index[s] = i
-            self.items.append(s)
-        return i
-
-
-def changes_to_columns(changes: list[Change]) -> WireColumns:
-    """Encode Change objects as columns (the send-side per-op pass — the
-    analog of the per-op dict building JSON senders pay in to_dict)."""
-    actors, objects, keys, messages, strings = (
-        _Interner(), _Interner(), _Interner(), _Interner(), _Interner())
-    n = len(changes)
-    change_actor = np.zeros(n, np.int32)
-    change_seq = np.zeros(n, np.int32)
-    change_msg = np.full(n, -1, np.int32)
-    deps_off = np.zeros(n + 1, np.int32)
-    op_off = np.zeros(n + 1, np.int32)
-    deps_actor: list[int] = []
-    deps_seq: list[int] = []
-    op_action: list[int] = []
-    op_obj: list[int] = []
-    op_key: list[int] = []
-    op_elem: list[int] = []
-    op_vtag: list[int] = []
-    op_vint: list[int] = []
-    op_vdbl: list[float] = []
-    op_vstr: list[int] = []
-
-    for i, c in enumerate(changes):
-        change_actor[i] = actors.add(c.actor)
-        change_seq[i] = c.seq
-        if c.message is not None:
-            change_msg[i] = messages.add(c.message)
-        for a, s in c.deps.items():
-            deps_actor.append(actors.add(a))
-            deps_seq.append(int(s))
-        deps_off[i + 1] = len(deps_actor)
-        for op in c.ops:
-            op_action.append(_ACTION_IDX[op.action])
-            op_obj.append(objects.add(op.obj))
-            op_key.append(keys.add(op.key) if op.key is not None else -1)
-            op_elem.append(int(op.elem) if op.elem is not None else -1)
-            tag, vi, vd, vs = _encode_value(op, strings)
-            op_vtag.append(tag)
-            op_vint.append(vi)
-            op_vdbl.append(vd)
-            op_vstr.append(vs)
-        op_off[i + 1] = len(op_action)
-
-    return WireColumns(
-        change_actor=change_actor, change_seq=change_seq,
-        change_msg=change_msg, deps_off=deps_off,
-        deps_actor=np.asarray(deps_actor, np.int32),
-        deps_seq=np.asarray(deps_seq, np.int32),
-        op_off=op_off,
-        op_action=np.asarray(op_action, np.int8),
-        op_obj=np.asarray(op_obj, np.int32),
-        op_key=np.asarray(op_key, np.int32),
-        op_elem=np.asarray(op_elem, np.int32),
-        op_vtag=np.asarray(op_vtag, np.int8),
-        op_vint=np.asarray(op_vint, np.int64),
-        op_vdbl=np.asarray(op_vdbl, np.float64),
-        op_vstr=np.asarray(op_vstr, np.int32),
-        actors=actors.items, objects=objects.items, keys=keys.items,
-        messages=messages.items, strings=strings.items)
-
-
-def _encode_value(op, strings: _Interner):
-    """(vtag, vint, vdbl, vstr) for one op, matching WireColumns.op_value."""
-    if op.action not in ("set", "link"):
-        return V_NONE, 0, 0.0, -1
-    v = op.value
-    if v is None:
-        return V_NULL, 0, 0.0, -1
-    if v is True:
-        return V_TRUE, 0, 0.0, -1
-    if v is False:
-        return V_FALSE, 0, 0.0, -1
-    if isinstance(v, int):
-        if _I64_MIN <= v <= _I64_MAX:
-            return V_INT, v, 0.0, -1
-        return V_BIGINT, 0, 0.0, strings.add(str(v))
-    if isinstance(v, float):
-        return V_DOUBLE, 0, float(v), -1
-    if isinstance(v, str):
-        return V_STR, 0, 0.0, strings.add(v)
-    raise TypeError(f"unsupported scalar value on the wire: {type(v).__name__}")
 
 
 # ---------------------------------------------------------------------------
@@ -245,6 +143,8 @@ def bytes_to_columns(data: bytes) -> WireColumns:
         messages=table(n_messages), strings=table(n_strings))
     if pos != len(data):
         raise ValueError(f"frame has {len(data) - pos} trailing bytes")
+    # retain the raw frame: it is the native delta encoder's direct input
+    cols.frame_bytes = bytes(data)
     return cols
 
 

@@ -122,11 +122,27 @@ class EngineDocSet:
         return handle
 
     def apply_columns(self, doc_id: str, cols) -> DocHandle:
-        """Columnar-frame ingress (sync/frames.py). This is the seam where
-        the native column-direct delta encoder plugs in; TODAY it
-        materializes Change objects once from the columns (one pass, no JSON)
-        and shares apply_changes."""
-        return self.apply_changes(doc_id, cols.to_changes())
+        """Columnar-frame ingress (sync/frames.py). With the native delta
+        encoder available the columns go straight to C++ interning/hashing
+        and the log keeps lazy refs into the frame — no per-op Python
+        objects exist unless a lagging peer later needs re-serving. The
+        fallback materializes Change objects once (one pass, no JSON)."""
+        with self._lock:
+            self.add_doc(doc_id)
+            if self._resident._native is not None:
+                self._resident.apply_columns({doc_id: cols})
+            else:
+                self._resident.apply_changes(
+                    {doc_id: cols.to_changes()})
+            admitted = self._resident.last_admitted.get(doc_id, [])
+            log = self._log[doc_id]
+            for c in admitted:
+                log.setdefault(c.actor, []).append(c)
+            handle = self.get_doc(doc_id)
+        if admitted:
+            for handler in list(self.handlers):
+                handler(doc_id, handle)
+        return handle
 
     # -- protocol reads -------------------------------------------------------
 
@@ -136,12 +152,15 @@ class EngineDocSet:
             return dict(self._resident.tables[i].clock)
 
     def missing_changes(self, doc_id: str, clock: dict[str, int]) -> list[Change]:
-        """Per-actor suffixes newer than `clock` (op_set.js:299-306)."""
+        """Per-actor suffixes newer than `clock` (op_set.js:299-306). Log
+        entries may be lazy frame refs; they materialize here, only for the
+        changes a lagging peer actually needs."""
         with self._lock:
             out: list[Change] = []
             for actor, changes in self._log.get(doc_id, {}).items():
                 have = clock.get(actor, 0)
-                out.extend(c for c in changes if c.seq > have)
+                out.extend(c if isinstance(c, Change) else c.change()
+                           for c in changes if c.seq > have)
             return out
 
     # -- engine reads ---------------------------------------------------------

@@ -423,24 +423,19 @@ def run_resident_rounds(doc_changes, n_rounds=6, fraction=0.2):
                     for r in rounds]
 
     # engine rounds via the fused single-dispatch path (first one warms the
-    # delta-shape compile). Rounds chain on-device (state donation); hash
-    # readbacks are collected asynchronously — the posture of a streaming
-    # sync service. The timed region starts from the wire frames (real
-    # ingress: decode + delta encode + scatter + reconcile).
+    # delta-shape compile). The timed region starts from the wire frames —
+    # the service's real ingress: frame decode + delta encode (native C++
+    # when available) + scatter + reconcile + hash readback.
     resident.apply_and_reconcile(rounds[0])
     t0 = time.perf_counter()
-    pending = []
     for frames in frame_rounds[1:]:
-        deltas = {d: decode_frame(f).to_changes() for d, f in frames.items()}
-        resident._register_actors(deltas)
-        flat, meta = resident._build_delta_arrays(deltas)
-        from automerge_tpu.engine.resident import _scatter_and_apply
-        resident.state, out = _scatter_and_apply(
-            resident.state, flat, meta, max_fids=resident.cap_fids)
-        pending.append(out["hash"])
-    _jax.block_until_ready(pending)
-    for h in pending:
-        np.asarray(h)
+        if resident._native is not None:
+            cols = {d: decode_frame(f) for d, f in frames.items()}
+            resident.apply_and_reconcile_columns(cols)
+        else:
+            deltas = {d: decode_frame(f).to_changes()
+                      for d, f in frames.items()}
+            resident.apply_and_reconcile(deltas)
     engine_round = (time.perf_counter() - t0) / max(len(rounds) - 1, 1)
 
     # oracle rounds (re-applying the same deltas to fresh copies)
