@@ -62,8 +62,20 @@ def _round_up(n: int, m: int) -> int:
 #
 # Row layout (all int32; see pack.pack_rows):
 #   op_mask[I] action[I] fid[I] actor[I] seq[I] change_idx[I]
-#   fid_hash[I] value_hash[I] clock[C*A] ins_mask[L*E] ins_fid[L*E]
-#   ins_pos[L*E] elem_objhash[L*E]
+#   fid_hash[I] value_hash[I] clock_op[A*I] ins_mask[L*E] ins_fid[L*E]
+#   ins_pos[L*E] elem_objhash[L*E] elem_list[L*E]
+# clock_op is each op's own change-clock row, stored actor-major
+# (row = a*I + i), so the kernel never indexes by change id and the change
+# count C is unbounded. elem_list is the owning-list row index per element
+# slot — a static iota pattern, never scattered.
+#
+# Every pairwise join (op x op domination, elem x op visibility,
+# elem x elem rank, op x elem hash keys) is a lax.fori_loop over 8-row
+# blocks of broadcasted compares: code size is O(1) in every dimension
+# (no Python unrolling), per-doc dims are bounded only by VMEM, and the
+# per-fid one-hots are gone entirely (fid equality is joined directly), so
+# the field count F is unbounded too.
+#
 # The hash must stay bit-identical to kernels.state_hash, so the murmur
 # finalizer is reproduced in int32 arithmetic (wraparound add/mul and
 # logical shifts give the same bits as the uint32 original).
@@ -90,101 +102,136 @@ def _mix4_i32(a, b, c, d):
     return h
 
 
-def _make_reconcile_kernel(I, C, A, L, E, F, a_set, a_del):
-    """Build the fused kernel body for static per-doc dims."""
-    LE = L * E
+# Pairwise-join block height (sublane-aligned). 8 rows of [*, 128] int32 is
+# one native TPU tile; every fori_loop below steps the j/elem axis in these
+# blocks so the biggest live intermediate is 8 * max(I, LE) * 128 * 4B.
+_BLK = 8
+
+
+def _make_reconcile_kernel(I, A, LE, a_set, a_del):
+    """Build the fused kernel body for static per-doc dims.
+
+    All joins are fori_loop-blocked broadcasted compares over the row axis;
+    nothing is unrolled, so compiled code size is independent of I/A/LE and
+    the per-doc field count F never appears at all.
+    """
     r_om, r_ac, r_fid, r_act, r_seq, r_chg, r_fh, r_vh = (
         0, I, 2 * I, 3 * I, 4 * I, 5 * I, 6 * I, 7 * I)
-    r_clock = 8 * I
-    r_imask = r_clock + C * A
+    r_co = 8 * I                  # clock_op, actor-major: row a*I + i
+    r_imask = r_co + A * I
     r_ifid = r_imask + LE
     r_ipos = r_ifid + LE
     r_iobj = r_ipos + LE
+    r_ilist = r_iobj + LE
 
-    def kernel(x_ref, o_ref):
+    def kernel(x_ref, o_ref, *scratch):
+        # Mosaic lowers dynamic block addressing only through refs, so every
+        # blocked join reads its j/elem block from x_ref via pl.ds and
+        # accumulates full-axis results either in a fori carry (pure
+        # accumulation) or a VMEM scratch ref (block stores).
         om = x_ref[r_om:r_om + I, :]
         action = x_ref[r_ac:r_ac + I, :]
         fid = x_ref[r_fid:r_fid + I, :]
         actor = x_ref[r_act:r_act + I, :]
         seq = x_ref[r_seq:r_seq + I, :]
-        chg = x_ref[r_chg:r_chg + I, :]
         fh = x_ref[r_fh:r_fh + I, :]
         vh = x_ref[r_vh:r_vh + I, :]
+        d = om.shape[1]
 
-        amask = (om > 0) & (action >= a_set)
+        amask = ((om > 0) & (action >= a_set)).astype(jnp.int32)
 
-        # cji[j, i] = clock(change of op j) at actor of op i    [I, I, 128]
-        # via static one-hot loops over the tiny C and A axes.
-        cj_by_a = []
-        for a in range(A):
-            acc = jnp.zeros_like(seq)
-            for c in range(C):
-                row = x_ref[r_clock + c * A + a, :]
-                acc = acc + jnp.where(chg == c, row[None, :], 0)
-            cj_by_a.append(acc)                      # [I, 128]
         # dominated[i] = any_j (amask_j & amask_i & fid_j==fid_i
-        #                & cji >= seq_i & chg_j != chg_i)
-        dominated = jnp.zeros_like(amask)
-        for j in range(I):
-            cji_j = jnp.zeros_like(seq)              # [I(i), 128]
-            for a in range(A):
-                cji_j = cji_j + jnp.where(actor == a,
-                                          cj_by_a[a][j][None, :], 0)
-            dom_j = (amask[j][None, :] & amask
-                     & (fid[j][None, :] == fid)
-                     & (cji_j >= seq)
-                     & (chg[j][None, :] != chg))
-            dominated = dominated | dom_j
-        survivor = amask & ~dominated
-        candidate = survivor & (action != a_del)
+        #                & clock_op[j, actor_i] >= seq_i & chg_j != chg_i)
+        # j blocked in _BLK rows; the actor-axis gather becomes an inner
+        # fori over A of (actor == a) selects against clock_op's a-th band.
+        chg = x_ref[r_chg:r_chg + I, :]
 
-        # per-fid presence (the hash path only needs whether a field has a
-        # surviving value, not the winner's identity)       [F rows of 128]
-        present = []
-        for f in range(F):
-            m_f = (fid == f) & amask
-            wa_f = jnp.max(jnp.where(m_f & candidate, actor, -1),
-                           axis=0, keepdims=True)    # [1, 128]
-            present.append(wa_f >= 0)
+        def dom_block(jb, dominated):
+            j0 = jb * _BLK
+            om_j = x_ref[pl.ds(r_om + j0, _BLK), :]
+            ac_j = x_ref[pl.ds(r_ac + j0, _BLK), :]
+            fid_j = x_ref[pl.ds(r_fid + j0, _BLK), :]
+            chg_j = x_ref[pl.ds(r_chg + j0, _BLK), :]
+            am_j = (om_j > 0) & (ac_j >= a_set)
+            base = (am_j[:, None, :] & (amask[None] > 0)
+                    & (fid_j[:, None, :] == fid[None])
+                    & (chg_j[:, None, :] != chg[None]))
+
+            def cp_a(a, acc):
+                cja = x_ref[pl.ds(r_co + a * I + j0, _BLK), :]
+                hit = ((actor[None] == a)
+                       & (cja[:, None, :] >= seq[None]))
+                return acc | hit.astype(jnp.int32)
+
+            cp = jax.lax.fori_loop(
+                0, A, cp_a, jnp.zeros((_BLK, I, d), jnp.int32))
+            return dominated | jnp.any(base & (cp > 0),
+                                       axis=0).astype(jnp.int32)
+
+        dominated = jax.lax.fori_loop(
+            0, I // _BLK, dom_block, jnp.zeros((I, d), jnp.int32))
+        survivor = (amask > 0) & (dominated == 0)
+        candidate = survivor & (action != a_del)
+        cand_i = candidate.astype(jnp.int32)
 
         if LE > 0:
+            vis_ref, rank_ref, isl_ref, oh_ref, rk_ref = scratch
             imask = x_ref[r_imask:r_imask + LE, :]
             ifid = x_ref[r_ifid:r_ifid + LE, :]
             ipos = x_ref[r_ipos:r_ipos + LE, :]
             iobj = x_ref[r_iobj:r_iobj + LE, :]
+            ilist = x_ref[r_ilist:r_ilist + LE, :]
             el_valid = (imask > 0) & (ifid >= 0)
-            pae = jnp.zeros_like(imask, dtype=jnp.bool_)
-            for f in range(F):
-                pae = pae | ((ifid == f) & present[f])
-            elem_visible = el_valid & pae
-            # visible rank inside each list           [L*E rows of 128]
-            ranks = []
-            for l in range(L):
-                pos_l = ipos[l * E:(l + 1) * E, :]
-                vis_l = elem_visible[l * E:(l + 1) * E, :]
-                acc = jnp.zeros_like(pos_l)
-                for e in range(E):
-                    lt = (pos_l[e][None, :] < pos_l)
-                    acc = acc + jnp.where(vis_l[e][None, :] & lt, 1, 0)
-                ranks.append(acc)
-            vis_rank = jnp.where(elem_visible,
-                                 jnp.concatenate(ranks, axis=0), -1)
-            # fid -> (is_list, owning-object hash, visible rank)
-            op_is_list = jnp.zeros_like(amask)
-            op_objhash = jnp.zeros_like(fid)
-            op_rank = jnp.zeros_like(fid)
-            for f in range(F):
-                efm = (ifid == f) & el_valid
-                isl = jnp.any(efm, axis=0, keepdims=True)
-                oh = jnp.max(jnp.where(efm, iobj, -1), axis=0, keepdims=True)
-                rk = jnp.max(jnp.where(efm, vis_rank, -1), axis=0,
-                             keepdims=True)
-                m_f = (fid == f) & amask
-                op_is_list = op_is_list | (m_f & isl)
-                op_objhash = op_objhash + jnp.where(m_f, oh, 0)
-                op_rank = op_rank + jnp.where(m_f, rk, 0)
-            key1 = jnp.where(op_is_list, op_objhash, jnp.int32(-7))
-            key2 = jnp.where(op_is_list, op_rank, fh)
+
+            # element visible iff its field has any surviving value-carrying
+            # op: a blocked elem x op join on fid equality.
+            def vis_block(eb, carry):
+                e0 = eb * _BLK
+                ifid_b = x_ref[pl.ds(r_ifid + e0, _BLK), :]
+                hit = jnp.any((ifid_b[:, None, :] == fid[None])
+                              & (cand_i[None] > 0), axis=1)
+                vis_ref[pl.ds(e0, _BLK), :] = hit.astype(jnp.int32)
+                return carry
+
+            jax.lax.fori_loop(0, LE // _BLK, vis_block, 0)
+            elem_visible = el_valid & (vis_ref[:] > 0)
+            vis_i = elem_visible.astype(jnp.int32)
+
+            # visible rank: count of visible same-list elements with a
+            # smaller RGA position (blocked elem x elem join).
+            def rank_block(eb, carry):
+                e0 = eb * _BLK
+                pos_b = x_ref[pl.ds(r_ipos + e0, _BLK), :]
+                lst_b = x_ref[pl.ds(r_ilist + e0, _BLK), :]
+                cnt = jnp.sum(
+                    jnp.where((lst_b[:, None, :] == ilist[None])
+                              & (vis_i[None] > 0)
+                              & (ipos[None] < pos_b[:, None, :]), 1, 0),
+                    axis=1)
+                rank_ref[pl.ds(e0, _BLK), :] = cnt
+                return carry
+
+            jax.lax.fori_loop(0, LE // _BLK, rank_block, 0)
+            vis_rank = jnp.where(elem_visible, rank_ref[:], -1)
+
+            # op -> (is_list, owning-object hash, visible rank): a blocked
+            # op x elem join on fid equality.
+            def opmap_block(jb, carry):
+                j0 = jb * _BLK
+                fid_b = x_ref[pl.ds(r_fid + j0, _BLK), :]
+                m = (fid_b[:, None, :] == ifid[None]) & el_valid[None]
+                isl_ref[pl.ds(j0, _BLK), :] = \
+                    jnp.any(m, axis=1).astype(jnp.int32)
+                oh_ref[pl.ds(j0, _BLK), :] = \
+                    jnp.max(jnp.where(m, iobj[None], -1), axis=1)
+                rk_ref[pl.ds(j0, _BLK), :] = \
+                    jnp.max(jnp.where(m, vis_rank[None], -1), axis=1)
+                return carry
+
+            jax.lax.fori_loop(0, I // _BLK, opmap_block, 0)
+            op_is_list = isl_ref[:]
+            key1 = jnp.where(op_is_list > 0, oh_ref[:], jnp.int32(-7))
+            key2 = jnp.where(op_is_list > 0, rk_ref[:], fh)
         else:
             key1 = jnp.full_like(fh, -7)
             key2 = fh
@@ -201,14 +248,28 @@ def reconcile_rows_hash(rows, dims: tuple, interpret: bool = False):
     """Fused reconcile + state hash over a docs-minor row buffer.
 
     rows: [ROWS, D_pad] int32 (see pack.pack_rows); dims is the static
-    (I, C, A, L, E, F, a_set, a_del) tuple. Returns [D_pad] uint32 per-doc
+    (I, A, LE, a_set, a_del) tuple. Returns [D_pad] uint32 per-doc
     state hashes, bit-identical to kernels.apply_doc(...)["hash"].
     """
     if not HAVE_PALLAS:
         raise RuntimeError("pallas unavailable on this backend")
-    I, C, A, L, E, F, a_set, a_del = dims
+    I, A, LE, a_set, a_del = dims
+    if I % _BLK or LE % _BLK:
+        # The blocked joins step in _BLK-row tiles with no tail handling; an
+        # unpadded dim would silently drop ops/elements from the joins and
+        # return a WRONG hash. In-repo producers pad via encode._pad_to.
+        raise ValueError(
+            f"megakernel dims must be multiples of {_BLK}: I={I}, LE={LE} "
+            f"(pad ops/elements before packing)")
     rows_n, d_pad = rows.shape
-    kernel = _make_reconcile_kernel(I, C, A, L, E, F, a_set, a_del)
+    kernel = _make_reconcile_kernel(I, A, LE, a_set, a_del)
+    scratch = []
+    if LE > 0:
+        scratch = [pltpu.VMEM((LE, 128), jnp.int32),   # elem visibility
+                   pltpu.VMEM((LE, 128), jnp.int32),   # elem rank
+                   pltpu.VMEM((I, 128), jnp.int32),    # op is-list
+                   pltpu.VMEM((I, 128), jnp.int32),    # op objhash
+                   pltpu.VMEM((I, 128), jnp.int32)]    # op rank
     out = pl.pallas_call(
         kernel,
         grid=(d_pad // 128,),
@@ -217,6 +278,7 @@ def reconcile_rows_hash(rows, dims: tuple, interpret: bool = False):
         out_specs=pl.BlockSpec((1, 128), lambda d: (0, d),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((1, d_pad), jnp.int32),
+        scratch_shapes=scratch,
         interpret=interpret,
     )(rows)
     return jax.lax.bitcast_convert_type(out[0], jnp.uint32)

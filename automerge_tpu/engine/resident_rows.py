@@ -70,22 +70,23 @@ class ResidentRowsDocSet(ResidentDocSet):
     # row layout
 
     def _bases(self):
-        I, C, A = self.cap_ops, self.cap_changes, self.cap_actors
+        I, A = self.cap_ops, self.cap_actors
         LE = self.cap_lists * self.cap_elems
         om = 0
+        co = 8 * I
         return {
             "om": om, "ac": om + I, "fid": om + 2 * I, "act": om + 3 * I,
             "seq": om + 4 * I, "chg": om + 5 * I, "fh": om + 6 * I,
-            "vh": om + 7 * I, "clk": 8 * I, "im": 8 * I + C * A,
-            "if": 8 * I + C * A + LE, "ip": 8 * I + C * A + 2 * LE,
-            "io": 8 * I + C * A + 3 * LE, "rows": 8 * I + C * A + 4 * LE,
+            "vh": om + 7 * I, "co": co, "im": co + A * I,
+            "if": co + A * I + LE, "ip": co + A * I + 2 * LE,
+            "io": co + A * I + 3 * LE, "il": co + A * I + 4 * LE,
+            "rows": co + A * I + 5 * LE,
         }
 
     def dims(self) -> tuple:
         from .encode import A_DEL, A_SET
-        return (self.cap_ops, self.cap_changes, self.cap_actors,
-                self.cap_lists, self.cap_elems, self.cap_fids,
-                int(A_SET), int(A_DEL))
+        return (self.cap_ops, self.cap_actors,
+                self.cap_lists * self.cap_elems, int(A_SET), int(A_DEL))
 
     def _alloc_rows(self):
         b = self._bases()
@@ -95,6 +96,11 @@ class ResidentRowsDocSet(ResidentDocSet):
         le = self.cap_lists * self.cap_elems
         self.rows_host[b["if"]:b["if"] + le] = -1
         self.rows_host[b["io"]:b["io"] + le] = -1
+        # elem_list is a static pattern (owning-list row per slot) shared by
+        # every doc; it never needs scattering.
+        self.rows_host[b["il"]:b["il"] + le] = np.repeat(
+            np.arange(self.cap_lists, dtype=np.int32),
+            self.cap_elems)[:, None]
 
     # the docs-major device state of the base class is never built
     def _alloc(self):
@@ -115,18 +121,19 @@ class ResidentRowsDocSet(ResidentDocSet):
         b = self._bases()
         self._alloc_rows()
         new = self.rows_host
-        I0, C0, A0 = old_caps["I"], old_caps["C"], old_caps["A"]
+        I0, A0 = old_caps["I"], old_caps["A"]
         L0, E0 = old_caps["L"], old_caps["E"]
         for g in ("om", "ac", "fid", "act", "seq", "chg", "fh", "vh"):
             new[b[g]:b[g] + I0] = old[old_b[g]:old_b[g] + I0]
-        # clock rows re-stride from (C0, A0) to (C, A)
-        clk = old[old_b["clk"]:old_b["clk"] + C0 * A0].reshape(C0, A0, -1)
-        new[b["clk"]:b["clk"] + self.cap_changes * self.cap_actors] \
-            .reshape(self.cap_changes, self.cap_actors, -1)[:C0, :A0] = clk
+        # clock_op bands re-stride from (A0, I0) to (A, I)
+        co = old[old_b["co"]:old_b["co"] + A0 * I0].reshape(A0, I0, -1)
+        new[b["co"]:b["co"] + self.cap_actors * self.cap_ops] \
+            .reshape(self.cap_actors, self.cap_ops, -1)[:A0, :I0] = co
         for g in ("im", "if", "ip", "io"):
             src = old[old_b[g]:old_b[g] + L0 * E0].reshape(L0, E0, -1)
             new[b[g]:b[g] + self.cap_lists * self.cap_elems] \
                 .reshape(self.cap_lists, self.cap_elems, -1)[:L0, :E0] = src
+        # il is static (re-filled by _alloc_rows for the new strides)
         self._dirty = True
 
     def _register_actors(self, changes_by_doc) -> None:
@@ -145,7 +152,7 @@ class ResidentRowsDocSet(ResidentDocSet):
         if not old_actors or not getattr(self, "_rows_ready", False):
             return
         b = self._bases()
-        I, C, A = self.cap_ops, self.cap_changes, self.cap_actors
+        I, A = self.cap_ops, self.cap_actors
         perm = np.array([self.actor_rank[a] for a in old_actors],
                         dtype=np.int32)
         act = self.rows_host[b["act"]:b["act"] + I]
@@ -153,11 +160,11 @@ class ResidentRowsDocSet(ResidentDocSet):
         safe = np.clip(act, 0, len(perm) - 1)
         self.rows_host[b["act"]:b["act"] + I] = np.where(
             om > 0, perm[safe], act)
-        clk = self.rows_host[b["clk"]:b["clk"] + C * A].reshape(C, A, -1)
-        remapped = np.zeros_like(clk)
+        co = self.rows_host[b["co"]:b["co"] + A * I].reshape(A, I, -1)
+        remapped = np.zeros_like(co)
         for old_rank, new_rank in enumerate(perm):
-            remapped[:, new_rank] = clk[:, old_rank]
-        self.rows_host[b["clk"]:b["clk"] + C * A] = remapped.reshape(C * A, -1)
+            remapped[new_rank] = co[old_rank]
+        self.rows_host[b["co"]:b["co"] + A * I] = remapped.reshape(A * I, -1)
         # actor ranks inside ins_log entries must follow the remap too
         for log in self.ins_log:
             for lrow, entries in log.items():
@@ -204,7 +211,10 @@ class ResidentRowsDocSet(ResidentDocSet):
         if need_ops.max(initial=0) > self.cap_ops:
             grow["cap_ops"] = _pad_to(int(need_ops.max()))
         if need_ch.max(initial=0) > self.cap_changes:
-            grow["cap_changes"] = _pad_to(int(need_ch.max()))
+            # change ids live in the rows themselves (clock_op replaced the
+            # per-change clock bands), so growing the change cap never
+            # re-layouts the buffer.
+            self.cap_changes = _pad_to(int(need_ch.max()))
         cur_elems = max((len(s) for t in self.tables
                          for s in t.elem_slots.values()), default=0)
         add_elems = max(n_elems.values(), default=0)
@@ -217,18 +227,26 @@ class ResidentRowsDocSet(ResidentDocSet):
         need_fids = max((len(self.tables[i].fields) + n
                          for i, n in new_fids.items()), default=0)
         if need_fids > self.cap_fids:
-            # cap_fids is only a static kernel parameter (field ids live in
-            # the rows themselves), so growing it costs a recompile, nothing
-            # else.
+            # field ids live in the rows themselves and the blocked kernel
+            # joins on fid equality directly, so the field count is
+            # unbounded: growing this bookkeeping cap costs nothing.
             self.cap_fids = _pad_to(need_fids)
         if grow:
             self._grow(**grow)
+        from .pack import rows_dims_eligible
+        le = self.cap_lists * self.cap_elems
+        if not rows_dims_eligible(self.cap_ops, self.cap_actors, le):
+            raise RuntimeError(
+                f"resident rows state outgrew the megakernel VMEM budget "
+                f"(ops={self.cap_ops}, actors={self.cap_actors}, "
+                f"elem slots={le}); shard this DocSet across more rows "
+                f"instances or use the docs-major ResidentDocSet")
 
     def _round_triplets(self, changes_by_doc) -> np.ndarray:
         """Encode one round into (P, 3) int32 scatter triplets
         (row, doc, value) and apply them to the host mirror."""
         b = self._bases()
-        A, E = self.cap_actors, self.cap_elems
+        I, E = self.cap_ops, self.cap_elems
         rows, docs, vals = [], [], []
 
         def put(r, d, v):
@@ -239,6 +257,7 @@ class ResidentRowsDocSet(ResidentDocSet):
             delta = self._encode_delta(i, changes)
             self.change_log[i].extend(delta.changes)
             s0 = int(self.op_count[i])
+            c0 = int(self.change_count[i])
             for k, (code, fid, arank, seq, chg, _value, fh, vh) in enumerate(
                     delta.ops):
                 s = s0 + k
@@ -250,11 +269,11 @@ class ResidentRowsDocSet(ResidentDocSet):
                 put(b["chg"] + s, i, chg)
                 put(b["fh"] + s, i, fh)
                 put(b["vh"] + s, i, vh)
-            c0 = int(self.change_count[i])
-            for k, row in enumerate(delta.clocks):
-                c = c0 + k
+                # the op's own change-clock row, scattered into the
+                # actor-major clock_op bands
+                row = delta.clocks[chg - c0]
                 for a in np.nonzero(row)[0]:
-                    put(b["clk"] + c * A + int(a), i, row[a])
+                    put(b["co"] + int(a) * I + s, i, row[a])
             for (lrow, oi, objhash) in delta.new_lists:
                 self.list_hash[i][lrow] = objhash
             touched_lists = set()

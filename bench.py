@@ -14,10 +14,9 @@ the full job: parse/ingest changes, converge state, and expose a readable
 result.
 
 Usage:
-  python bench.py              # headline: config 5 (10K-doc DocSet merge)
-  python bench.py --config N   # run config N in {1..5}
+  python bench.py              # all five configs; headline = config 5
+  python bench.py --config N   # run only config N in {1..5}
   python bench.py --docs M     # override document count
-  python bench.py --all        # run every config; headline line stays last
 
 Prints ONE final JSON line:
   {"metric": ..., "value": N, "unit": "ops/sec", "vs_baseline": N, ...}
@@ -230,8 +229,11 @@ def run_engine(doc_changes, repeat=10):
     import jax.numpy as jnp
     from functools import partial
     from automerge_tpu.engine.encode import encode_doc, stack_docs
-    from automerge_tpu.engine.pack import (apply_packed_hash, pack_batch,
-                                           pack_rows, rows_eligible)
+    from automerge_tpu.engine.pack import (ROWS_MAX_ELEMS, ROWS_MAX_OPS,
+                                           ROWS_VMEM_BUDGET,
+                                           apply_packed_hash, pack_batch,
+                                           pack_rows, rows_count,
+                                           rows_eligible)
     from automerge_tpu.engine.pallas_kernels import (HAVE_PALLAS,
                                                      reconcile_rows_hash)
 
@@ -240,14 +242,28 @@ def run_engine(doc_changes, repeat=10):
     encodings = [encode_doc(changes, all_actors) for changes in doc_changes]
     batch = stack_docs(encodings)
     max_fids = batch.pop("max_fids")
-    use_rows = (HAVE_PALLAS and jax.default_backend() == "tpu"
-                and rows_eligible(batch, max_fids))
+    eligible = rows_eligible(batch, max_fids)
+    use_rows = (HAVE_PALLAS and jax.default_backend() == "tpu" and eligible)
+    d_, i_ = batch["op_mask"].shape
+    a_ = batch["clock"].shape[2]
+    l_, e_ = batch["ins_mask"].shape[1:]
+    kernel_info = {
+        "rows_kernel_used": bool(use_rows),
+        "rows_kernel_eligible": bool(eligible),
+        # the blocked megakernel's only caps are VMEM-driven (pack.py):
+        # per-doc dims this batch vs the eligibility cutoffs
+        "per_doc_dims": {"ops": int(i_), "actors": int(a_),
+                         "elems": int(l_ * e_), "fids": int(max_fids),
+                         "rows": rows_count(i_, a_, l_ * e_)},
+        "eligibility_cutoff": {"ops": ROWS_MAX_OPS, "elems": ROWS_MAX_ELEMS,
+                               "vmem_budget_rows": ROWS_VMEM_BUDGET},
+    }
     if use_rows:
         wire, dims, n_docs = pack_rows(batch, max_fids)
     else:
-        wire, meta = pack_batch(batch)
+        wire_packed, meta = pack_batch(batch)
+        wire = wire_packed
     encode_time = time.perf_counter() - t0
-    del batch
 
     if use_rows:
         @partial(jax.jit, static_argnames=("dims",))
@@ -272,7 +288,31 @@ def run_engine(doc_changes, repeat=10):
 
     # Warmup: compile AND exercise the transfer + readback paths (the tunnel
     # pays large one-time costs on the first use of each shape/direction).
-    np.asarray(dispatch([jnp.asarray(b) for b in buffers]))
+    try:
+        np.asarray(dispatch([jnp.asarray(b) for b in buffers]))
+    except Exception as e:
+        if not use_rows:
+            raise
+        # The VMEM working-set model in pack.rows_dims_eligible was
+        # optimistic for this shape: fall back to the packed XLA path
+        # instead of losing the config.
+        kernel_info["rows_kernel_used"] = False
+        kernel_info["rows_kernel_fallback_error"] = repr(e)[:200]
+        use_rows = False
+        wire, meta = pack_batch(batch)
+
+        @partial(jax.jit, static_argnames=("meta", "max_fids"))
+        def apply_all_fallback(arrs, meta, max_fids):
+            return jnp.stack([
+                apply_packed_hash.__wrapped__(a, meta, max_fids, True)
+                for a in arrs])
+
+        def dispatch(arrs):  # noqa: F811
+            return apply_all_fallback(tuple(arrs), meta, max_fids)
+
+        buffers = [wire.copy() for _ in range(repeat)]
+        np.asarray(dispatch([jnp.asarray(b) for b in buffers]))
+    del batch
 
     # Timed: ship every pass's buffer, barrier on the transfers, run ONE
     # dispatch covering every pass, drain all hashes in one readback.
@@ -290,7 +330,7 @@ def run_engine(doc_changes, repeat=10):
     t0 = time.perf_counter()
     np.asarray(dispatch(arrs))
     device_time = (time.perf_counter() - t0) / repeat
-    return end_to_end, device_time, encode_time
+    return end_to_end, device_time, encode_time, kernel_info
 
 
 def check_parity(doc_changes, sample=5):
@@ -483,7 +523,7 @@ def run_config(cfg: int, n_docs: int | None = None, oracle_cap_docs=1000):
         subset, scale = doc_changes, 1.0
         oracle_time = run_oracle(subset)
 
-    engine_time, device_time, encode_time = run_engine(doc_changes)
+    engine_time, device_time, encode_time, kernel_info = run_engine(doc_changes)
     check_parity(doc_changes)
 
     resident = {}
@@ -520,6 +560,7 @@ def run_config(cfg: int, n_docs: int | None = None, oracle_cap_docs=1000):
         "device_ops_per_s": round(ops / device_time),
         "speedup": round(oracle_time / engine_time, 2),
         "device_speedup": round(oracle_time / device_time, 1),
+        "megakernel": kernel_info,
         "parity": True,
     }
 
@@ -581,7 +622,7 @@ def worker_main(args):
     _load_package()
 
     rc = 0
-    configs = list(CONFIGS) if args.all else [args.config]
+    configs = [args.config] if args.config else list(CONFIGS)
     for cfg in configs:
         if cfg in args.skip:
             continue
@@ -618,7 +659,7 @@ def parent_main(args, passthrough: list[str]):
     plan = ((1, False), (2, False), (3, True))
     for attempt, force_cpu in plan:
         done_cfgs = set(results_by_cfg)
-        want = set(CONFIGS) if args.all else {args.config}
+        want = {args.config} if args.config else set(CONFIGS)
         if want <= done_cfgs:
             break
         remaining = deadline - time.time()
@@ -689,9 +730,11 @@ def parent_main(args, passthrough: list[str]):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--config", type=int, default=5)
+    ap.add_argument("--config", type=int, default=None,
+                    help="run only this config (default: all five)")
     ap.add_argument("--docs", type=int, default=None)
-    ap.add_argument("--all", action="store_true")
+    ap.add_argument("--all", action="store_true",
+                    help="(default behavior; kept for compatibility)")
     ap.add_argument("--worker", action="store_true")
     ap.add_argument("--force-cpu", action="store_true")
     ap.add_argument("--skip", type=lambda s: {int(x) for x in s.split(",") if x},
@@ -703,9 +746,7 @@ def main():
         return
 
     passthrough = []
-    if args.all:
-        passthrough.append("--all")
-    else:
+    if args.config:
         passthrough += ["--config", str(args.config)]
     if args.docs:
         passthrough += ["--docs", str(args.docs)]

@@ -146,6 +146,32 @@ def test_reconcile_rows_lists_and_tombstones():
     np.testing.assert_array_equal(ref, got)
 
 
+def test_reconcile_rows_large_dims():
+    """VERDICT r1 #5 done-criterion: the blocked megakernel handles I>=256
+    and F>=128 per doc (far past the old unrolled kernel's 64-caps) with
+    bit-identical hashes vs the XLA path."""
+    import automerge_tpu as am
+
+    big = am.change(am.init("A"), lambda d: d.__setitem__(
+        "xs", list(range(12))))
+    for i in range(130):
+        big = am.change(big, lambda d, i=i: d.__setitem__(f"k{i}", i))
+    b2 = am.change(am.merge(am.init("B"), big),
+                   lambda d: d.__setitem__("k3", -1))
+    big = am.merge(big, b2)
+    changes = big._doc.opset.get_missing_changes({})
+
+    from automerge_tpu.engine.encode import encode_doc, stack_docs
+    actors = sorted({c.actor for c in changes})
+    batch = stack_docs([encode_doc(changes, actors)] * 2)
+    max_fids = batch.pop("max_fids")
+    assert batch["op_mask"].shape[1] >= 256
+    assert max_fids >= 128
+
+    ref, got = _hash_both_ways([changes] * 2)
+    np.testing.assert_array_equal(ref, got)
+
+
 def test_reconcile_rows_convergence_hash():
     """Two replicas that merged in opposite orders hash identically through
     the megakernel (delivery-order independence)."""
