@@ -486,21 +486,20 @@ class ResidentDocSet:
             self.last_admitted[doc_id] = deltas[i].changes
         return self._stack_deltas(deltas)
 
-    def _build_delta_arrays_cols(self, cols_by_doc: dict):
-        """Columnar round encode: admission + clock rows in Python (per
-        change), ONE batched native call set for all per-op work (interning,
-        hashing, row building) across every document in the round. The C++
-        side reads the raw AMW1 frame bytes directly — the wire format IS
-        the encoder input, so ingest pays no Python-side merge or re-blob."""
+    def _native_ingest_round(self, cols_by_doc: dict, on_admitted):
+        """Shared native-encode round protocol: per-doc causal admission in
+        sorted doc order, frame dedup, admitted-metadata assembly, ONE
+        batched native call straight from raw AMW1 frame bytes, and the
+        capacity-stats mirror. `on_admitted(i, t, ready)` runs per doc with
+        its admitted _Pending list for caller-specific bookkeeping (clock
+        rows, change logs) before metadata assembly. Returns
+        (BatchDelta | None, adm_doc, cidxs) — None when nothing was
+        admitted."""
         from ..native.delta import frame_bytes_of
 
-        n = self.cap_docs
-        deltas = [Delta() for _ in range(n)]
-        self.last_admitted = {}
-
-        # 1. causal admission + clock rows, per doc (doc order fixed so the
-        # native batch emits doc-grouped rows we can slice by searchsorted)
-        ready_by_doc: list[tuple[int, list[_Pending]]] = []
+        frames: list[bytes] = []
+        frame_of: dict[int, int] = {}
+        adm_frame, adm_idx, adm_doc, aranks, seqs, cidxs = [], [], [], [], [], []
         for doc_id in sorted(cols_by_doc, key=lambda d: self.doc_index[d]):
             cols = cols_by_doc[doc_id]
             i = self.doc_index[doc_id]
@@ -509,33 +508,12 @@ class ResidentDocSet:
                 _Pending(cols.actors[cols.change_actor[j]],
                          int(cols.change_seq[j]), cols.deps_at(j), (cols, j))
                 for j in range(cols.n_changes)])
-            deltas[i].changes = [AdmittedRef(*p.payload) for p in ready]
-            self.last_admitted[doc_id] = deltas[i].changes
+            on_admitted(i, t, ready)
             for p in ready:
-                deltas[i].clocks.append(
-                    self._clock_row(t, p.actor, p.seq, p.deps))
-            if ready:
-                ready_by_doc.append((i, ready))
-        if not ready_by_doc:
-            return self._stack_deltas(deltas)
-
-        # 2. collect the frames that actually had admissions (queued changes
-        # may reference frames from earlier rounds)
-        frames: list[bytes] = []
-        frame_of: dict[int, int] = {}
-        for _, ready in ready_by_doc:
-            for p in ready:
-                c = p.payload[0]
+                c, j = p.payload
                 if id(c) not in frame_of:
                     frame_of[id(c)] = len(frames)
                     frames.append(frame_bytes_of(c))
-
-        # 3. admitted metadata arrays (admission order, grouped by doc)
-        adm_frame, adm_idx, adm_doc, aranks, seqs, cidxs = [], [], [], [], [], []
-        for i, ready in ready_by_doc:
-            t = self.tables[i]
-            for p in ready:
-                c, j = p.payload
                 adm_frame.append(frame_of[id(c)])
                 adm_idx.append(j)
                 adm_doc.append(i)
@@ -543,15 +521,42 @@ class ResidentDocSet:
                 seqs.append(p.seq)
                 cidxs.append(t.n_changes)
                 t.n_changes += 1
+        if not adm_doc:
+            return None, adm_doc, cidxs
 
-        # 4. one native batch straight from frame bytes
         self._native.ensure_docs(len(self.doc_ids))
         self._native.begin()
         self._native.apply_frames(frames, adm_frame, adm_idx, adm_doc,
                                   aranks, seqs, cidxs)
         bd = self._native.finish()
+        for i in range(min(len(self.tables), len(bd.stats))):
+            t = self.tables[i]
+            t.n_lists = int(bd.stats[i, 0])
+            t.max_elems = int(bd.stats[i, 1])
+        return bd, adm_doc, cidxs
 
-        # 5. slice doc-grouped rows into per-doc deltas
+    def _build_delta_arrays_cols(self, cols_by_doc: dict):
+        """Columnar round encode: admission + clock rows in Python (per
+        change), ONE batched native call set for all per-op work (interning,
+        hashing, row building) across every document in the round. The C++
+        side reads the raw AMW1 frame bytes directly — the wire format IS
+        the encoder input, so ingest pays no Python-side merge or re-blob."""
+        n = self.cap_docs
+        deltas = [Delta() for _ in range(n)]
+        self.last_admitted = {}
+
+        def on_admitted(i, t, ready):
+            deltas[i].changes = [AdmittedRef(*p.payload) for p in ready]
+            self.last_admitted[self.doc_ids[i]] = deltas[i].changes
+            for p in ready:
+                deltas[i].clocks.append(
+                    self._clock_row(t, p.actor, p.seq, p.deps))
+
+        bd, adm_doc, _ = self._native_ingest_round(cols_by_doc, on_admitted)
+        if bd is None:
+            return self._stack_deltas(deltas)
+
+        # slice doc-grouped rows into per-doc deltas
         for rows, attr in ((bd.op_rows, "ops"), (bd.ins_rows, "ins"),
                            (bd.newlist_rows, "new_lists")):
             if len(rows):
@@ -560,18 +565,14 @@ class ResidentDocSet:
                     lo, hi = bounds[i], bounds[i + 1]
                     if hi > lo:
                         setattr(deltas[i], attr, rows[lo:hi, 1:])
-        # mirror table additions + capacity stats
+        # mirror table additions
         for d, name, kind in bd.new_objects:
             self.tables[d].objects.append((name, kind))
         for d, oi, key in bd.new_fields:
             self.tables[d].fields.append((oi, key))
         for d, v in bd.new_values:
             self.tables[d].value_list.append(v)
-        for i in range(min(len(self.tables), len(bd.stats))):
-            t = self.tables[i]
-            t.n_lists = int(bd.stats[i, 0])
-            t.max_elems = int(bd.stats[i, 1])
-        for i, _ in ready_by_doc:
+        for i in set(adm_doc):
             self.tables[i].n_ops += len(deltas[i].ops)
         return self._stack_deltas(deltas)
 

@@ -39,13 +39,15 @@ def _ceil128(n: int) -> int:
 class ResidentRowsDocSet(ResidentDocSet):
     """Resident DocSet whose device state IS the megakernel row buffer."""
 
-    def __init__(self, doc_ids, actors: list[str] = ()):  # noqa: B006
+    def __init__(self, doc_ids, actors: list[str] = (),  # noqa: B006
+                 native: bool | None = None):
         self._rows_ready = False
-        # The rows flow drives _encode_delta with Change objects directly
-        # (docs-minor triplets have their own scatter layout); the native
-        # columnar encoder has no rows output mode yet, so pin the Python
-        # path — mixing encoders on one instance desyncs interning tables.
-        super().__init__(doc_ids, native=False)
+        # One delta encoder per instance (same rule as the base class): when
+        # the native C++ encoder is available, ALL ingress routes through it
+        # (Change rounds are converted to columns first), so its interning
+        # tables stay authoritative; otherwise the Python _encode_delta path
+        # runs. Mixing encoders on one instance would desync interning state.
+        super().__init__(doc_ids, native=native)
         self.n_pad = _ceil128(max(len(self.doc_ids), 1))
         # per-doc: list_row -> [(slot, elem, arank, parent_slot), ...]
         self.ins_log: list[dict[int, list[tuple]]] = [
@@ -136,12 +138,12 @@ class ResidentRowsDocSet(ResidentDocSet):
         # il is static (re-filled by _alloc_rows for the new strides)
         self._dirty = True
 
-    def _register_actors(self, changes_by_doc) -> None:
+    # _register_actors/_register_actors_cols are inherited from the base
+    # class; only the remap sink differs (host rows mirror vs device state).
+    def _register_actor_names(self, new: set) -> None:
         """Host-mirror version of the base remap (act rows through perm,
-        clock columns re-gathered)."""
-        new = {c.actor for changes in changes_by_doc.values()
-               for c in changes}
-        new -= set(self.actors)
+        clock_op bands re-gathered)."""
+        new = set(new) - set(self.actors)
         if not new:
             return
         old_actors = list(self.actors)
@@ -231,16 +233,27 @@ class ResidentRowsDocSet(ResidentDocSet):
             # joins on fid equality directly, so the field count is
             # unbounded: growing this bookkeeping cap costs nothing.
             self.cap_fids = _pad_to(need_fids)
+        # budget-check the PROSPECTIVE caps before _grow re-lays the buffer:
+        # a rejected batch must leave the instance fully usable
+        self._check_rows_budget(
+            grow.get("cap_ops", self.cap_ops),
+            grow.get("cap_lists", self.cap_lists)
+            * grow.get("cap_elems", self.cap_elems))
         if grow:
             self._grow(**grow)
+
+    def _check_rows_budget(self, cap_ops: int | None = None,
+                           le: int | None = None) -> None:
         from .pack import rows_dims_eligible
-        le = self.cap_lists * self.cap_elems
-        if not rows_dims_eligible(self.cap_ops, self.cap_actors, le):
+        cap_ops = self.cap_ops if cap_ops is None else cap_ops
+        le = self.cap_lists * self.cap_elems if le is None else le
+        if not rows_dims_eligible(cap_ops, self.cap_actors, le):
             raise RuntimeError(
-                f"resident rows state outgrew the megakernel VMEM budget "
-                f"(ops={self.cap_ops}, actors={self.cap_actors}, "
-                f"elem slots={le}); shard this DocSet across more rows "
-                f"instances or use the docs-major ResidentDocSet")
+                f"this batch would grow the resident rows state past the "
+                f"megakernel VMEM budget (ops={cap_ops}, "
+                f"actors={self.cap_actors}, elem slots={le}); shard this "
+                f"DocSet across more rows instances or use the docs-major "
+                f"ResidentDocSet")
 
     def _round_triplets(self, changes_by_doc) -> np.ndarray:
         """Encode one round into (P, 3) int32 scatter triplets
@@ -329,18 +342,52 @@ class ResidentRowsDocSet(ResidentDocSet):
         `hashes()` call after the batch). The FINAL round's hash always
         equals the canonical post-batch hash.
         """
+        if self._native is not None:
+            from ..native.wire import changes_to_columns
+            return self.apply_rounds_cols(
+                [{d: changes_to_columns(chs) for d, chs in r.items()}
+                 for r in rounds], interpret)
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
         for r in rounds:
             self._register_actors(r)
         self._reserve_for(rounds)
-        pre_dirty = self._dirty
-        pre_rows = self.rows_host.copy() if pre_dirty or self.rows_dev is None \
-            else None
+        pre_rows = self.rows_host.copy() \
+            if self._dirty or self.rows_dev is None else None
         trip_list = [self._round_triplets(r) for r in rounds]
+        return self._dispatch_rounds(trip_list, pre_rows, interpret)
+
+    def apply_rounds_cols(self, rounds, interpret: bool | None = None):
+        """Columnar-native variant of apply_rounds: each round maps doc_id ->
+        WireColumns (a decoded wire frame). Ingress is frame bytes -> native
+        C++ delta encoder -> vectorized numpy triplet assembly -> one scan
+        dispatch; no per-op Python anywhere on the path (the round's causal
+        admission and clock rows stay per-CHANGE Python, as in the base
+        class's apply_columns). Same return and actor-universe semantics as
+        apply_rounds."""
+        if self._native is None:
+            return self.apply_rounds(
+                [{d: c.to_changes() for d, c in r.items()} for r in rounds],
+                interpret)
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        for r in rounds:
+            self._register_actors_cols(r)
+        # Reject an oversized batch BEFORE admission mutates any state
+        # (seen-sets, clocks, change logs, C++ tables); afterwards the
+        # instance could no longer retry the same changes.
+        self._precheck_rows_budget_cols(rounds)
+        encoded = [self._native_encode_round(r) for r in rounds]
+        self._grow_for_rounds(encoded)
+        pre_rows = self.rows_host.copy() \
+            if self._dirty or self.rows_dev is None else None
+        trip_list = [self._cols_triplets(e) for e in encoded]
+        return self._dispatch_rounds(trip_list, pre_rows, interpret)
+
+    def _dispatch_rounds(self, trip_list, pre_rows, interpret):
         p = _pad_to(max((len(t) for t in trip_list), default=1), 8)
         oob = self._bases()["rows"]  # out-of-range row => dropped by scatter
-        stacked = np.full((len(rounds), p, 3), 0, dtype=np.int32)
+        stacked = np.full((len(trip_list), p, 3), 0, dtype=np.int32)
         for k, t in enumerate(trip_list):
             stacked[k, :len(t)] = t
             stacked[k, len(t):, 0] = oob
@@ -350,6 +397,196 @@ class ResidentRowsDocSet(ResidentDocSet):
         self.rows_dev, hashes = _scan_rounds(
             self.rows_dev, jnp.asarray(stacked), self.dims(), interpret)
         return np.asarray(hashes)[:, :len(self.doc_ids)]
+
+    # ------------------------------------------------------------------
+    # native columnar ingress
+
+    def _precheck_rows_budget_cols(self, rounds) -> None:
+        """Upper-bound VMEM-budget check from the submitted columns plus the
+        causal queues, BEFORE any admission runs (the cols analog of
+        _reserve_for's ordering). Conservative: duplicates and non-admitted
+        changes are counted as if applied; the exact post-encode check in
+        _grow_for_rounds still runs."""
+        from ..storage import _ACTION_IDX
+        ins_idx = _ACTION_IDX["ins"]
+        list_idxs = (_ACTION_IDX["makeList"], _ACTION_IDX["makeText"])
+
+        need_ops = self.op_count.copy()
+        n_elems: dict[int, int] = {}
+        n_lists: dict[int, int] = {}
+
+        def count(i, cols, j):
+            o0, o1 = int(cols.op_off[j]), int(cols.op_off[j + 1])
+            need_ops[i] += o1 - o0
+            acts = np.asarray(cols.op_action[o0:o1])
+            n_elems[i] = n_elems.get(i, 0) + int((acts == ins_idx).sum())
+            n_lists[i] = n_lists.get(i, 0) + int(
+                np.isin(acts, list_idxs).sum())
+
+        for i, t in enumerate(self.tables):
+            for p in t.queue:  # native instances queue (cols, j) payloads
+                count(i, *p.payload)
+        for r in rounds:
+            for doc_id, cols in r.items():
+                i = self.doc_index[doc_id]
+                for j in range(cols.n_changes):
+                    count(i, cols, j)
+
+        cap_ops = max(self.cap_ops,
+                      _pad_to(int(need_ops.max(initial=1))))
+        cur_elems = max((t.max_elems for t in self.tables), default=0)
+        cap_elems = max(self.cap_elems, _pad_to(
+            cur_elems + max(n_elems.values(), default=0)))
+        cur_lists = max((t.n_lists for t in self.tables), default=0)
+        cap_lists = max(self.cap_lists, _pad_to(
+            cur_lists + max(n_lists.values(), default=0), 1))
+        from .pack import rows_dims_eligible
+        if not rows_dims_eligible(cap_ops, self.cap_actors,
+                                  cap_lists * cap_elems):
+            raise RuntimeError(
+                f"this batch could grow the resident rows state past the "
+                f"megakernel VMEM budget (ops<={cap_ops}, "
+                f"actors={self.cap_actors}, elem slots<="
+                f"{cap_lists * cap_elems}); shard this DocSet across more "
+                f"rows instances or use the docs-major ResidentDocSet")
+
+    def _native_encode_round(self, cols_by_doc):
+        """Causal admission (Python, per change) + ONE native batch encode
+        for the round (shared protocol in the base class). Returns the
+        native BatchDelta plus the admission-aligned clock matrix, or None
+        if nothing was admitted."""
+        from .resident import AdmittedRef
+
+        clock_rows = []
+
+        def on_admitted(i, t, ready):
+            self.change_log[i].extend(
+                AdmittedRef(*p.payload) for p in ready)
+            for p in ready:
+                clock_rows.append(self._clock_row(t, p.actor, p.seq, p.deps))
+
+        bd, adm_doc, cidxs = self._native_ingest_round(cols_by_doc,
+                                                       on_admitted)
+        if bd is None:
+            return None
+        return {
+            "bd": bd,
+            "clock_mat": np.stack(clock_rows),
+            "adm_doc": np.asarray(adm_doc, np.int64),
+            "adm_cidx": np.asarray(cidxs, np.int64),
+        }
+
+    def _grow_for_rounds(self, encoded) -> None:
+        """Exact capacity growth from the already-encoded rounds (the native
+        encoder reports precisely which op/elem/list slots each round fills,
+        so no estimation is needed)."""
+        need_ops = self.op_count.copy()
+        for enc in encoded:
+            if enc is None:
+                continue
+            doc = enc["bd"].op_rows[:, 0]
+            if len(doc):
+                ids, cnts = np.unique(doc, return_counts=True)
+                need_ops[ids] += cnts
+        grow = {}
+        if need_ops.max(initial=0) > self.cap_ops:
+            grow["cap_ops"] = _pad_to(int(need_ops.max()))
+        need_lists = max((t.n_lists for t in self.tables), default=0)
+        need_elems = max((t.max_elems for t in self.tables), default=0)
+        if need_lists > self.cap_lists:
+            grow["cap_lists"] = _pad_to(need_lists, 1)
+        if need_elems > self.cap_elems:
+            grow["cap_elems"] = _pad_to(need_elems)
+        self._check_rows_budget(
+            grow.get("cap_ops", self.cap_ops),
+            grow.get("cap_lists", self.cap_lists)
+            * grow.get("cap_elems", self.cap_elems))
+        if grow:
+            self._grow(**grow)
+        need_ch = int(max((t.n_changes for t in self.tables), default=0))
+        if need_ch > self.cap_changes:
+            self.cap_changes = _pad_to(need_ch)
+
+    def _cols_triplets(self, enc) -> np.ndarray:
+        """Vectorized scatter-triplet assembly from one round's BatchDelta
+        (the numpy replacement for _round_triplets' per-op Python loop)."""
+        if enc is None:
+            return np.zeros((0, 3), np.int32)
+        b = self._bases()
+        I, E = self.cap_ops, self.cap_elems
+        bd = enc["bd"]
+        parts_r, parts_d, parts_v = [], [], []
+
+        op = bd.op_rows.astype(np.int64)
+        if len(op):
+            doc = op[:, 0]
+            # rows are doc-grouped in admission order: within-group index
+            # via each row's group start
+            starts = np.searchsorted(doc, doc, side="left")
+            slot = self.op_count[doc] + (np.arange(len(op)) - starts)
+            for g, v in (("om", np.ones(len(op), np.int64)), ("ac", op[:, 1]),
+                         ("fid", op[:, 2]), ("act", op[:, 3]),
+                         ("seq", op[:, 4]), ("chg", op[:, 5]),
+                         ("fh", op[:, 7]), ("vh", op[:, 8])):
+                parts_r.append(b[g] + slot)
+                parts_d.append(doc)
+                parts_v.append(v)
+            # per-op change-clock rows into the actor-major clock_op bands;
+            # (doc, cidx) keys are ascending in both arrays, so the op ->
+            # admitted-change join is one searchsorted
+            key_adm = enc["adm_doc"] * (1 << 32) + enc["adm_cidx"]
+            key_op = doc * (1 << 32) + op[:, 5]
+            ai = np.searchsorted(key_adm, key_op)
+            cmat = enc["clock_mat"][ai]                      # [k, A]
+            oi, a = np.nonzero(cmat)
+            parts_r.append(b["co"] + a * I + slot[oi])
+            parts_d.append(doc[oi])
+            parts_v.append(cmat[oi, a])
+            ids, cnts = np.unique(doc, return_counts=True)
+            self.op_count[ids] += cnts
+        ids, cnts = np.unique(enc["adm_doc"], return_counts=True)
+        self.change_count[ids] += cnts
+
+        for (d, lrow, _oi, objhash) in bd.newlist_rows:
+            self.list_hash[int(d)][int(lrow)] = int(objhash)
+
+        ins = bd.ins_rows
+        if len(ins):
+            from ..native.linearize import linearize_host
+            touched = set()
+            ir, idd, iv = [], [], []
+            for (d, lrow, slot_, elem, arank, parent_slot, fid) in ins:
+                d, lrow, slot_ = int(d), int(lrow), int(slot_)
+                self.ins_log[d].setdefault(lrow, []).append(
+                    (slot_, int(elem), int(arank), int(parent_slot)))
+                le = lrow * E + slot_
+                ir += [b["im"] + le, b["if"] + le, b["io"] + le]
+                idd += [d, d, d]
+                iv += [1, int(fid), self.list_hash[d][lrow]]
+                touched.add((d, lrow))
+            parts_r.append(np.asarray(ir, np.int64))
+            parts_d.append(np.asarray(idd, np.int64))
+            parts_v.append(np.asarray(iv, np.int64))
+            for (d, lrow) in touched:
+                entries = self.ins_log[d][lrow]
+                n = len(entries)
+                mask = np.ones(n, dtype=bool)
+                elem = np.array([e for (_, e, _, _) in entries], np.int32)
+                arank = np.array([a for (_, _, a, _) in entries], np.int32)
+                parent = np.array([p for (_, _, _, p) in entries], np.int32)
+                slots = np.array([s for (s, _, _, _) in entries], np.int64)
+                pos = linearize_host(mask, elem, arank, parent)
+                parts_r.append(b["ip"] + lrow * E + slots)
+                parts_d.append(np.full(n, d, np.int64))
+                parts_v.append(np.asarray(pos, np.int64))
+
+        if not parts_r:
+            return np.zeros((0, 3), np.int32)
+        trips = np.stack([np.concatenate(parts_r),
+                          np.concatenate(parts_d),
+                          np.concatenate(parts_v)], axis=1).astype(np.int32)
+        self.rows_host[trips[:, 0], trips[:, 1]] = trips[:, 2]
+        return trips
 
     def hashes(self, interpret: bool | None = None) -> np.ndarray:
         """Current per-doc state hashes from resident state."""
@@ -368,9 +605,13 @@ class ResidentRowsDocSet(ResidentDocSet):
         from .. import api
         from ..frontend.materialize import apply_changes_to_doc
 
+        from .resident import AdmittedRef
+
         i = self.doc_index[doc_id]
         doc = api.init("resident-view")
-        doc = apply_changes_to_doc(doc, doc._doc.opset, self.change_log[i],
+        changes = [c.change() if isinstance(c, AdmittedRef) else c
+                   for c in self.change_log[i]]
+        doc = apply_changes_to_doc(doc, doc._doc.opset, changes,
                                    incremental=False)
         from .batchdoc import oracle_state
         return oracle_state(doc)

@@ -258,29 +258,30 @@ def run_engine(doc_changes, repeat=10):
         "eligibility_cutoff": {"ops": ROWS_MAX_OPS, "elems": ROWS_MAX_ELEMS,
                                "vmem_budget_rows": ROWS_VMEM_BUDGET},
     }
-    if use_rows:
-        wire, dims, n_docs = pack_rows(batch, max_fids)
-    else:
-        wire_packed, meta = pack_batch(batch)
-        wire = wire_packed
-    encode_time = time.perf_counter() - t0
+    @partial(jax.jit, static_argnames=("dims",))
+    def apply_all_rows(arrs, dims):
+        return jnp.stack([
+            reconcile_rows_hash.__wrapped__(a, dims, False)
+            for a in arrs])
+
+    @partial(jax.jit, static_argnames=("meta", "max_fids"))
+    def apply_all_packed(arrs, meta, max_fids):
+        return jnp.stack([
+            apply_packed_hash.__wrapped__(a, meta, max_fids, True)
+            for a in arrs])
+
+    def build_packed_dispatch():
+        wire, meta = pack_batch(batch)
+        return wire, lambda arrs: apply_all_packed(tuple(arrs), meta,
+                                                   max_fids)
 
     if use_rows:
-        @partial(jax.jit, static_argnames=("dims",))
-        def apply_all(arrs, dims):
-            return jnp.stack([
-                reconcile_rows_hash.__wrapped__(a, dims, False)
-                for a in arrs])
+        wire, dims, n_docs = pack_rows(batch, max_fids)
         def dispatch(arrs):
-            return apply_all(tuple(arrs), dims)
+            return apply_all_rows(tuple(arrs), dims)
     else:
-        @partial(jax.jit, static_argnames=("meta", "max_fids"))
-        def apply_all_packed(arrs, meta, max_fids):
-            return jnp.stack([
-                apply_packed_hash.__wrapped__(a, meta, max_fids, True)
-                for a in arrs])
-        def dispatch(arrs):
-            return apply_all_packed(tuple(arrs), meta, max_fids)
+        wire, dispatch = build_packed_dispatch()
+    encode_time = time.perf_counter() - t0
 
     # Distinct buffer copies per pass so the device transfer is really paid
     # each iteration (JAX dedups identical host arrays).
@@ -299,17 +300,7 @@ def run_engine(doc_changes, repeat=10):
         kernel_info["rows_kernel_used"] = False
         kernel_info["rows_kernel_fallback_error"] = repr(e)[:200]
         use_rows = False
-        wire, meta = pack_batch(batch)
-
-        @partial(jax.jit, static_argnames=("meta", "max_fids"))
-        def apply_all_fallback(arrs, meta, max_fids):
-            return jnp.stack([
-                apply_packed_hash.__wrapped__(a, meta, max_fids, True)
-                for a in arrs])
-
-        def dispatch(arrs):  # noqa: F811
-            return apply_all_fallback(tuple(arrs), meta, max_fids)
-
+        wire, dispatch = build_packed_dispatch()
         buffers = [wire.copy() for _ in range(repeat)]
         np.asarray(dispatch([jnp.asarray(b) for b in buffers]))
     del batch
@@ -352,14 +343,24 @@ def check_parity(doc_changes, sample=5):
     return True
 
 
-def run_resident_rounds(doc_changes, n_rounds=6, fraction=0.2):
+def _oracle_wire_rounds(rounds):
+    """The interpretive baseline's wire, serialized untimed: per-op JSON
+    change lists, the format the reference ships and parses
+    (/root/reference/README.md:349-360)."""
+    return [{d: json.dumps([c.to_dict() for c in chs])
+             for d, chs in r.items()} for r in rounds]
+
+
+def run_resident_rounds(doc_changes, n_rounds=12, fraction=0.2):
     """Incremental sync measurement: documents live on device; each round a
     fraction of them receives one new change **as a binary columnar wire
     frame** (sync/frames.py — what peers actually ship since r2). The timed
     engine round covers the real ingress path: frame decode + delta encode +
-    scatter + reconcile + hash readback. The oracle applies the same deltas
-    incrementally per document from pre-parsed Change objects (generous to
-    the baseline: its wire parse isn't timed).
+    scatter + reconcile + hash readback. The oracle's timed round is
+    symmetric: it receives ITS real wire — the per-op JSON the reference
+    ships (README.md:349-360) — so it pays json parse + Change
+    reconstruction + incremental apply, exactly what the reference's
+    receiveMsg -> applyChanges path does.
 
     On TPU the engine path is the docs-minor resident state
     (`resident_rows.ResidentRowsDocSet`): all rounds of the micro-batch run
@@ -373,6 +374,7 @@ def run_resident_rounds(doc_changes, n_rounds=6, fraction=0.2):
 
     import jax as _jax
 
+    from automerge_tpu.core.change import Change
     from automerge_tpu.engine.resident import ResidentDocSet
     from automerge_tpu.sync.frames import decode_frame, encode_frame
 
@@ -393,6 +395,12 @@ def run_resident_rounds(doc_changes, n_rounds=6, fraction=0.2):
         rset.apply_rounds(
             [{doc_ids[i]: doc_changes[i] for i in range(n)}],
             interpret=False)
+        # Pre-size for the incremental horizon (warm + timed rounds) so no
+        # capacity growth re-layouts the rows buffer and forces an XLA
+        # recompile inside the timed region.
+        rset.reserve(
+            ops_per_doc=int(rset.op_count.max()) + 2 * n_rounds + 1,
+            changes_per_doc=int(rset.change_count.max()) + 2 * n_rounds + 1)
 
         changed = rng.sample(range(n), max(1, int(n * fraction)))
         rounds = []
@@ -413,11 +421,13 @@ def run_resident_rounds(doc_changes, n_rounds=6, fraction=0.2):
         # warm the scan compile with an identically-shaped micro-batch
         # (same scan length; triplet pad buckets match since the rounds are
         # structurally identical), then time the steady-state batch —
-        # INCLUDING the wire-frame decode, the service's real ingress.
+        # INCLUDING the wire-frame decode, the service's real ingress:
+        # frame bytes -> native C++ delta encode -> vectorized triplets ->
+        # one scan dispatch (per-op Python only on the no-native fallback).
         rset.apply_rounds(rounds[:n_rounds], interpret=False)
         t0 = time.perf_counter()
-        rset.apply_rounds(
-            [{d: decode_frame(f).to_changes() for d, f in fr.items()}
+        rset.apply_rounds_cols(
+            [{d: decode_frame(f) for d, f in fr.items()}
              for fr in frame_rounds[n_rounds:]], interpret=False)
         engine_round = (time.perf_counter() - t0) / n_rounds
         rounds = rounds[:n_rounds]  # oracle times the same number of rounds
@@ -425,13 +435,15 @@ def run_resident_rounds(doc_changes, n_rounds=6, fraction=0.2):
         oracle_docs = {i: apply_changes_to_doc(
             am.init("o"), am.init("o2")._doc.opset, doc_changes[i],
             incremental=False) for i in changed}
+        json_rounds = _oracle_wire_rounds(rounds)
         t0 = time.perf_counter()
-        for deltas in rounds:
+        for jdeltas in json_rounds:
             for i in changed:
                 doc = oracle_docs[i]
+                chs = [Change.from_dict(d)
+                       for d in json.loads(jdeltas[doc_ids[i]])]
                 oracle_docs[i] = apply_changes_to_doc(
-                    doc, doc._doc.opset, deltas[doc_ids[i]],
-                    incremental=True)
+                    doc, doc._doc.opset, chs, incremental=True)
         oracle_round = (time.perf_counter() - t0) / len(rounds)
         ops_per_round = sum(len(c.ops) for d in rounds[0].values() for c in d)
         return engine_round, oracle_round, ops_per_round
@@ -478,16 +490,18 @@ def run_resident_rounds(doc_changes, n_rounds=6, fraction=0.2):
             resident.apply_and_reconcile(deltas)
     engine_round = (time.perf_counter() - t0) / max(len(rounds) - 1, 1)
 
-    # oracle rounds (re-applying the same deltas to fresh copies)
+    # oracle rounds from its real wire (JSON parse + incremental apply)
     oracle_docs = {i: apply_changes_to_doc(am.init("o"), am.init("o2")._doc.opset,
                                            doc_changes[i], incremental=False)
                    for i in changed}
+    json_rounds = _oracle_wire_rounds(rounds)
     t0 = time.perf_counter()
-    for deltas in rounds:
+    for jdeltas in json_rounds:
         for i in changed:
             doc = oracle_docs[i]
+            chs = [Change.from_dict(d) for d in json.loads(jdeltas[doc_ids[i]])]
             oracle_docs[i] = apply_changes_to_doc(
-                doc, doc._doc.opset, deltas[doc_ids[i]], incremental=True)
+                doc, doc._doc.opset, chs, incremental=True)
     oracle_round = (time.perf_counter() - t0) / len(rounds)
 
     ops_per_round = sum(len(c.ops) for d in rounds[0].values() for c in d)

@@ -171,7 +171,17 @@ class TestReserve:
 
 
 class TestResidentRows:
-    """Docs-minor resident state + micro-batched rounds (resident_rows.py)."""
+    """Docs-minor resident state + micro-batched rounds (resident_rows.py).
+
+    Runs against the native columnar ingress (apply_rounds routes Change
+    rounds through the C++ delta encoder); TestResidentRowsPython below
+    re-runs every test on the pure-Python fallback path."""
+
+    native = None  # auto: use the native encoder when available
+
+    def _mk_set(self, ids):
+        from automerge_tpu.engine.resident_rows import ResidentRowsDocSet
+        return ResidentRowsDocSet(ids, native=self.native)
 
     def _mk_docs(self, n=4):
         docs, logs = [], []
@@ -197,10 +207,9 @@ class TestResidentRows:
         return np.asarray(apply_packed_hash(jax.numpy.asarray(flat), meta, mf))
 
     def test_rounds_converge_with_from_scratch(self):
-        from automerge_tpu.engine.resident_rows import ResidentRowsDocSet
         docs, logs = self._mk_docs()
         ids = [f"d{i}" for i in range(len(docs))]
-        rset = ResidentRowsDocSet(ids)
+        rset = self._mk_set(ids)
         rset.apply_rounds([{ids[i]: logs[i] for i in range(len(ids))}])
         rounds = []
         for rnd in range(3):
@@ -219,10 +228,9 @@ class TestResidentRows:
         np.testing.assert_array_equal(hs[-1], self._from_scratch_hashes(full))
 
     def test_new_actor_mid_flight_remaps(self):
-        from automerge_tpu.engine.resident_rows import ResidentRowsDocSet
         docs, logs = self._mk_docs(2)
         ids = ["d0", "d1"]
-        rset = ResidentRowsDocSet(ids)
+        rset = self._mk_set(ids)
         rset.apply_rounds([{ids[i]: logs[i] for i in range(2)}])
         # actor "AA" sorts before "B" but after "A": ranks shift
         prev = docs[0]
@@ -236,10 +244,9 @@ class TestResidentRows:
         np.testing.assert_array_equal(hs[-1], self._from_scratch_hashes(full))
 
     def test_capacity_growth_mid_batch(self):
-        from automerge_tpu.engine.resident_rows import ResidentRowsDocSet
         docs, logs = self._mk_docs(2)
         ids = ["d0", "d1"]
-        rset = ResidentRowsDocSet(ids)
+        rset = self._mk_set(ids)
         rset.apply_rounds([{ids[i]: logs[i] for i in range(2)}])
         cap_before = rset.cap_ops
         rounds = []
@@ -256,10 +263,9 @@ class TestResidentRows:
         np.testing.assert_array_equal(hs[-1], self._from_scratch_hashes(full))
 
     def test_causal_buffering_across_rounds(self):
-        from automerge_tpu.engine.resident_rows import ResidentRowsDocSet
         docs, logs = self._mk_docs(1)
         ids = ["d0"]
-        rset = ResidentRowsDocSet(ids)
+        rset = self._mk_set(ids)
         rset.apply_rounds([{ids[0]: logs[0]}])
         prev = docs[0]
         s1 = am.change(prev, lambda d: d.__setitem__("a", 1))
@@ -275,11 +281,10 @@ class TestResidentRows:
 
     def test_materialize_matches_oracle(self):
         from automerge_tpu.engine.batchdoc import oracle_state
-        from automerge_tpu.engine.resident_rows import ResidentRowsDocSet
         from automerge_tpu.frontend.materialize import apply_changes_to_doc
         docs, logs = self._mk_docs(2)
         ids = ["d0", "d1"]
-        rset = ResidentRowsDocSet(ids)
+        rset = self._mk_set(ids)
         rset.apply_rounds([{ids[i]: logs[i] for i in range(2)}])
         for i in range(2):
             doc = apply_changes_to_doc(am.init("o"), am.init("o")._doc.opset,
@@ -287,10 +292,9 @@ class TestResidentRows:
             assert rset.materialize(ids[i]) == oracle_state(doc)
 
     def test_second_list_reserves_cap_lists(self):
-        from automerge_tpu.engine.resident_rows import ResidentRowsDocSet
         docs, logs = self._mk_docs(1)
         ids = ["d0"]
-        rset = ResidentRowsDocSet(ids)
+        rset = self._mk_set(ids)
         rset.apply_rounds([{ids[0]: logs[0]}])
         prev = docs[0]
         new = am.change(prev, lambda d: d.__setitem__("ys", [7, 8]))
@@ -302,10 +306,9 @@ class TestResidentRows:
         np.testing.assert_array_equal(hs[-1], self._from_scratch_hashes(full))
 
     def test_queued_changes_count_toward_reservation(self):
-        from automerge_tpu.engine.resident_rows import ResidentRowsDocSet
         docs, logs = self._mk_docs(1)
         ids = ["d0"]
-        rset = ResidentRowsDocSet(ids)
+        rset = self._mk_set(ids)
         rset.apply_rounds([{ids[0]: logs[0]}])
         prev = docs[0]
         # c2 has many ops and depends on c1; deliver c2 first so it queues
@@ -319,3 +322,10 @@ class TestResidentRows:
         assert int(rset.op_count[0]) <= rset.cap_ops
         full = [s2._doc.opset.get_missing_changes({})]
         np.testing.assert_array_equal(hs[-1], self._from_scratch_hashes(full))
+
+
+class TestResidentRowsPython(TestResidentRows):
+    """Every rows test again on the pure-Python encoder fallback (the path
+    taken when the native toolchain is unavailable)."""
+
+    native = False
