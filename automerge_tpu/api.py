@@ -285,7 +285,19 @@ def load_transit(data: str | bytes, actor_id: str | None = None) -> RootMap:
 
 
 def load(data: str, actor_id: str | None = None) -> RootMap:
-    """Rebuild a document by replaying a saved change log."""
+    """Rebuild a document by replaying a saved change log.
+
+    Large causally-ordered logs take the bulk fast path (core/bulkload.py:
+    native JSON parse + vectorized state build + one RGA linearization per
+    list — O(n log n) instead of the interpretive replay's O(n^2) on long
+    list histories); anything it cannot prove it handles exactly falls back
+    to the interpretive path below."""
+    from .core.bulkload import BULK_MIN_CHANGES, try_bulk_load
+    if len(data) > 64 * BULK_MIN_CHANGES:  # cheap size gate before parsing
+        opset = try_bulk_load(data, max_version=SAVE_FORMAT_VERSION)
+        if opset is not None:
+            return materialize_root(actor_id or make_uuid(), opset)
+
     payload = json.loads(data)
     if isinstance(payload, dict):
         version = payload.get("automerge_tpu", SAVE_FORMAT_VERSION)

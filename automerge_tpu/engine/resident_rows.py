@@ -255,6 +255,20 @@ class ResidentRowsDocSet(ResidentDocSet):
                 f"DocSet across more rows instances or use the docs-major "
                 f"ResidentDocSet")
 
+    def _linearized_pos_rows(self, doc_idx: int, lrow: int):
+        """Fresh RGA positions for one touched list from its ins log:
+        (ip-band row indices, positions), both int64 arrays."""
+        from ..native.linearize import linearize_host
+        entries = self.ins_log[doc_idx][lrow]
+        n = len(entries)
+        elem = np.fromiter((e for (_, e, _, _) in entries), np.int32, n)
+        arank = np.fromiter((a for (_, _, a, _) in entries), np.int32, n)
+        parent = np.fromiter((p for (_, _, _, p) in entries), np.int32, n)
+        slots = np.fromiter((s for (s, _, _, _) in entries), np.int64, n)
+        pos = linearize_host(np.ones(n, dtype=bool), elem, arank, parent)
+        rows = self._bases()["ip"] + lrow * self.cap_elems + slots
+        return rows, np.asarray(pos, np.int64)
+
     def _round_triplets(self, changes_by_doc) -> np.ndarray:
         """Encode one round into (P, 3) int32 scatter triplets
         (row, doc, value) and apply them to the host mirror."""
@@ -299,18 +313,10 @@ class ResidentRowsDocSet(ResidentDocSet):
                 put(b["io"] + le, i, self.list_hash[i][lrow])
                 touched_lists.add(lrow)
             # re-linearize touched lists; ship fresh position rows
-            from ..native.linearize import linearize_host
             for lrow in touched_lists:
-                entries = self.ins_log[i][lrow]
-                n = len(entries)
-                mask = np.ones(n, dtype=bool)
-                elem = np.array([e for (_, e, _, _) in entries], np.int32)
-                arank = np.array([a for (_, _, a, _) in entries], np.int32)
-                parent = np.array([p for (_, _, _, p) in entries], np.int32)
-                slots = [s for (s, _, _, _) in entries]
-                pos_by_order = linearize_host(mask, elem, arank, parent)
-                for idx, s in enumerate(slots):
-                    put(b["ip"] + lrow * E + s, i, pos_by_order[idx])
+                prow, pval = self._linearized_pos_rows(i, lrow)
+                for r, v in zip(prow.tolist(), pval.tolist()):
+                    put(r, i, v)
             self.op_count[i] += len(delta.ops)
             self.change_count[i] += len(delta.clocks)
 
@@ -552,7 +558,6 @@ class ResidentRowsDocSet(ResidentDocSet):
 
         ins = bd.ins_rows
         if len(ins):
-            from ..native.linearize import linearize_host
             touched = set()
             ir, idd, iv = [], [], []
             for (d, lrow, slot_, elem, arank, parent_slot, fid) in ins:
@@ -568,17 +573,10 @@ class ResidentRowsDocSet(ResidentDocSet):
             parts_d.append(np.asarray(idd, np.int64))
             parts_v.append(np.asarray(iv, np.int64))
             for (d, lrow) in touched:
-                entries = self.ins_log[d][lrow]
-                n = len(entries)
-                mask = np.ones(n, dtype=bool)
-                elem = np.array([e for (_, e, _, _) in entries], np.int32)
-                arank = np.array([a for (_, _, a, _) in entries], np.int32)
-                parent = np.array([p for (_, _, _, p) in entries], np.int32)
-                slots = np.array([s for (s, _, _, _) in entries], np.int64)
-                pos = linearize_host(mask, elem, arank, parent)
-                parts_r.append(b["ip"] + lrow * E + slots)
-                parts_d.append(np.full(n, d, np.int64))
-                parts_v.append(np.asarray(pos, np.int64))
+                prow, pval = self._linearized_pos_rows(d, lrow)
+                parts_r.append(prow)
+                parts_d.append(np.full(len(prow), d, np.int64))
+                parts_v.append(pval)
 
         if not parts_r:
             return np.zeros((0, 3), np.int32)

@@ -158,12 +158,100 @@ def gen_docset(n_docs=10000):
     return out
 
 
+def gen_text_load_log(n_edits=65536, seed=11):
+    """Config 6: synthesize a single-actor random-edit text change log
+    directly as JSON (building it interactively would itself be O(n^2) —
+    the very cost this config measures). Returns (json_str, visible_len)."""
+    import json as _json
+    import random
+    from automerge_tpu.core.ids import ROOT_ID
+
+    rng = random.Random(seed)
+    tid = "11111111-2222-3333-4444-555555555555"
+    seq, elem = [], 0
+    changes = [{"actor": "A", "seq": 1, "deps": {}, "ops": [
+        {"action": "makeText", "obj": tid},
+        {"action": "link", "obj": ROOT_ID, "key": "t", "value": tid}]}]
+    for k in range(n_edits):
+        if rng.random() < 0.75 or not seq:
+            pos = rng.randint(0, len(seq))
+            parent = seq[pos - 1] if pos else "_head"
+            elem += 1
+            eid = f"A:{elem}"
+            ops = [{"action": "ins", "obj": tid, "key": parent, "elem": elem},
+                   {"action": "set", "obj": tid, "key": eid,
+                    "value": rng.choice("abcdefgh ")}]
+            seq.insert(pos, eid)
+        else:
+            eid = seq.pop(rng.randrange(len(seq)))
+            ops = [{"action": "del", "obj": tid, "key": eid}]
+        changes.append({"actor": "A", "seq": k + 2, "deps": {}, "ops": ops})
+    return _json.dumps(changes), len(seq)
+
+
+def run_text_load_config(n_edits=65536, oracle_cap=8192):
+    """Config 6: long-text load latency (VERDICT r1 #7). The engine path is
+    api.load's bulk loader (core/bulkload.py: native JSON parse + vectorized
+    state build + one native RGA linearization); the oracle is the
+    interpretive per-change replay, measured at oracle_cap edits on the SAME
+    workload so the speedup is apples-to-apples at equal size (no
+    extrapolation), plus the full-size bulk time as the headline latency."""
+    from automerge_tpu.core.bulkload import try_bulk_load
+    from automerge_tpu.core.change import coerce_change
+
+    small, small_vis = gen_text_load_log(oracle_cap)
+    full, full_vis = gen_text_load_log(n_edits)
+
+    t0 = time.perf_counter()
+    doc_small_oracle = am.init("o")
+    doc_small_oracle = apply_changes_to_doc(
+        doc_small_oracle, doc_small_oracle._doc.opset,
+        [coerce_change(c) for c in json.loads(small)], incremental=False)
+    oracle_small_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    doc_small_bulk = am.load(small)
+    bulk_small_s = time.perf_counter() - t0
+    assert try_bulk_load(small) is not None, "bulk path did not engage"
+    if not am.equals(doc_small_oracle, doc_small_bulk):
+        raise AssertionError("bulk/interpretive load parity failure")
+
+    t0 = time.perf_counter()
+    doc_full = am.load(full)
+    bulk_full_s = time.perf_counter() - t0
+    assert len(doc_full["t"]) == full_vis
+
+    ops = 2 * n_edits  # ins+set / del per edit, roughly
+    return {
+        "config": 6,
+        "name": f"{n_edits}-edit text load (bulk vs interpretive)",
+        "docs": 1,
+        "ops": ops,
+        "edits": n_edits,
+        "visible_chars": full_vis,
+        "load_full_s": round(bulk_full_s, 3),
+        "oracle_s": round(oracle_small_s, 4),
+        "engine_s": round(bulk_small_s, 4),
+        "device_s": round(bulk_small_s, 4),  # host-side config: no device
+        "oracle_ops_per_s": round(2 * oracle_cap / oracle_small_s),
+        "engine_ops_per_s": round(2 * oracle_cap / bulk_small_s),
+        "device_ops_per_s": round(2 * oracle_cap / bulk_small_s),
+        "speedup": round(oracle_small_s / bulk_small_s, 2),
+        "device_speedup": round(oracle_small_s / bulk_small_s, 2),
+        "speedup_note": (f"measured at {oracle_cap} edits equal-size; "
+                         f"full {n_edits}-edit load takes load_full_s "
+                         f"(sub-second target, VERDICT r1 #7)"),
+        "parity": True,
+    }
+
+
 CONFIGS = {
     1: ("single-doc LWW storm (2 actors x 1000 sets)", gen_lww_storm),
     2: ("nested JSON card board (8 actors)", gen_trellis),
     3: ("3-actor Text edit trace", gen_text_trace),
     4: ("tombstone-heavy list", gen_tombstone_list),
     5: ("10K-doc DocSet merge", gen_docset),
+    6: ("64K-edit text load (bulk vs interpretive)", None),
 }
 
 
@@ -509,6 +597,8 @@ def run_resident_rounds(doc_changes, n_rounds=12, fraction=0.2):
 
 
 def run_config(cfg: int, n_docs: int | None = None, oracle_cap_docs=1000):
+    if cfg == 6:
+        return run_text_load_config()
     name, gen = CONFIGS[cfg]
     kwargs = {}
     if cfg == 5 and n_docs:

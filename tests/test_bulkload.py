@@ -1,0 +1,209 @@
+"""Bulk loader parity: core/bulkload.py must reconstruct an OpSet
+bit-equivalent to interpretive replay — including follow-up behavior of
+documents edited (and merged concurrently) AFTER loading.
+
+The interpretive path is the spec (it mirrors the reference op by op,
+SURVEY.md §3.5); the bulk path must be indistinguishable from it.
+"""
+
+import json
+import random
+
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu.core.bulkload import (BULK_MIN_CHANGES, build_opset,
+                                         try_bulk_load)
+from automerge_tpu.native.wire import parse_changes_json
+
+
+def _interpretive_load(data, actor_id="oracle"):
+    from automerge_tpu.core.change import coerce_change
+    from automerge_tpu.frontend.materialize import apply_changes_to_doc
+    payload = json.loads(data)
+    changes = payload["changes"] if isinstance(payload, dict) else payload
+    doc = am.init(actor_id)
+    return apply_changes_to_doc(doc, doc._doc.opset,
+                                [coerce_change(c) for c in changes],
+                                incremental=False)
+
+
+def _bulk_load(data, actor_id="oracle"):
+    from automerge_tpu.frontend.materialize import materialize_root
+    opset = try_bulk_load(data)
+    assert opset is not None, "bulk path unexpectedly fell back"
+    return materialize_root(actor_id, opset)
+
+
+def _opsets_equal(a, b):
+    """Deep state comparison between two OpSets."""
+    assert a.clock == b.clock
+    assert a.deps == b.deps
+    assert tuple(a.queue) == tuple(b.queue)
+    assert list(a.history) == list(b.history)
+    assert set(a.states) == set(b.states)
+    for actor in a.states:
+        assert list(a.states[actor]) == list(b.states[actor])
+    assert set(a.by_object) == set(b.by_object)
+    for oid in a.by_object:
+        oa, ob = a.by_object[oid], b.by_object[oid]
+        assert oa.init_action == ob.init_action, oid
+        assert oa.fields == ob.fields, oid
+        assert list(oa.fields) == list(ob.fields), oid  # key order too
+        assert oa.following == ob.following, oid
+        assert oa.insertion == ob.insertion, oid
+        assert list(oa.inbound) == list(ob.inbound), oid
+        assert oa.max_elem == ob.max_elem, oid
+        if oa.elem_ids is not None:
+            assert oa.elem_ids.keys == ob.elem_ids.keys, oid
+            assert oa.elem_ids.values == ob.elem_ids.values, oid
+
+
+def _random_trace(seed, n_steps=140):
+    """Concurrent multi-actor trace over maps, lists, text, nested objects,
+    with deletes and periodic merges."""
+    rng = random.Random(seed)
+    base = am.change(am.init("base"), lambda d: am.assign(
+        d, {"m": {}, "xs": [], "t": am.Text()}))
+    reps = {a: am.merge(am.init(a), base) for a in ("A", "B", "C")}
+    for step in range(n_steps):
+        a = rng.choice("ABC")
+        d = reps[a]
+        r = rng.random()
+        if r < 0.3:
+            k = f"k{rng.randint(0, 8)}"
+            d = am.change(d, lambda doc, k=k, s=step: doc["m"].__setitem__(
+                k, rng.choice([s, f"s{s}", s * 0.5, True, None])))
+        elif r < 0.45 and len(d["m"]):
+            k = rng.choice(sorted(d["m"].keys()))
+            d = am.change(d, lambda doc, k=k: doc["m"].__delitem__(k))
+        elif r < 0.65:
+            n = len(d["xs"])
+            d = am.change(d, lambda doc, s=step: doc["xs"].insert_at(
+                rng.randint(0, n), s))
+        elif r < 0.75 and len(d["xs"]):
+            d = am.change(d, lambda doc: doc["xs"].delete_at(
+                rng.randint(0, len(doc["xs"]) - 1)))
+        elif r < 0.9:
+            n = len(d["t"])
+            d = am.change(d, lambda doc: doc["t"].insert_at(
+                rng.randint(0, n), rng.choice("abcdef ")))
+        elif len(d["t"]):
+            d = am.change(d, lambda doc: doc["t"].delete_at(
+                rng.randint(0, len(doc["t"]) - 1)))
+        reps[a] = d
+        if step % 25 == 24:
+            other = rng.choice([x for x in "ABC" if x != a])
+            reps[a] = am.merge(reps[a], reps[other])
+    return am.merge(am.merge(reps["A"], reps["B"]), reps["C"])
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_random_trace_state_parity(seed):
+    doc = _random_trace(seed)
+    data = am.save(doc)
+    oracle = _interpretive_load(data)
+    bulk = _bulk_load(data)
+    _opsets_equal(oracle._doc.opset, bulk._doc.opset)
+    assert am.equals(oracle, bulk)
+    assert am.save(oracle) == am.save(bulk)
+
+
+def test_followup_edits_and_concurrent_merge_behave_identically():
+    doc = _random_trace(99)
+    data = am.save(doc)
+    oracle = _interpretive_load(data, actor_id="edit")
+    bulk = _bulk_load(data, actor_id="edit")
+
+    def edit(d):
+        d = am.change(d, lambda doc: doc["xs"].insert_at(0, "new"))
+        d = am.change(d, lambda doc: doc["m"].__setitem__("k0", "after"))
+        d = am.change(d, lambda doc: doc["t"].insert_at(0, "Z"))
+        return d
+
+    o2, b2 = edit(oracle), edit(bulk)
+    assert am.equals(o2, b2)
+    # concurrent peer edits merge identically into both
+    peer = am.change(am.merge(am.init("zpeer"), doc),
+                     lambda d: am.assign(d, {"k0": "peer", "p": 1}))
+    om = am.merge(o2, peer)
+    bm = am.merge(b2, peer)
+    assert am.equals(om, bm)
+    assert dict(om._conflicts) == dict(bm._conflicts)
+    # undo works on a bulk-loaded doc's follow-up change
+    assert am.can_undo(o2) == am.can_undo(b2)
+
+
+def test_api_load_routes_large_logs_through_bulk(monkeypatch):
+    doc = _random_trace(7)
+    data = am.save(doc)
+    calls = {"n": 0}
+    import automerge_tpu.core.bulkload as BL
+    orig = BL.build_opset
+
+    def spy(cols):
+        calls["n"] += 1
+        return orig(cols)
+
+    monkeypatch.setattr(BL, "build_opset", spy)
+    loaded = am.load(data)
+    assert calls["n"] == 1, "large load did not take the bulk path"
+    assert am.equals(loaded, doc)
+
+
+def test_small_logs_use_interpretive_path():
+    d = am.change(am.init("A"), lambda doc: doc.__setitem__("x", 1))
+    data = am.save(d)
+    assert try_bulk_load(data) is None  # under BULK_MIN_CHANGES
+    assert am.equals(am.load(data), d)
+
+
+def test_unordered_log_falls_back():
+    d = am.init("A")
+    for i in range(BULK_MIN_CHANGES + 8):
+        d = am.change(d, lambda doc, i=i: doc.__setitem__("n", i))
+    payload = json.loads(am.save(d))
+    payload["changes"].reverse()  # no longer causally ordered
+    data = json.dumps(payload)
+    assert try_bulk_load(data) is None
+    assert am.load(data)["n"] == BULK_MIN_CHANGES + 7  # interpretive queue
+
+
+def _big_changes_payload():
+    d = am.init("A")
+    for i in range(BULK_MIN_CHANGES + 8):
+        d = am.change(d, lambda doc, i=i: doc.__setitem__(f"k{i}", i))
+    return json.loads(am.save(d))["changes"]
+
+
+def test_nested_changes_key_is_not_bulk_loaded():
+    """A 'changes' key that is not the canonical top-level one must get the
+    interpretive fallback's semantics (empty doc), not be sliced out."""
+    data = json.dumps({"automerge_tpu": 1,
+                       "meta": {"changes": _big_changes_payload()}})
+    assert try_bulk_load(data) is None
+    assert len(am.load(data)) == 0  # interpretive: no top-level changes
+
+
+def test_future_version_raises_even_when_key_not_first():
+    data = json.dumps({"changes": _big_changes_payload(),
+                       "automerge_tpu": 99})
+    assert try_bulk_load(data, max_version=1) is None
+    with pytest.raises(ValueError, match="version 99"):
+        am.load(data)
+
+
+def test_out_of_int64_and_unicode_values_survive():
+    d = am.init("A")
+    for i in range(BULK_MIN_CHANGES):
+        d = am.change(d, lambda doc, i=i: doc.__setitem__(f"k{i}", i))
+    big = 2 ** 70
+    d = am.change(d, lambda doc: am.assign(
+        d if False else doc,
+        {"big": big, "uni": "héllo ☃", "f": 1.5, "neg": -7,
+         "none": None, "t": True}))
+    data = am.save(d)
+    bulk = _bulk_load(data)
+    oracle = _interpretive_load(data)
+    _opsets_equal(oracle._doc.opset, bulk._doc.opset)
+    assert bulk["big"] == big and bulk["uni"] == "héllo ☃"
