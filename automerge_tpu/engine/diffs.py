@@ -1,0 +1,257 @@
+"""Engine-side diff emission and incremental mirror maintenance.
+
+The reference's universal currency is the diff stream: every applyChanges
+emits edit records that frontends fold into materialized snapshots
+(/root/reference/src/op_set.js:105-176, freeze_api.js:148-186). The device
+engine's currency is converged state; this module bridges the two for the
+resident path (VERDICT r1 next #6): the fused dispatch compares each
+round's converged state against the previous round ON DEVICE
+(resident._scatter_apply_diff) and ships back only small changed-entry
+masks; `decode_round_diffs` turns just those entries into reference-shaped
+edit records through the host interning tables, and `MirrorDoc` folds them
+into an incrementally-maintained materialized view.
+
+Record shapes mirror the reference's (README.md:487-520):
+  {"action": "create", "type": "map"|"list"|"text", "obj": id}
+  {"action": "set",    "type": "map", "obj", "key", "value",
+                       ["link": True], ["conflicts": [{actor, value,
+                       [link]}]]}
+  {"action": "remove", "type": "map", "obj", "key"}
+  {"action": "insert"|"set"|"remove", "type": "list"|"text", "obj",
+                       "index", ["value", ...]}
+
+One deliberate difference, documented here because it changes how records
+compose: the reference emits diffs per OP in application order, while a
+resident round covers a whole change batch, so these are BATCH diffs — per
+list, removes come first in DESCENDING old-index order, then inserts in
+ASCENDING final-index order, then sets at final indexes. Applying them in
+sequence transforms the old visible sequence into the new one (standard
+patch algebra); rank shifts caused by a neighbor's insert/remove are
+implicit, exactly as in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from .encode import A_MAKE_LIST, A_MAKE_MAP, A_MAKE_TEXT
+
+
+def _decode_value(t, value_id: int):
+    """(value, is_link) from a doc's arrival-ordered value table."""
+    raw = t.value_list[value_id]
+    if isinstance(raw, tuple) and len(raw) == 2 and raw[0] == "__link__":
+        return raw[1], True
+    return raw, False
+
+
+def decode_round_diffs(rset, chg_fid: np.ndarray, chg_elem: np.ndarray,
+                       prev_vis: np.ndarray, prev_rank: np.ndarray) -> dict:
+    """{doc_id: [edit records]} for the entries the device flagged changed.
+
+    rset: the ResidentDocSet right after a diff dispatch (its _out holds the
+    new converged state). prev_vis/prev_rank: the previous round's element
+    visibility/ranks (host copies, padded to current capacities).
+    """
+    out = rset._out
+    present = np.asarray(out["present"])
+    win_value = np.asarray(out["win_value"])
+    win_actor = np.asarray(out["win_actor"])
+    candidate = np.asarray(out["candidate"])
+    vis = np.asarray(out["elem_visible"])
+    rank = np.asarray(out["vis_rank"])
+    st_fid = np.asarray(rset.state["fid"])
+    st_actor = np.asarray(rset.state["actor"])
+    st_value = np.asarray(rset.state["value"])
+    ins_fid = np.asarray(rset.state["ins_fid"])
+    list_obj = np.asarray(rset.state["list_obj"])
+
+    # stash host copies as the next round's decode baseline (vis/ranks are
+    # already materialized here; re-downloading them next round would double
+    # the transfer)
+    rset._diff_prev_host = (vis, rank)
+
+    n_docs = len(rset.doc_ids)
+    changed_docs = np.nonzero(chg_fid[:n_docs].any(axis=1)
+                              | chg_elem[:n_docs].any(axis=(1, 2)))[0]
+    # objects already announced with a "create" record, per doc
+    announced = getattr(rset, "_diff_announced", None)
+    if announced is None:
+        announced = rset._diff_announced = {}
+
+    diffs: dict[str, list] = {}
+    for i in changed_docs.tolist():
+        t = rset.tables[i]
+        kind_of = {oi: kind for oi, (_oid, kind) in enumerate(t.objects)}
+        oid_of = {oi: oid for oi, (oid, _k) in enumerate(t.objects)}
+        seq_objs = {oi for oi, k in kind_of.items()
+                    if k in (A_MAKE_LIST, A_MAKE_TEXT)}
+        records: list[dict] = []
+
+        # create records for objects first seen by the diff consumer
+        seen = announced.setdefault(i, 1)  # the root needs no create
+        if len(t.objects) > seen:
+            for oi in range(seen, len(t.objects)):
+                kind = kind_of[oi]
+                records.append({
+                    "action": "create",
+                    "type": ("text" if kind == A_MAKE_TEXT else
+                             "list" if kind == A_MAKE_LIST else "map"),
+                    "obj": oid_of[oi]})
+            announced[i] = len(t.objects)
+
+        def conflicts_of(f: int) -> list[dict] | None:
+            """Loser records for a multi-survivor field (op_set.js:95-103)."""
+            ops = np.nonzero(candidate[i] & (st_fid[i] == f))[0]
+            if len(ops) <= 1:
+                return None
+            w = int(win_actor[i, f])
+            recs = []
+            # losers in actor-descending order, matching the reference's
+            # survivor ordering (winner first, op_set.js:201)
+            for j in sorted(ops.tolist(), key=lambda j: -int(st_actor[i, j])):
+                a = int(st_actor[i, j])
+                if a == w:
+                    continue
+                v, is_link = _decode_value(t, int(st_value[i, j]))
+                rec = {"actor": rset.actors[a], "value": v}
+                if is_link:
+                    rec["link"] = True
+                recs.append(rec)
+            return recs or None
+
+        # map-field records (sequence fields are driven by chg_elem below)
+        for f in np.nonzero(chg_fid[i][:len(t.fields)])[0].tolist():
+            obj_idx, key = t.fields[f]
+            if obj_idx in seq_objs:
+                continue
+            rec: dict[str, Any] = {"type": "map", "obj": oid_of[obj_idx],
+                                   "key": key}
+            if present[i, f]:
+                rec["action"] = "set"
+                v, is_link = _decode_value(t, int(win_value[i, f]))
+                rec["value"] = v
+                if is_link:
+                    rec["link"] = True
+                c = conflicts_of(f)
+                if c:
+                    rec["conflicts"] = c
+            else:
+                rec["action"] = "remove"
+            records.append(rec)
+
+        # sequence records, per touched list row: removes (desc old index),
+        # inserts (asc new index), sets (asc new index)
+        for lrow in np.nonzero(chg_elem[i].any(axis=1))[0].tolist():
+            obj_idx = int(list_obj[i, lrow])
+            if obj_idx < 0:
+                continue
+            typ = "text" if kind_of[obj_idx] == A_MAKE_TEXT else "list"
+            oid = oid_of[obj_idx]
+            removes, inserts, sets = [], [], []
+            for slot in np.nonzero(chg_elem[i, lrow])[0].tolist():
+                was = bool(prev_vis[i, lrow, slot])
+                now = bool(vis[i, lrow, slot])
+                f = int(ins_fid[i, lrow, slot])
+                if was and not now:
+                    removes.append({"action": "remove", "type": typ,
+                                    "obj": oid,
+                                    "index": int(prev_rank[i, lrow, slot])})
+                elif now:
+                    if was and not chg_fid[i, f]:
+                        continue  # pure rank shift: implicit in the patch
+                    v, is_link = _decode_value(t, int(win_value[i, f]))
+                    rec = {"action": "insert" if not was else "set",
+                           "type": typ, "obj": oid,
+                           "index": int(rank[i, lrow, slot]), "value": v}
+                    if is_link:
+                        rec["link"] = True
+                    c = conflicts_of(f)
+                    if c:
+                        rec["conflicts"] = c
+                    (inserts if not was else sets).append(rec)
+            removes.sort(key=lambda r: -r["index"])
+            inserts.sort(key=lambda r: r["index"])
+            sets.sort(key=lambda r: r["index"])
+            records.extend(removes + inserts + sets)
+
+        if records:
+            diffs[rset.doc_ids[i]] = records
+    return diffs
+
+
+class MirrorDoc:
+    """An incrementally-maintained materialized view driven purely by engine
+    diff records — the frontend counterpart of the reference's
+    updateCache-from-diffs flow (freeze_api.js:148-186), for consumers that
+    track a resident document without holding its op log."""
+
+    def __init__(self):
+        self.objects: dict[str, Any] = {"_root": {}}
+        self.conflicts: dict[str, dict] = {}  # root-key conflicts
+        self._links: dict[str, str] = {}      # obj id -> placeholder marker
+
+    ROOT = None  # set on first apply from record obj ids
+
+    def _node(self, obj_id: str):
+        return self.objects[obj_id]
+
+    def apply(self, records: list[dict]) -> None:
+        for rec in records:
+            action = rec["action"]
+            if action == "create":
+                self.objects[rec["obj"]] = ([] if rec["type"] in
+                                            ("list", "text") else {})
+                if rec["type"] == "text":
+                    self._links[rec["obj"]] = "text"
+                continue
+            obj = rec["obj"]
+            if obj not in self.objects:  # the root arrives unannounced
+                self.objects[obj] = {}
+                self.objects["_root"] = self.objects[obj]
+            node = self.objects[obj]
+            value = rec.get("value")
+            if rec.get("link"):
+                value = self.objects[value]
+            if rec["type"] == "map":
+                if action == "set":
+                    node[rec["key"]] = value
+                    if rec.get("conflicts"):
+                        self.conflicts.setdefault(obj, {})[rec["key"]] = {
+                            c["actor"]: (self.objects[c["value"]]
+                                         if c.get("link") else c["value"])
+                            for c in rec["conflicts"]}
+                    else:
+                        self.conflicts.get(obj, {}).pop(rec["key"], None)
+                elif action == "remove":
+                    node.pop(rec["key"], None)
+                    self.conflicts.get(obj, {}).pop(rec["key"], None)
+            else:  # list / text
+                if action == "insert":
+                    node.insert(rec["index"], value)
+                elif action == "set":
+                    node[rec["index"]] = value
+                elif action == "remove":
+                    del node[rec["index"]]
+
+    def snapshot(self, root_obj_id: str) -> dict:
+        """Plain {data, conflicts} matching batchdoc.decode_doc's shape
+        (text nodes render as strings)."""
+        text_ids = {id(self.objects[o]) for o, m in self._links.items()
+                    if m == "text" and o in self.objects}
+
+        def deep(v):
+            if isinstance(v, list):
+                if id(v) in text_ids:
+                    return "".join(str(x) for x in v)
+                return [deep(x) for x in v]
+            if isinstance(v, dict):
+                return {k: deep(x) for k, x in v.items()}
+            return v
+
+        root = self.objects.get(root_obj_id, self.objects["_root"])
+        conflicts = {k: {a: deep(v) for a, v in c.items()}
+                     for k, c in self.conflicts.get(root_obj_id, {}).items()}
+        return {"data": deep(root), "conflicts": conflicts}

@@ -166,6 +166,10 @@ class ResidentDocSet:
         self.state: dict[str, jnp.ndarray] = {}
         self._alloc()
         self._out = None
+        # diff-emission baseline: what the diff consumer last saw (device
+        # refs + host copies of elem vis/ranks); decoupled from _out
+        self._diff_prev = None
+        self._diff_prev_host = None
 
         self._native = None
         if native is not False:
@@ -314,6 +318,14 @@ class ResidentDocSet:
         for old_rank, new_rank in enumerate(perm):
             inv[new_rank] = old_rank
         self.state = _remap_actors(self.state, jnp.asarray(perm), jnp.asarray(inv))
+        if self._diff_prev is not None:
+            # the diff baseline's winner ranks must follow the remap, or
+            # every field of every doc would look changed next diff round
+            p, wv, wa, sh, ev, vr = self._diff_prev
+            perm_j = jnp.asarray(perm)
+            wa = jnp.where(wa >= 0,
+                           perm_j[jnp.clip(wa, 0, len(perm) - 1)], wa)
+            self._diff_prev = (p, wv, wa, sh, ev, vr)
 
     # ------------------------------------------------------------------
     def _admit(self, t: DocTables, incoming: list[_Pending]) -> list[_Pending]:
@@ -457,17 +469,17 @@ class ResidentDocSet:
         self.state = _scatter_delta(self.state, flat, meta)
         self._out = None
 
-    def apply_and_reconcile_columns(self, cols_by_doc: dict):
-        """Fused columnar apply + reconcile (one device dispatch)."""
+    def apply_and_reconcile_columns(self, cols_by_doc: dict,
+                                    diffs: bool = False):
+        """Fused columnar apply + reconcile (one device dispatch); see
+        apply_and_reconcile for the diffs=True contract."""
         if self._native is None:
             return self.apply_and_reconcile(
-                {d: c.to_changes() for d, c in cols_by_doc.items()})
+                {d: c.to_changes() for d, c in cols_by_doc.items()},
+                diffs=diffs)
         self._register_actors_cols(cols_by_doc)
         flat, meta = self._build_delta_arrays_cols(cols_by_doc)
-        self.state, out = _scatter_and_apply(self.state, flat, meta,
-                                             max_fids=self.cap_fids)
-        self._out = out
-        return np.asarray(out["hash"])[:len(self.doc_ids)]
+        return self._apply_flat(flat, meta, diffs)
 
     def _register_actors_cols(self, cols_by_doc: dict) -> None:
         new = set()
@@ -645,23 +657,103 @@ class ResidentDocSet:
         return jnp.asarray(flat), meta
 
     # ------------------------------------------------------------------
-    def apply_and_reconcile(self, changes_by_doc: dict[str, list[Change]]):
+    def apply_and_reconcile(self, changes_by_doc: dict[str, list[Change]],
+                            diffs: bool = False):
         """Fused delta apply + reconcile: one device dispatch for the whole
         round (scatter, survivor analysis, linearization, hashing), one
         readback for the hashes. This is the hot path of a resident sync
         service — per-round cost is a single host<->device roundtrip plus
-        the delta bytes."""
+        the delta bytes.
+
+        With diffs=True the dispatch also computes changed-field/element
+        masks vs the previous round on device, and the return value is
+        (hashes, {doc_id: [edit records]}) — reference-shaped diff records
+        (op_set.js:105-176) decoded only for the changed entries, so a
+        frontend can update a materialized view incrementally
+        (engine/diffs.py)."""
         if self._native is not None:
             from ..native.wire import changes_to_columns
             return self.apply_and_reconcile_columns(
                 {d: changes_to_columns(chs)
-                 for d, chs in changes_by_doc.items()})
+                 for d, chs in changes_by_doc.items()}, diffs=diffs)
         self._register_actors(changes_by_doc)
         flat, meta = self._build_delta_arrays(changes_by_doc)
-        self.state, out = _scatter_and_apply(self.state, flat, meta,
-                                             max_fids=self.cap_fids)
+        return self._apply_flat(flat, meta, diffs)
+
+    def _apply_flat(self, flat, meta, diffs: bool):
+        if not diffs:
+            self.state, out = _scatter_and_apply(self.state, flat, meta,
+                                                 max_fids=self.cap_fids)
+            self._out = out
+            return np.asarray(out["hash"])[:len(self.doc_ids)]
+        prev = self._prev_for_diffs()
+        prev_vis_host, prev_rank_host = self._prev_host_for_diffs()
+        actor_hashes = jnp.asarray(
+            [content_hash(a) for a in self.actors]
+            + [0] * (self.cap_actors - len(self.actors)), dtype=jnp.int32)
+        self.state, out, survh, chg_fid, chg_elem = _scatter_apply_diff(
+            self.state, flat, meta, actor_hashes, *prev,
+            max_fids=self.cap_fids)
         self._out = out
-        return np.asarray(out["hash"])[:len(self.doc_ids)]
+        # the baseline for the NEXT diff round: device refs (no transfer);
+        # independent of _out so hash-only rounds / add_docs in between do
+        # not reset the consumer's view to empty
+        self._diff_prev = (out["present"], out["win_value"],
+                           out["win_actor"], survh,
+                           out["elem_visible"], out["vis_rank"])
+        from .diffs import decode_round_diffs
+        records = decode_round_diffs(self, np.asarray(chg_fid),
+                                     np.asarray(chg_elem),
+                                     prev_vis_host, prev_rank_host)
+        return np.asarray(out["hash"])[:len(self.doc_ids)], records
+
+    def _prev_for_diffs(self):
+        """The last diff round's converged state padded to current
+        capacities (the baseline the device change-detection compares
+        against). Before any diff round the baseline is empty: the first
+        one then describes building every document from scratch — exactly
+        what a frontend needs to seed its mirror. Hash-only rounds between
+        diff rounds intentionally leave the baseline where the diff
+        consumer last saw it, so their effects are reported on the next
+        diff round."""
+        n, F = self.cap_docs, self.cap_fids
+        L, E = self.cap_lists, self.cap_elems
+
+        def pad(arr, shape, fill):
+            arr = jnp.asarray(arr)
+            pads = [(0, s - arr.shape[k]) for k, s in enumerate(shape)]
+            if any(p[1] for p in pads):
+                arr = jnp.pad(arr, pads, constant_values=fill)
+            return arr
+
+        if self._diff_prev is None:
+            return (jnp.zeros((n, F), bool),
+                    jnp.full((n, F), -1, jnp.int32),
+                    jnp.full((n, F), -1, jnp.int32),
+                    jnp.zeros((n, F), jnp.uint32),
+                    jnp.zeros((n, L, E), bool),
+                    jnp.full((n, L, E), -1, jnp.int32))
+        p, wv, wa, sh, ev, vr = self._diff_prev
+        return (pad(p, (n, F), False), pad(wv, (n, F), -1),
+                pad(wa, (n, F), -1), pad(sh, (n, F), 0),
+                pad(ev, (n, L, E), False), pad(vr, (n, L, E), -1))
+
+    def _prev_host_for_diffs(self):
+        """Host copies of the baseline's element visibility/ranks for the
+        decode (old indexes of removals) — reused from the previous diff
+        round's decode readback, not re-downloaded."""
+        n = self.cap_docs
+        L, E = self.cap_lists, self.cap_elems
+        if self._diff_prev_host is None:
+            return (np.zeros((n, L, E), bool),
+                    np.full((n, L, E), -1, np.int32))
+        vis, rank = self._diff_prev_host
+        pads = [(0, n - vis.shape[0]), (0, L - vis.shape[1]),
+                (0, E - vis.shape[2])]
+        if any(p[1] for p in pads):
+            vis = np.pad(vis, pads, constant_values=False)
+            rank = np.pad(rank, pads, constant_values=-1)
+        return vis, rank
 
     def reconcile(self):
         """Run the reconcile kernel over resident state; returns per-doc
@@ -797,3 +889,49 @@ def _scatter_and_apply(state, flat, meta, *, max_fids):
     new_state = _scatter_delta.__wrapped__(state, flat, meta)
     out = apply_doc.__wrapped__(new_state, max_fids)
     return new_state, out
+
+
+def _fid_survivor_hash(state, out, max_fids: int, actor_hashes):
+    """Order-independent per-field hash of the surviving (actor, value)
+    pairs — changes whenever a field's conflict set changes even if the LWW
+    winner didn't (op_set.js:95-103 is the reference surface this feeds).
+    Actors are mixed by CONTENT hash (actor_hashes[rank]), not rank, so the
+    hash survives the global rank remap a newly-registered actor causes."""
+    from .kernels import _mix4
+    safe_actor = jnp.clip(state["actor"], 0, actor_hashes.shape[0] - 1)
+    ah = actor_hashes[safe_actor]
+    contrib = _mix4(ah, state["value_hash"], ah ^ 0x5BF0,
+                    state["value_hash"])
+    n, _ = state["op_mask"].shape
+    docs = jnp.arange(n)[:, None]
+    safe_fid = jnp.clip(state["fid"], 0, max_fids - 1)
+    return jnp.zeros((n, max_fids), jnp.uint32).at[docs, safe_fid].add(
+        jnp.where(out["candidate"], contrib, jnp.uint32(0)))
+
+
+@partial(jax.jit, static_argnames=("meta", "max_fids"), donate_argnums=(0,))
+def _scatter_apply_diff(state, flat, meta, actor_hashes, prev_present,
+                        prev_win_value, prev_win_actor, prev_survh,
+                        prev_vis, prev_rank, *, max_fids):
+    """_scatter_and_apply plus device-side change detection: per-field and
+    per-element changed masks vs the previous diff round's converged state
+    (the engine-side analog of the reference's diff stream,
+    op_set.js:105-176). The baseline arrays stay on device between rounds;
+    only the changed-entry masks (and the state the decode reads) cross
+    back to the host."""
+    new_state = _scatter_delta.__wrapped__(state, flat, meta)
+    out = apply_doc.__wrapped__(new_state, max_fids)
+    survh = _fid_survivor_hash(new_state, out, max_fids, actor_hashes)
+    chg_fid = ((out["present"] != prev_present)
+               | (out["win_value"] != prev_win_value)
+               | (out["win_actor"] != prev_win_actor)
+               | (survh != prev_survh))
+    # an element changes if its visibility or rank moved, OR its field's
+    # value/conflict state changed (a set on a stable visible element)
+    ins_fid = new_state["ins_fid"]
+    safe_if = jnp.clip(ins_fid, 0, max_fids - 1)
+    docs3 = jnp.arange(chg_fid.shape[0])[:, None, None]
+    chg_elem = ((out["elem_visible"] != prev_vis)
+                | (out["vis_rank"] != prev_rank)
+                | (chg_fid[docs3, safe_if] & (ins_fid >= 0)))
+    return new_state, out, survh, chg_fid, chg_elem

@@ -1,0 +1,251 @@
+"""Device-side diff emission (VERDICT r1 #6): the resident engine reports
+which fields/elements changed per round as reference-shaped edit records
+(op_set.js:105-176), and a frontend mirror updated ONLY from those records
+stays equal to a full materialization — and to the oracle."""
+
+import random
+
+import numpy as np
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu.core.ids import ROOT_ID
+from automerge_tpu.engine.batchdoc import oracle_state
+from automerge_tpu.engine.diffs import MirrorDoc
+from automerge_tpu.engine.resident import ResidentDocSet
+from automerge_tpu.frontend.materialize import apply_changes_to_doc
+
+
+def _delta(prev, new):
+    return new._doc.opset.get_missing_changes(prev._doc.opset.clock)
+
+
+class _Tracker:
+    """A resident DocSet plus per-doc mirrors fed only by engine diffs."""
+
+    def __init__(self, doc_ids, native=None):
+        self.rset = ResidentDocSet(doc_ids, native=native)
+        self.mirrors = {d: MirrorDoc() for d in doc_ids}
+
+    def round(self, changes_by_doc):
+        hashes, diffs = self.rset.apply_and_reconcile(changes_by_doc,
+                                                      diffs=True)
+        for doc_id, records in diffs.items():
+            self.mirrors[doc_id].apply(records)
+        return hashes, diffs
+
+    def check(self, doc_id):
+        got = self.mirrors[doc_id].snapshot(ROOT_ID)
+        want = self.rset.materialize(doc_id)
+        assert got == want, f"{doc_id}:\nmirror: {got}\nengine: {want}"
+
+
+@pytest.mark.parametrize("native", [None, False])
+def test_incremental_mirror_follows_engine_diffs(native):
+    docs = {}
+    a = am.change(am.init("A"), lambda d: am.assign(
+        d, {"n": 1, "xs": [10, 20], "t": am.Text(), "m": {"deep": True}}))
+    a = am.change(a, lambda d: d["t"].insert_at(0, *"hi"))
+    docs["d0"] = a
+    b = am.change(am.init("A"), lambda d: am.assign(d, {"x": "y"}))
+    docs["d1"] = b
+
+    tr = _Tracker(["d0", "d1"], native=native)
+    # round 1: initial load — diffs describe construction from empty
+    tr.round({d: doc._doc.opset.get_missing_changes({})
+              for d, doc in docs.items()})
+    tr.check("d0")
+    tr.check("d1")
+
+    # round 2: map set + list insert + text edit + delete on d0 only
+    prev = docs["d0"]
+    new = am.change(prev, lambda d: d.__setitem__("n", 2))
+    new = am.change(new, lambda d: d["xs"].insert_at(1, 15))
+    new = am.change(new, lambda d: d["t"].insert_at(2, "!"))
+    new = am.change(new, lambda d: d["m"].__delitem__("deep"))
+    _, diffs = tr.round({"d0": _delta(prev, new)})
+    docs["d0"] = new
+    assert "d1" not in diffs, "unchanged doc must emit no records"
+    tr.check("d0")
+    tr.check("d1")
+
+    # round 3: removals and a set on an existing element
+    prev = docs["d0"]
+    new = am.change(prev, lambda d: d["xs"].delete_at(0))
+    new = am.change(new, lambda d: d["t"].delete_at(0))
+    new = am.change(new, lambda d: d["xs"].__setitem__(0, 99))
+    _, diffs = tr.round({"d0": _delta(prev, new)})
+    docs["d0"] = new
+    tr.check("d0")
+
+
+def test_conflict_only_change_is_reported():
+    """A concurrent losing write changes no winner, no visibility, no rank —
+    only the conflict set. The survivor-hash mask must still catch it."""
+    base = am.change(am.init("B"), lambda d: d.__setitem__("k", "v0"))
+    tr = _Tracker(["d"])
+    tr.round({"d": base._doc.opset.get_missing_changes({})})
+    tr.check("d")
+
+    # truly concurrent writes: B (higher actor) wins, A lands in conflicts
+    fork = am.merge(am.init("A"), base)
+    b2 = am.change(base, lambda d: d.__setitem__("k", "vb"))
+    a2 = am.change(fork, lambda d: d.__setitem__("k", "va"))
+    merged = am.merge(b2, a2)
+    delta = merged._doc.opset.get_missing_changes(base._doc.opset.clock)
+    _, diffs = tr.round({"d": delta})
+    assert "d" in diffs, "conflict-only change produced no diff"
+    recs = [r for r in diffs["d"] if r.get("key") == "k"]
+    assert recs and recs[0]["action"] == "set" and recs[0]["value"] == "vb"
+    assert recs[0]["conflicts"] == [{"actor": "A", "value": "va"}]
+    tr.check("d")
+
+
+def test_diff_records_match_oracle_diffs_shape():
+    """Engine records for a simple round carry the same action/obj/key/value
+    content as the interpretive oracle's diff stream."""
+    base = am.change(am.init("A"), lambda d: am.assign(d, {"xs": [1, 2]}))
+    tr = _Tracker(["d"])
+    tr.round({"d": base._doc.opset.get_missing_changes({})})
+
+    new = am.change(base, lambda d: d["xs"].insert_at(1, 7))
+    new = am.change(new, lambda d: d.__setitem__("k", "v"))
+    delta = _delta(base, new)
+    _, diffs = tr.round({"d": delta})
+
+    # oracle diff stream for the same delta
+    _, oracle_diffs = base._doc.opset.add_changes(delta)
+
+    def norm(recs):
+        out = set()
+        for r in recs:
+            if r["action"] == "create":
+                continue
+            out.add((r["action"], r["type"], r.get("key"), r.get("index"),
+                     repr(r.get("value"))))
+        return out
+
+    assert norm(diffs["d"]) == norm(oracle_diffs)
+    tr.check("d")
+
+
+def test_random_rounds_mirror_parity():
+    """Randomized multi-round soak: mirrors driven purely by engine diffs
+    track full materialization and the interpretive oracle."""
+    rng = random.Random(5)
+    n = 4
+    ids = [f"d{i}" for i in range(n)]
+    docs = {}
+    for i, did in enumerate(ids):
+        d = am.change(am.init("A"), lambda x, i=i: am.assign(
+            x, {"n": i, "xs": [i], "t": am.Text()}))
+        docs[did] = d
+
+    tr = _Tracker(ids)
+    tr.round({d: docs[d]._doc.opset.get_missing_changes({}) for d in ids})
+
+    for rnd in range(6):
+        round_changes = {}
+        for did in rng.sample(ids, rng.randint(1, n)):
+            prev = docs[did]
+            r = rng.random()
+            if r < 0.35:
+                new = am.change(prev, lambda d, rnd=rnd: d.__setitem__(
+                    "n", rnd * 10))
+            elif r < 0.6:
+                pos = rng.randint(0, len(prev["xs"]))
+                new = am.change(prev, lambda d, p=pos, rnd=rnd:
+                                d["xs"].insert_at(p, rnd))
+            elif r < 0.8 and len(prev["xs"]):
+                pos = rng.randrange(len(prev["xs"]))
+                new = am.change(prev, lambda d, p=pos: d["xs"].delete_at(p))
+            else:
+                pos = rng.randint(0, len(prev["t"]))
+                new = am.change(prev, lambda d, p=pos: d["t"].insert_at(
+                    p, rng.choice("xyz")))
+            round_changes[did] = _delta(prev, new)
+            docs[did] = new
+        tr.round(round_changes)
+        for did in ids:
+            tr.check(did)
+            # and the oracle agrees with the engine materialization
+            assert tr.rset.materialize(did) == oracle_state(docs[did])
+
+
+def test_baseline_survives_add_docs_and_hash_only_rounds():
+    """add_docs and diffs=False rounds must not reset the diff baseline:
+    the next diff round reports only what the consumer hasn't seen (list
+    inserts are not idempotent, so a reset would duplicate elements)."""
+    a = am.change(am.init("A"), lambda d: d.__setitem__("xs", [1, 2, 3]))
+    tr = _Tracker(["d0"])
+    tr.round({"d0": a._doc.opset.get_missing_changes({})})
+    tr.check("d0")
+
+    # mid-stream doc addition nulls _out but must not reset the baseline
+    tr.rset.add_docs(["d1"])
+    tr.mirrors["d1"] = MirrorDoc()
+    b = am.change(am.init("B"), lambda d: d.__setitem__("y", 1))
+    prev_a = a
+    a2 = am.change(a, lambda d: d.__setitem__("n", 7))
+    _, diffs = tr.round({"d0": _delta(prev_a, a2),
+                         "d1": b._doc.opset.get_missing_changes({})})
+    # d0's records must NOT re-insert xs elements
+    assert all(r.get("type") != "list" for r in diffs["d0"]), diffs["d0"]
+    tr.check("d0")
+    tr.check("d1")
+
+    # a hash-only round's effects surface on the NEXT diff round
+    a3 = am.change(a2, lambda d: d["xs"].insert_at(0, 0))
+    tr.rset.apply_and_reconcile({"d0": _delta(a2, a3)})  # diffs=False
+    a4 = am.change(a3, lambda d: d.__setitem__("n", 8))
+    _, diffs = tr.round({"d0": _delta(a3, a4)})
+    kinds = {(r["action"], r.get("type")) for r in diffs["d0"]}
+    assert ("insert", "list") in kinds, "hash-only round's insert was lost"
+    tr.check("d0")
+
+
+def test_capacity_growth_between_hash_only_and_diff_rounds():
+    """A diff round whose delta grows capacities after a hash-only round
+    must not crash on baseline shape mismatch."""
+    a = am.change(am.init("A"), lambda d: d.__setitem__("k", 0))
+    r = ResidentDocSet(["d"])
+    r.apply_and_reconcile({"d": a._doc.opset.get_missing_changes({})})
+    prev = a
+    big = am.change(prev, lambda d: am.assign(
+        d, {f"k{i}": i for i in range(40)}))  # grows cap_ops/cap_fids
+    h, diffs = r.apply_and_reconcile({"d": _delta(prev, big)}, diffs=True)
+    m = MirrorDoc()
+    m.apply(diffs["d"])
+    # baseline was empty (first diff round): mirror sees the full doc
+    assert m.snapshot(ROOT_ID) == r.materialize("d")
+
+
+def test_new_actor_remap_emits_no_spurious_diffs():
+    """Registering an actor that re-sorts ranks must not flag unchanged
+    documents as changed."""
+    docs = {}
+    for i in range(3):
+        docs[f"d{i}"] = am.change(am.init("M"), lambda d, i=i: am.assign(
+            d, {"n": i, "xs": [i]}))
+    tr = _Tracker(list(docs))
+    tr.round({d: doc._doc.opset.get_missing_changes({})
+              for d, doc in docs.items()})
+
+    # actor "A" sorts before "M": global rank remap
+    prev = docs["d0"]
+    peer = am.change(am.merge(am.init("A"), prev),
+                     lambda d: d.__setitem__("n", 99))
+    merged = am.merge(prev, peer)
+    _, diffs = tr.round({"d0": _delta(prev, merged)})
+    docs["d0"] = merged
+    assert set(diffs) == {"d0"}, f"spurious diffs: {sorted(diffs)}"
+    for d in docs:
+        tr.check(d)
+
+
+def test_hash_only_path_unaffected():
+    """diffs=False keeps the old contract (hashes only, no diff state)."""
+    base = am.change(am.init("A"), lambda d: d.__setitem__("k", 1))
+    r = ResidentDocSet(["d"])
+    h = r.apply_and_reconcile({"d": base._doc.opset.get_missing_changes({})})
+    assert isinstance(h, np.ndarray) and h.shape == (1,)
