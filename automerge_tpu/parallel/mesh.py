@@ -69,14 +69,23 @@ def sharded_apply(arrays: dict, max_fids: int, mesh: Mesh):
     return fn(arrays)
 
 
-def reconcile_sharded(doc_changes, mesh: Mesh):
-    """End-to-end: encode a list of per-document change sets, shard them over
-    the mesh, reconcile, and return (encodings, sharded outputs, n_real_docs)."""
+def encode_padded_batch(doc_changes, mesh: Mesh):
+    """Encode per-document change sets into a stacked batch padded to the
+    mesh size. Deterministic given the change sets alone (sorted global
+    actor order), so every host of a multi-host run produces a
+    bit-identical description — the precondition for contributing local
+    shards of one global array (parallel/multihost.py)."""
     all_actors = sorted({c.actor for changes in doc_changes for c in changes})
     encodings = [encode_doc(changes, all_actors) for changes in doc_changes]
     batch = stack_docs(encodings)
     max_fids = batch.pop("max_fids")
-    batch = _pad_docs(batch, mesh.devices.size)
+    return encodings, _pad_docs(batch, mesh.devices.size), max_fids
+
+
+def reconcile_sharded(doc_changes, mesh: Mesh):
+    """End-to-end: encode a list of per-document change sets, shard them over
+    the mesh, reconcile, and return (encodings, sharded outputs, n_real_docs)."""
+    encodings, batch, max_fids = encode_padded_batch(doc_changes, mesh)
     arrays = shard_batch(batch, mesh)
     out = sharded_apply(arrays, max_fids, mesh)
     return encodings, out, len(doc_changes)

@@ -1,0 +1,92 @@
+"""Multi-host DocSet execution: jax.distributed + a global device mesh +
+the reference's sync protocol over DCN.
+
+The reference scales across machines purely by replica parallelism: each
+peer owns its documents and exchanges {docId, clock, changes}
+(/root/reference/src/connection.js:58-113). The multi-host design keeps
+that host-level protocol verbatim over the host network (our TCP transport,
+sync/tcp.py) and adds the orthogonal device axis: every process's devices
+join one global jax.sharding.Mesh, reconciliation runs as a single SPMD
+program with each host feeding its local shard of the document axis
+(jax.make_array_from_process_local_data), and cross-host reductions (clock
+unions, convergence checks) lower to the collectives fabric jax.distributed
+provides — Gloo over TCP between CPU hosts, ICI/DCN on TPU pods. The same
+code runs in both settings; only the mesh contents differ.
+
+This is exercised for real (two OS processes, each with its own device set,
+syncing over TCP then jointly reconciling on an 8-device global mesh) by
+tests/test_multihost.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DOCS_AXIS, encode_padded_batch, sharded_apply
+
+
+def init_multihost(coordinator: str, num_processes: int,
+                   process_id: int) -> None:
+    """Join the multi-process runtime (idempotent per process). CPU hosts
+    need jax.config.update("jax_platforms", "cpu") BEFORE calling this."""
+    jax.distributed.initialize(coordinator, num_processes=num_processes,
+                               process_id=process_id)
+
+
+def global_mesh(axis: str = DOCS_AXIS) -> Mesh:
+    """One mesh over every device of every participating process."""
+    return Mesh(np.array(jax.devices()), (axis,))
+
+
+def host_doc_range(n_global: int, mesh: Mesh) -> tuple[int, int]:
+    """Contiguous [lo, hi) block of the global document axis this process
+    owns (the doc axis is laid out device-major in mesh order, and
+    jax.devices() groups devices by process)."""
+    devices = list(mesh.devices.flat)
+    n_dev = len(devices)
+    assert n_global % n_dev == 0, "pad the doc axis to the mesh size first"
+    per_dev = n_global // n_dev
+    mine = [k for k, d in enumerate(devices)
+            if d.process_index == jax.process_index()]
+    assert mine == list(range(min(mine), max(mine) + 1)), (
+        "this process's devices are not contiguous in mesh order; build "
+        "the mesh from jax.devices() (process-major) for multi-host runs")
+    return min(mine) * per_dev, (max(mine) + 1) * per_dev
+
+
+def shard_global_batch(batch: dict, mesh: Mesh) -> dict:
+    """Assemble globally-sharded batch arrays from this process's local
+    rows; every process must pass a bit-identical batch description (the
+    synced change log guarantees it)."""
+    n_global = batch["op_mask"].shape[0]
+    sh = NamedSharding(mesh, P(DOCS_AXIS))
+    lo, hi = host_doc_range(n_global, mesh)
+    out = {}
+    for k, v in batch.items():
+        v = np.asarray(v)
+        out[k] = jax.make_array_from_process_local_data(
+            sh, np.ascontiguousarray(v[lo:hi]), global_shape=v.shape)
+    return out
+
+
+def reconcile_global(doc_changes, mesh: Mesh):
+    """One SPMD reconcile of a DocSet over the global (multi-host) mesh.
+
+    Every host holds the same synced per-document change lists (the DCN
+    protocol's postcondition), encodes the global batch identically, and
+    contributes only its own document shard. Returns (lo, hi, hashes):
+    this host's global doc range and the uint32 state hashes of exactly
+    those documents (padding rows sliced off by the caller via n_docs).
+    """
+    _, batch, max_fids = encode_padded_batch(doc_changes, mesh)
+    arrays = shard_global_batch(batch, mesh)
+    out = sharded_apply(arrays, max_fids, mesh)
+    h = out["hash"]
+    lo, hi = host_doc_range(batch["op_mask"].shape[0], mesh)
+    shards = sorted(h.addressable_shards,
+                    key=lambda s: s.index[0].start or 0)
+    local = np.concatenate([np.asarray(s.data) for s in shards])
+    return lo, hi, local.astype(np.uint32)

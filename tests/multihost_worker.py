@@ -1,0 +1,141 @@
+"""Worker process for tests/test_multihost.py (not a pytest module).
+
+Each of two OS processes: owns half of a DocSet, syncs with the other host
+over TCP speaking the reference's {docId, clock, changes} protocol, then
+joins a global 8-device mesh (4 CPU devices per process via
+jax.distributed) for a single SPMD reconcile and a cross-host clock-union
+collective. Usage:
+    python tests/multihost_worker.py <pid> <coordinator_port> <sync_port>
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+pid = int(sys.argv[1])
+coord_port = sys.argv[2]
+sync_port = int(sys.argv[3])
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=4").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from automerge_tpu.parallel.multihost import (global_mesh,  # noqa: E402
+                                              init_multihost,
+                                              reconcile_global)
+
+init_multihost(f"127.0.0.1:{coord_port}", num_processes=2, process_id=pid)
+assert jax.device_count() == 8, jax.device_count()
+assert jax.local_device_count() == 4
+
+import automerge_tpu as am  # noqa: E402
+from automerge_tpu.sync.docset import DocSet  # noqa: E402
+from automerge_tpu.sync.tcp import TcpSyncClient, TcpSyncServer  # noqa: E402
+
+N = 8
+ACTOR = f"host{pid}"
+ds = DocSet()
+for i in range(N):
+    if i % 2 == pid:  # each host authors half the fleet
+        d = am.change(am.init(ACTOR), lambda x, i=i: am.assign(
+            x, {"n": i, "xs": [i, i + 1], "owner": ACTOR}))
+        ds.set_doc(f"doc{i}", d)
+
+# --- phase 1: DCN sync ({docId, clock, changes} over TCP) ---------------
+if pid == 0:
+    link = TcpSyncServer(ds, port=sync_port).start()
+else:
+    link = None
+    for attempt in range(100):
+        try:
+            link = TcpSyncClient(ds, "127.0.0.1", sync_port).start()
+            break
+        except OSError:
+            time.sleep(0.1)
+    assert link is not None, "could not reach host 0"
+
+deadline = time.time() + 60
+while time.time() < deadline:
+    if all(ds.get_doc(f"doc{i}") is not None for i in range(N)):
+        break
+    time.sleep(0.05)
+else:
+    raise AssertionError(f"[p{pid}] initial sync did not converge")
+
+# concurrent edits on a shared doc: both hosts write doc0.winner; LWW must
+# resolve to host1 (higher actor string) on BOTH hosts. The non-authoring
+# host's auto-created replica has a random actor id, so rebase onto an
+# ACTOR-identified replica before writing.
+doc0 = ds.get_doc("doc0")
+if doc0._doc.actor_id == ACTOR:
+    ds.set_doc("doc0", am.change(
+        doc0, lambda x: x.__setitem__("winner", ACTOR)))
+else:
+    mine = am.change(am.merge(am.init(ACTOR), doc0),
+                     lambda x: x.__setitem__("winner", ACTOR))
+    ds.set_doc("doc0", am.merge(ds.get_doc("doc0"), mine))
+
+deadline = time.time() + 60
+while time.time() < deadline:
+    d0 = ds.get_doc("doc0")
+    clock = d0._doc.opset.clock
+    if all(f"host{h}" in clock for h in (0, 1)) \
+            and sum(clock.values()) >= 3:
+        break
+    time.sleep(0.05)
+else:
+    raise AssertionError(
+        f"[p{pid}] concurrent-edit sync did not converge: "
+        f"{ds.get_doc('doc0')._doc.opset.clock}")
+assert ds.get_doc("doc0")["winner"] == "host1", \
+    f"[p{pid}] LWW winner: {ds.get_doc('doc0')['winner']}"
+
+# --- phase 2: global SPMD reconcile over the joint mesh -----------------
+mesh = global_mesh()
+doc_changes = [ds.get_doc(f"doc{i}")._doc.opset.get_missing_changes({})
+               for i in range(N)]
+lo, hi, local_hashes = reconcile_global(doc_changes, mesh)
+
+# parity: the shard this host computed matches a purely-local oracle run
+from automerge_tpu.engine.batchdoc import apply_batch  # noqa: E402
+
+_, _, ref_out = apply_batch(doc_changes)
+ref = np.asarray(ref_out["hash"]).astype(np.uint32)
+want = ref[lo:min(hi, N)]
+got = local_hashes[:len(want)]
+assert (got == want).all(), f"[p{pid}] shard hash mismatch"
+
+# --- phase 3: cross-host collective (clock union over the doc axis) -----
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from automerge_tpu.parallel.collective import global_clock_union  # noqa: E402
+from automerge_tpu.parallel.mesh import DOCS_AXIS  # noqa: E402
+
+actors = sorted({c.actor for chs in doc_changes for c in chs})
+rank = {a: k for k, a in enumerate(actors)}
+clocks = np.zeros((N, len(actors)), np.int32)
+for i in range(N):
+    for a, s in ds.get_doc(f"doc{i}")._doc.opset.clock.items():
+        clocks[i, rank[a]] = s
+sh = NamedSharding(mesh, P(DOCS_AXIS))
+arr = jax.make_array_from_process_local_data(
+    sh, np.ascontiguousarray(clocks[lo:hi]), global_shape=clocks.shape)
+union = np.asarray(global_clock_union(arr, mesh))
+# the union must contain BOTH hosts' seqs even though each host only fed
+# its own shard — i.e. the reduction really crossed the host boundary
+want_union = clocks.max(axis=0)
+assert (union == want_union).all(), f"[p{pid}] union {union} != {want_union}"
+assert all(union[rank[f"host{h}"]] > 0 for h in (0, 1))
+
+if link is not None:
+    link.close()
+print(f"MULTIHOST-OK p{pid} union={union.tolist()}", flush=True)
