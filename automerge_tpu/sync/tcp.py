@@ -46,6 +46,14 @@ def _sync_lock_of(doc_set) -> threading.RLock:
     return lock
 
 
+def sync_lock(doc_set) -> threading.RLock:
+    """Public handle to the transport lock: application threads that
+    read-modify-write docs in a DocSet served by a TCP transport must hold
+    this around the get_doc -> change -> set_doc sequence, or the receive
+    thread can advance the doc between their read and their write."""
+    return _sync_lock_of(doc_set)
+
+
 _HEADER = struct.Struct(">I")
 _MSG_MAGIC = b"AMWM"
 _MSG_HDR = struct.Struct("<I")

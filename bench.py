@@ -596,6 +596,22 @@ def run_resident_rounds(doc_changes, n_rounds=12, fraction=0.2):
     return engine_round, oracle_round, ops_per_round
 
 
+def _oracle_capped(doc_changes, cap_docs: int):
+    """Interpretive-baseline time for a doc batch, measured directly up to
+    cap_docs and extrapolated linearly past it — with the linearity of the
+    measured region recorded (VERDICT r1 weak #5: the extrapolation must
+    carry its own empirical check). Returns (seconds, linearity|None,
+    measured_subset)."""
+    if len(doc_changes) > cap_docs:
+        subset = doc_changes[:cap_docs]
+        scale = len(doc_changes) / len(subset)
+        cap_time, first_s, second_s, n_first = run_oracle_split(subset)
+        linearity = round((second_s / max(len(subset) - n_first, 1))
+                          / (first_s / n_first), 3)
+        return cap_time * scale, linearity, subset
+    return run_oracle(doc_changes), None, doc_changes
+
+
 def run_config(cfg: int, n_docs: int | None = None, oracle_cap_docs=1000):
     if cfg == 6:
         return run_text_load_config()
@@ -615,20 +631,37 @@ def run_config(cfg: int, n_docs: int | None = None, oracle_cap_docs=1000):
     # cost GROWS with docs processed, so linear extrapolation UNDERestimates
     # the full-size oracle and the reported speedup is conservative; <1 the
     # reverse).
-    linearity = None
-    if len(doc_changes) > oracle_cap_docs:
-        subset = doc_changes[:oracle_cap_docs]
-        scale = len(doc_changes) / len(subset)
-        cap_time, first_s, second_s, n_first = run_oracle_split(subset)
-        linearity = round((second_s / max(len(subset) - n_first, 1))
-                          / (first_s / n_first), 3)
-        oracle_time = cap_time * scale
-    else:
-        subset, scale = doc_changes, 1.0
-        oracle_time = run_oracle(subset)
+    oracle_time, linearity, subset = _oracle_capped(doc_changes,
+                                                    oracle_cap_docs)
 
     engine_time, device_time, encode_time, kernel_info = run_engine(doc_changes)
     check_parity(doc_changes)
+
+    # Single-doc configs cannot amortize the tunneled chip's fixed
+    # dispatch/readback cost (~10-70ms) against a sub-10ms oracle; the
+    # engine's design center is the DocSet batch axis. So configs 1-4 also
+    # report a BATCHED variant: the same workload replicated over 256
+    # documents, oracle and engine both doing all 256 (oracle measured on a
+    # 64-doc subset, scaled linearly, linearity recorded like config 5).
+    batched = {}
+    if cfg in (1, 2, 3, 4):
+        rep = 256
+        rep_changes = doc_changes * rep
+        b_oracle, b_lin, _sub = _oracle_capped(rep_changes, 64)
+        b_engine, b_device, _enc, _ki = run_engine(rep_changes)
+        check_parity(rep_changes, sample=3)
+        b_ops = ops * rep
+        batched = {"batched": {
+            "docs": rep,
+            "ops": b_ops,
+            "oracle_s": round(b_oracle, 4),
+            "engine_s": round(b_engine, 4),
+            "device_s": round(b_device, 6),
+            "engine_ops_per_s": round(b_ops / b_engine),
+            "speedup": round(b_oracle / b_engine, 2),
+            "device_speedup": round(b_oracle / b_device, 1),
+            "oracle_linearity": b_lin,
+        }}
 
     resident = {}
     if cfg == 5 and len(doc_changes) >= 100:
@@ -648,6 +681,7 @@ def run_config(cfg: int, n_docs: int | None = None, oracle_cap_docs=1000):
 
     return {
         **resident,
+        **batched,
         "config": cfg,
         "name": name,
         "docs": len(doc_changes),
@@ -683,11 +717,16 @@ def _final_record(results_by_cfg: dict, backend: str | None, attempts: list):
         "vs_baseline": headline["speedup"] if headline else 0.0,
         "baseline": ("single-threaded interpretive engine "
                      "(no Node in image; see bench.py docstring)"),
-        "configs": {str(r["config"]): {"speedup": r["speedup"],
-                                       "device_speedup": r["device_speedup"],
-                                       "engine_ops_per_s": r["engine_ops_per_s"],
-                                       "backend": r.get("backend")}
-                    for r in results},
+        "configs": {str(r["config"]): {
+            "speedup": r["speedup"],
+            "device_speedup": r["device_speedup"],
+            "engine_ops_per_s": r["engine_ops_per_s"],
+            "backend": r.get("backend"),
+            **({"batched_speedup": r["batched"]["speedup"],
+                "batched_device_speedup": r["batched"]["device_speedup"],
+                "batched_docs": r["batched"]["docs"]}
+               if "batched" in r else {})}
+            for r in results},
     }
     if headline:
         rec["device_resident_ops_per_s"] = headline["device_ops_per_s"]
