@@ -63,7 +63,17 @@ class DocHandle:
 
 
 class EngineDocSet:
-    def __init__(self, doc_ids: list[str] | None = None):
+    def __init__(self, doc_ids: list[str] | None = None,
+                 live_views: bool = False):
+        """live_views=True turns the node into a view server: every ingress
+        runs the fused apply+reconcile with device-side diff emission
+        (engine/diffs.py), per-doc MirrorDoc views are maintained
+        incrementally from the diff records (the reference's
+        updateCache-from-diffs flow, freeze_api.js:148-186, running off the
+        engine instead of an interpretive OpSet), and subscribers receive
+        the raw diff stream. Reads via `view()` then cost zero device work.
+        The trade: each ingress pays a reconcile dispatch immediately
+        instead of deferring it to the next hash read."""
         self._resident = ResidentDocSet(list(doc_ids or []))
         # per doc: actor -> changes ordered by seq (admission guarantees
         # in-order per actor). This is the re-serve log, op_set.js:308-317.
@@ -71,6 +81,9 @@ class EngineDocSet:
             d: {} for d in self._resident.doc_ids}
         self._handles: dict[str, DocHandle] = {}
         self.handlers: list[Callable] = []
+        self.live_views = live_views
+        self._views: dict[str, "object"] = {}
+        self._view_subs: list[Callable] = []
         # One node can serve several transport peers (TcpSyncServer spawns a
         # reader thread per socket); the resident engine is not re-entrant.
         self._lock = threading.RLock()
@@ -104,21 +117,42 @@ class EngineDocSet:
 
     # -- ingress ------------------------------------------------------------
 
-    def apply_changes(self, doc_id: str, changes: list[Change]) -> DocHandle:
-        """Admit a change batch into resident state (causal buffering and
-        duplicate-drop happen in the engine's delta encoder) and notify
-        handlers so attached Connections gossip the update."""
+    def _ingest(self, doc_id: str, apply_fn) -> tuple[DocHandle, list]:
+        """Shared ingress tail: run apply_fn (which scatters the delta and,
+        in live-view mode, reconciles + emits diffs), log admissions, fold
+        diff records into the doc's mirror view."""
         with self._lock:
             self.add_doc(doc_id)
-            self._resident.apply_changes({doc_id: changes})
+            diffs = apply_fn()
             admitted = self._resident.last_admitted.get(doc_id, [])
             log = self._log[doc_id]
             for c in admitted:
                 log.setdefault(c.actor, []).append(c)
+            records = (diffs or {}).get(doc_id, [])
+            if records:
+                from ..engine.diffs import MirrorDoc
+                self._views.setdefault(doc_id, MirrorDoc()).apply(records)
             handle = self.get_doc(doc_id)
+        if records:
+            for sub in list(self._view_subs):
+                sub(doc_id, records)
         if admitted:
             for handler in list(self.handlers):
                 handler(doc_id, handle)
+        return handle, admitted
+
+    def apply_changes(self, doc_id: str, changes: list[Change]) -> DocHandle:
+        """Admit a change batch into resident state (causal buffering and
+        duplicate-drop happen in the engine's delta encoder) and notify
+        handlers so attached Connections gossip the update."""
+        def apply_fn():
+            if self.live_views:
+                _h, diffs = self._resident.apply_and_reconcile(
+                    {doc_id: changes}, diffs=True)
+                return diffs
+            self._resident.apply_changes({doc_id: changes})
+            return None
+        handle, _ = self._ingest(doc_id, apply_fn)
         return handle
 
     def apply_columns(self, doc_id: str, cols) -> DocHandle:
@@ -127,22 +161,38 @@ class EngineDocSet:
         and the log keeps lazy refs into the frame — no per-op Python
         objects exist unless a lagging peer later needs re-serving. The
         fallback materializes Change objects once (one pass, no JSON)."""
-        with self._lock:
-            self.add_doc(doc_id)
+        def apply_fn():
+            if self.live_views:
+                _h, diffs = self._resident.apply_and_reconcile_columns(
+                    {doc_id: cols}, diffs=True)
+                return diffs
             if self._resident._native is not None:
                 self._resident.apply_columns({doc_id: cols})
             else:
-                self._resident.apply_changes(
-                    {doc_id: cols.to_changes()})
-            admitted = self._resident.last_admitted.get(doc_id, [])
-            log = self._log[doc_id]
-            for c in admitted:
-                log.setdefault(c.actor, []).append(c)
-            handle = self.get_doc(doc_id)
-        if admitted:
-            for handler in list(self.handlers):
-                handler(doc_id, handle)
+                self._resident.apply_changes({doc_id: cols.to_changes()})
+            return None
+        handle, _ = self._ingest(doc_id, apply_fn)
         return handle
+
+    # -- live views -----------------------------------------------------------
+
+    def subscribe_views(self, callback: Callable) -> None:
+        """callback(doc_id, records): the engine's diff stream, per round —
+        the surface a remote frontend folds into its own mirror."""
+        if callback not in self._view_subs:
+            self._view_subs.append(callback)
+
+    def view(self, doc_id: str):
+        """Current materialized view from the incrementally-maintained
+        mirror (live_views mode): no device work, no log replay."""
+        from ..core.ids import ROOT_ID
+        with self._lock:
+            if not self.live_views:
+                raise RuntimeError("EngineDocSet(live_views=True) required")
+            m = self._views.get(doc_id)
+            if m is None:
+                return {"data": {}, "conflicts": {}}
+            return m.snapshot(ROOT_ID)
 
     # -- protocol reads -------------------------------------------------------
 

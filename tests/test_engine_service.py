@@ -201,3 +201,64 @@ class TestEngineService:
         finally:
             client.close()
             server.close()
+
+
+class TestLiveViews:
+    """EngineDocSet(live_views=True): the engine's diff stream drives
+    incrementally-maintained views at the service layer — frontends read
+    materialized state with zero device work and subscribers receive the
+    same records a remote mirror would fold in."""
+
+    def test_views_track_engine_and_oracle_through_sync(self):
+        from automerge_tpu.engine.batchdoc import oracle_state
+        from automerge_tpu.frontend.materialize import apply_changes_to_doc
+
+        chs_a, chs_b, chs_all = two_replica_trace()
+        qa, qb = [], []
+        ea = EngineDocSet(live_views=True)
+        eb = EngineDocSet(live_views=True)
+        ca = Connection(ea, qa.append, wire="columnar")
+        cb = Connection(eb, qb.append, wire="columnar")
+        ca.open(); cb.open()
+        ea.apply_changes("doc", chs_a)
+        eb.apply_changes("doc", chs_b)
+        drain(qa, ca, qb, cb)
+
+        # both nodes' live views equal their own device materialization...
+        for node in (ea, eb):
+            assert node.view("doc") == node.materialize("doc")
+        # ...and the interpretive oracle of the merged history
+        doc = am.init("o")
+        doc = apply_changes_to_doc(doc, doc._doc.opset, chs_all,
+                                   incremental=False)
+        assert ea.view("doc") == oracle_state(doc)
+        assert eb.view("doc") == ea.view("doc")
+
+    def test_subscribers_receive_the_diff_stream(self):
+        seen = []
+        e = EngineDocSet(live_views=True)
+        e.subscribe_views(lambda doc_id, recs: seen.append((doc_id, recs)))
+        base = am.change(am.init("A"), lambda d: d.__setitem__("xs", [1]))
+        e.apply_changes("d", base._doc.opset.get_missing_changes({}))
+        assert seen and seen[0][0] == "d"
+        actions = {(r["action"], r.get("type")) for r in seen[0][1]}
+        assert ("insert", "list") in actions
+
+        # a remote mirror fed only by the subscription tracks the service
+        from automerge_tpu.core.ids import ROOT_ID
+        from automerge_tpu.engine.diffs import MirrorDoc
+        remote = MirrorDoc()
+        for _d, recs in seen:
+            remote.apply(recs)
+        nxt = am.change(base, lambda d: d["xs"].insert_at(0, 0))
+        e.apply_changes("d", nxt._doc.opset.get_missing_changes(
+            base._doc.opset.clock))
+        for _d, recs in seen[1:]:
+            remote.apply(recs)
+        assert remote.snapshot(ROOT_ID) == e.view("d") == e.materialize("d")
+
+    def test_view_requires_live_mode(self):
+        import pytest
+        e = EngineDocSet()
+        with pytest.raises(RuntimeError):
+            e.view("d")
