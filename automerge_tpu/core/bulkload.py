@@ -74,7 +74,10 @@ def try_bulk_load(data: str, max_version: int | None = None) -> OpSet | None:
         return None
     except KeyError:
         # structural reference the fast path didn't expect (e.g. op on an
-        # object created by a queued change): interpretive path handles it
+        # object created by a queued change): interpretive path handles it.
+        # Counted so an unexpected fallback (a fast-path bug demoted to a
+        # perf regression) is observable rather than silent.
+        metrics.bump("bulkload_fallback_keyerror")
         return None
     finally:
         if was_enabled:

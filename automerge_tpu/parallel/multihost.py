@@ -32,6 +32,8 @@ def init_multihost(coordinator: str, num_processes: int,
                    process_id: int) -> None:
     """Join the multi-process runtime (idempotent per process). CPU hosts
     need jax.config.update("jax_platforms", "cpu") BEFORE calling this."""
+    if jax.distributed.is_initialized():
+        return
     jax.distributed.initialize(coordinator, num_processes=num_processes,
                                process_id=process_id)
 

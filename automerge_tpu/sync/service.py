@@ -87,6 +87,16 @@ class EngineDocSet:
         # One node can serve several transport peers (TcpSyncServer spawns a
         # reader thread per socket); the resident engine is not re-entrant.
         self._lock = threading.RLock()
+        # Diff records are index-based patches, so subscribers must see a
+        # doc's batches in ingress order — but running callbacks under
+        # self._lock would let a subscriber that grabs its own lock deadlock
+        # against a peer thread calling back into this node (ABBA). Instead,
+        # ingress order is frozen by appending to this queue while holding
+        # self._lock; delivery drains the queue outside it, serialized by
+        # _notify_lock (an RLock, so a subscriber may itself call
+        # apply_changes without deadlocking).
+        self._notify_queue: list[tuple[str, list]] = []
+        self._notify_lock = threading.RLock()
 
     # -- registry surface (doc_set.js:5-38) ---------------------------------
 
@@ -133,9 +143,10 @@ class EngineDocSet:
                 from ..engine.diffs import MirrorDoc
                 self._views.setdefault(doc_id, MirrorDoc()).apply(records)
             handle = self.get_doc(doc_id)
+            if records:
+                self._notify_queue.append((doc_id, records))
         if records:
-            for sub in list(self._view_subs):
-                sub(doc_id, records)
+            self._drain_notifications()
         if admitted:
             for handler in list(self.handlers):
                 handler(doc_id, handle)
@@ -173,6 +184,21 @@ class EngineDocSet:
             return None
         handle, _ = self._ingest(doc_id, apply_fn)
         return handle
+
+    def _drain_notifications(self) -> None:
+        """Deliver queued diff batches to view subscribers in ingress order.
+        Whichever thread holds _notify_lock drains everything pending
+        (including batches enqueued by other ingress threads, which then
+        find the queue empty — their batch was delivered for them, still in
+        order)."""
+        with self._notify_lock:
+            while True:
+                with self._lock:
+                    if not self._notify_queue:
+                        return
+                    doc_id, records = self._notify_queue.pop(0)
+                for sub in list(self._view_subs):
+                    sub(doc_id, records)
 
     # -- live views -----------------------------------------------------------
 

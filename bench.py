@@ -232,12 +232,14 @@ def run_text_load_config(n_edits=65536, oracle_cap=8192):
         "load_full_s": round(bulk_full_s, 3),
         "oracle_s": round(oracle_small_s, 4),
         "engine_s": round(bulk_small_s, 4),
-        "device_s": round(bulk_small_s, 4),  # host-side config: no device
+        # host-only config: no device path, so no device_* measurements
+        # (null, not aliased to host numbers — ADVICE r2)
+        "device_s": None,
         "oracle_ops_per_s": round(2 * oracle_cap / oracle_small_s),
         "engine_ops_per_s": round(2 * oracle_cap / bulk_small_s),
-        "device_ops_per_s": round(2 * oracle_cap / bulk_small_s),
+        "device_ops_per_s": None,
         "speedup": round(oracle_small_s / bulk_small_s, 2),
-        "device_speedup": round(oracle_small_s / bulk_small_s, 2),
+        "device_speedup": None,
         "speedup_note": (f"measured at {oracle_cap} edits equal-size; "
                          f"full {n_edits}-edit load takes load_full_s "
                          f"(sub-second target, VERDICT r1 #7)"),
@@ -777,11 +779,15 @@ def worker_main(args):
             print(f"ERROR {json.dumps({'config': cfg, 'error': repr(e)[:400]})}",
                   flush=True)
             continue
+        dev_note = (f"(device {r['device_s']*1000:.2f}ms), "
+                    if r.get("device_s") is not None else "(host-only), ")
+        dev_speed = (f" / {r['device_speedup']}x device-resident"
+                     if r.get("device_speedup") is not None else "")
         print(f"# config {cfg} [{r['name']}]: {r['ops']} ops, "
               f"oracle {r['oracle_s']:.3f}s, engine {r['engine_s']:.3f}s "
-              f"(device {r['device_s']*1000:.2f}ms), "
-              f"speedup {r['speedup']}x end-to-end / {r['device_speedup']}x "
-              f"device-resident, parity OK", file=sys.stderr)
+              f"{dev_note}"
+              f"speedup {r['speedup']}x end-to-end{dev_speed}, parity OK",
+              file=sys.stderr)
         print(f"RESULT {json.dumps(r)}", flush=True)
     print("FINAL done", flush=True)
     sys.exit(rc)

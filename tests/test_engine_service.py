@@ -262,3 +262,62 @@ class TestLiveViews:
         e = EngineDocSet()
         with pytest.raises(RuntimeError):
             e.view("d")
+
+    def test_subscriber_sees_rounds_in_ingress_order(self):
+        """Diff batches are index-based patches: the subscriber stream must
+        be ordered per doc even with concurrent ingress threads (ADVICE r2).
+        Order is frozen under the service lock; delivery never holds it."""
+        import threading
+
+        e = EngineDocSet(live_views=True)
+        seen = []
+        e.subscribe_views(lambda doc_id, recs: seen.append(recs))
+        doc = am.change(am.init("A"), lambda d: d.__setitem__("n", -1))
+        e.apply_changes("d", doc._doc.opset.get_missing_changes({}))
+
+        rounds = []
+        for i in range(16):
+            prev = doc
+            doc = am.change(doc, lambda d, i=i: d.__setitem__("n", i))
+            rounds.append(doc._doc.opset.get_missing_changes(
+                prev._doc.opset.clock))
+        barrier = threading.Barrier(4)
+        it = iter(rounds)
+        lock = threading.Lock()
+
+        def worker():
+            barrier.wait()
+            while True:
+                with lock:
+                    chs = next(it, None)
+                if chs is None:
+                    return
+                e.apply_changes("d", chs)
+
+        ts = [threading.Thread(target=worker) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        # fold the delivered stream into a fresh mirror: if any batch were
+        # delivered out of ingress order the index patches would corrupt it
+        from automerge_tpu.core.ids import ROOT_ID
+        from automerge_tpu.engine.diffs import MirrorDoc
+        remote = MirrorDoc()
+        for recs in seen:
+            remote.apply(recs)
+        assert remote.snapshot(ROOT_ID) == e.view("d")
+
+    def test_subscriber_may_reenter_service(self):
+        """A subscriber that calls back into the node (reads a view, applies
+        a follow-up change) must not deadlock against the delivery path."""
+        e = EngineDocSet(live_views=True)
+        reentered = []
+
+        def sub(doc_id, recs):
+            reentered.append(e.view(doc_id)["data"].get("k"))
+
+        e.subscribe_views(sub)
+        doc = am.change(am.init("A"), lambda d: d.__setitem__("k", 1))
+        e.apply_changes("d", doc._doc.opset.get_missing_changes({}))
+        assert reentered == [1]
