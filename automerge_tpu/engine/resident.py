@@ -59,6 +59,11 @@ class DocTables:
         self.elem_slots: dict[int, dict[str, int]] = {}  # obj_idx -> eid -> slot
         self.state_clocks: dict[tuple[str, int], dict[str, int]] = {}
         self.clock: dict[str, int] = {}
+        # dependency frontier: the maximal (actor, seq) heads — the same
+        # pruned set the reference keeps as opSet.deps (op_set.js:243-249).
+        # A change whose declared deps cover this frontier has a transitive
+        # clock equal to the doc's full clock (the fast-admission invariant).
+        self.frontier: dict[str, int] = {}
         self.seen: set[tuple[str, int]] = set()
         self.queue: list = []  # _Pending records awaiting admission
         self.n_changes = 0
@@ -162,6 +167,13 @@ class ResidentDocSet:
 
         self.op_count = np.zeros(self.cap_docs, dtype=np.int64)
         self.change_count = np.zeros(self.cap_docs, dtype=np.int64)
+        # doc indices whose causal queue is non-empty (so budget prechecks
+        # scan O(queued) tables, not O(all))
+        self._queued_docs: set[int] = set()
+        # docs whose dense clock/frontier cache rows (maintained by the
+        # rows subclass for vectorized admission) are stale; base-class
+        # admission paths just mark, the consumer refreshes lazily
+        self._cache_dirty: set[int] = set()
 
         self.state: dict[str, jnp.ndarray] = {}
         self._alloc()
@@ -349,6 +361,13 @@ class ResidentDocSet:
                 if all(t.clock.get(a, 0) >= s for a, s in deps.items()):
                     ready.append(p)
                     t.clock[p.actor] = max(t.clock.get(p.actor, 0), p.seq)
+                    # frontier update (op_set.js:243-249): drop heads the
+                    # change declares it has seen, add the change itself
+                    drop = [a for a, s in t.frontier.items()
+                            if deps.get(a, 0) >= s]
+                    for a in drop:
+                        del t.frontier[a]
+                    t.frontier[p.actor] = p.seq
                     progress = True
                 else:
                     still.append(p)
@@ -367,6 +386,14 @@ class ResidentDocSet:
             if s <= 0:
                 continue
             trans = t.state_clocks.get((a, s))
+            if trans is not None and not isinstance(trans, dict):
+                # lazy dense-row memo from the vectorized fast path:
+                # (matrix, row_idx) in the CURRENT rank basis (converted to
+                # dicts on actor remap, see _register_actor_names overrides)
+                arr, ridx = trans
+                trans = {self.actors[r]: int(v)
+                         for r, v in enumerate(arr[ridx]) if v}
+                t.state_clocks[(a, s)] = trans
             if trans:
                 for a2, s2 in trans.items():
                     if s2 > full.get(a2, 0):
@@ -384,6 +411,11 @@ class ResidentDocSet:
         delta = Delta()
         ready = self._admit(t, [
             _Pending(c.actor, c.seq, dict(c.deps), c) for c in changes])
+        if t.queue:
+            self._queued_docs.add(doc_idx)
+        else:
+            self._queued_docs.discard(doc_idx)
+        self._cache_dirty.add(doc_idx)
         delta.changes = [p.payload for p in ready]
         for p in ready:
             c: Change = p.payload
@@ -520,6 +552,11 @@ class ResidentDocSet:
                 _Pending(cols.actors[cols.change_actor[j]],
                          int(cols.change_seq[j]), cols.deps_at(j), (cols, j))
                 for j in range(cols.n_changes)])
+            if t.queue:
+                self._queued_docs.add(i)
+            else:
+                self._queued_docs.discard(i)
+            self._cache_dirty.add(i)
             on_admitted(i, t, ready)
             for p in ready:
                 c, j = p.payload

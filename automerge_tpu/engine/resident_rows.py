@@ -67,6 +67,14 @@ class ResidentRowsDocSet(ResidentDocSet):
         self._alloc_rows()
         self.rows_dev = None
         self._dirty = True
+        # dense admission cache (vectorized round-frame fast path): per-doc
+        # clock rows + single-head frontier summary. Rebuilt lazily from the
+        # authoritative DocTables dicts for docs in _cache_dirty.
+        self._clock_cache: np.ndarray | None = None
+        self._fsize = None
+        self._hrank = None
+        self._hseq = None
+        self._cache_dirty = set(range(len(self.doc_ids)))
 
     # ------------------------------------------------------------------
     # row layout
@@ -146,6 +154,19 @@ class ResidentRowsDocSet(ResidentDocSet):
         new = set(new) - set(self.actors)
         if not new:
             return
+        # dense clock memos/caches are in the OLD rank basis: materialize
+        # memos to actor-name dicts now, rebuild caches lazily
+        old_actor_list = list(self.actors)
+        for t in self.tables:
+            for key, trans in t.state_clocks.items():
+                if trans is not None and not isinstance(trans, dict):
+                    arr, ridx = trans
+                    t.state_clocks[key] = {
+                        old_actor_list[r]: int(v)
+                        for r, v in enumerate(arr[ridx])
+                        if v and r < len(old_actor_list)}
+        self._clock_cache = None
+        self._cache_dirty = set(range(len(self.doc_ids)))
         old_actors = list(self.actors)
         self.actors = sorted(set(self.actors) | new)
         self.actor_rank = {a: i for i, a in enumerate(self.actors)}
@@ -586,6 +607,351 @@ class ResidentRowsDocSet(ResidentDocSet):
         self.rows_host[trips[:, 0], trips[:, 1]] = trips[:, 2]
         return trips
 
+    # ------------------------------------------------------------------
+    # round-frame ingress: the streaming sync service's hot path
+
+    def apply_round_frames(self, frames, interpret: bool | None = None):
+        """Apply a micro-batch of sync rounds shipped as ROUND FRAMES
+        (sync/frames.py AMR1: one columnar frame per round covering every
+        document touched that round) in ONE asynchronous device dispatch.
+
+        Unlike apply_rounds, this does NOT read hashes back: it returns the
+        device array handle of the post-batch per-doc hashes (padded to
+        n_pad; slice [:len(doc_ids)] after np.asarray). A streaming service
+        advertises clocks from host state and only needs hashes when a
+        convergence check runs — reading them is the caller's explicit
+        barrier. Consecutive calls chain device-side (the rows buffer is
+        donated), so ingress pipelines: host encode of batch k+1 overlaps
+        device work of batch k, and the tunnel's fixed per-transfer latency
+        leaves the critical path entirely.
+
+        frames: list of round-frame bytes (or decoded RoundColumns).
+        Documents must already exist in this set.
+        """
+        from ..sync.frames import RoundColumns, decode_round_frame
+
+        rounds = [f if isinstance(f, RoundColumns) else decode_round_frame(f)
+                  for f in frames]
+        if self._native is None:
+            # Python-encoder fallback: same semantics, per-doc Change path.
+            h = self.apply_rounds([rc.to_dict() for rc in rounds], interpret)
+            import jax.numpy as _jnp
+            return _jnp.asarray(h[-1] if len(h) else
+                                self.hashes(interpret=interpret))
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        # Nothing on this path creates reference cycles, but its allocation
+        # bursts (admitted refs, delta rows) trigger generational GC scans
+        # over the whole service heap — measured at ~2/3 of the ingress cost
+        # on a 2K-doc node (same pathology core/bulkload.py documents).
+        import gc
+        was_enabled = gc.isenabled()
+        if was_enabled:
+            gc.disable()
+        try:
+            for rc in rounds:
+                self._register_round_actors(rc)
+            self._precheck_round_frames(rounds)
+            encoded = [self._encode_round_frame(rc) for rc in rounds]
+            self._grow_for_rounds(encoded)
+            pre_rows = self.rows_host.copy() \
+                if self._dirty or self.rows_dev is None else None
+            trip_list = [self._cols_triplets(e) for e in encoded]
+            return self._dispatch_final(trip_list, pre_rows, interpret)
+        finally:
+            if was_enabled:
+                gc.enable()
+
+    def _register_round_actors(self, rc) -> None:
+        cols = rc.cols
+        idx = set(np.asarray(cols.change_actor).tolist())
+        self._register_actor_names({cols.actors[i] for i in idx})
+
+    def _precheck_round_frames(self, rounds) -> None:
+        """Vectorized VMEM-budget precheck for round frames (the analog of
+        _precheck_rows_budget_cols, one numpy pass per round instead of
+        per-change slicing)."""
+        from ..storage import _ACTION_IDX
+        ins_idx = _ACTION_IDX["ins"]
+        l1, l2 = _ACTION_IDX["makeList"], _ACTION_IDX["makeText"]
+
+        need_ops = self.op_count.copy()
+        n_elems = np.zeros(self.cap_docs, np.int64)
+        n_lists = np.zeros(self.cap_docs, np.int64)
+        for i in list(getattr(self, "_queued_docs", ())):
+            t = self.tables[i]
+            for p in t.queue:
+                cols, j = p.payload
+                o0, o1 = int(cols.op_off[j]), int(cols.op_off[j + 1])
+                need_ops[i] += o1 - o0
+                acts = np.asarray(cols.op_action[o0:o1])
+                n_elems[i] += int((acts == ins_idx).sum())
+                n_lists[i] += int(((acts == l1) | (acts == l2)).sum())
+        for rc in rounds:
+            cols = rc.cols
+            doc_idx = np.fromiter((self.doc_index[d] for d in rc.doc_ids),
+                                  np.int64, len(rc.doc_ids))
+            off = np.asarray(rc.change_off, np.int64)
+            op_off = np.asarray(cols.op_off, np.int64)
+            ops_per_doc = op_off[off[1:]] - op_off[off[:-1]]
+            np.add.at(need_ops, doc_idx, ops_per_doc)
+            acts = np.asarray(cols.op_action)
+            if (acts == ins_idx).any() or (acts == l1).any() \
+                    or (acts == l2).any():
+                op_doc = np.repeat(doc_idx, ops_per_doc)
+                np.add.at(n_elems, op_doc, acts == ins_idx)
+                np.add.at(n_lists, op_doc, (acts == l1) | (acts == l2))
+
+        cap_ops = max(self.cap_ops, _pad_to(int(need_ops.max(initial=1))))
+        cur_elems = max((t.max_elems for t in self.tables), default=0)
+        cap_elems = max(self.cap_elems,
+                        _pad_to(cur_elems + int(n_elems.max(initial=0))))
+        cur_lists = max((t.n_lists for t in self.tables), default=0)
+        cap_lists = max(self.cap_lists,
+                        _pad_to(cur_lists + int(n_lists.max(initial=0)), 1))
+        from .pack import rows_dims_eligible
+        if not rows_dims_eligible(cap_ops, self.cap_actors,
+                                  cap_lists * cap_elems):
+            raise RuntimeError(
+                f"this batch could grow the resident rows state past the "
+                f"megakernel VMEM budget (ops<={cap_ops}, "
+                f"actors={self.cap_actors}, elem slots<="
+                f"{cap_lists * cap_elems}); shard this DocSet across more "
+                f"rows instances or use the docs-major ResidentDocSet")
+
+    def _refresh_admission_cache(self) -> None:
+        """Rebuild the dense clock/frontier cache rows for stale docs. The
+        DocTables dicts stay authoritative; the cache exists so a round's
+        admission checks run as a handful of numpy gathers."""
+        D, A = self.cap_docs, self.cap_actors
+        if self._clock_cache is None \
+                or self._clock_cache.shape != (D, A):
+            self._clock_cache = np.zeros((D, A), np.int64)
+            self._fsize = np.zeros(D, np.int64)
+            self._hrank = np.full(D, -1, np.int64)
+            self._hseq = np.zeros(D, np.int64)
+            dirty = range(len(self.doc_ids))
+        elif self._cache_dirty:
+            dirty = self._cache_dirty
+        else:
+            return
+        rank_of = self.actor_rank
+        cc, fs, hr, hs = (self._clock_cache, self._fsize,
+                          self._hrank, self._hseq)
+        for i in dirty:
+            t = self.tables[i]
+            row = cc[i]
+            row[:] = 0
+            for a, s in t.clock.items():
+                row[rank_of[a]] = s
+            f = t.frontier
+            fs[i] = len(f)
+            if len(f) == 1:
+                (a, s), = f.items()
+                hr[i] = rank_of[a]
+                hs[i] = s
+        self._cache_dirty = set()
+
+    def _encode_round_frame(self, rc):
+        """Admission + clock rows for one round frame, then ONE batched
+        native encode over the shared embedded AMW1 frame.
+
+        The hot case — in-order delivery of one change per doc whose
+        declared deps cover the doc's dependency frontier — is classified
+        VECTORIZED against the dense clock/frontier cache: its transitive
+        clock IS the doc's current clock (one gather for the whole round),
+        no closure walk, no _Pending allocation, no per-change deps dict.
+        Anything else (gaps, dups, queued docs, multi-change docs, partial
+        frontiers) falls back per-doc to the general _admit / _clock_row
+        machinery, unchanged."""
+        from ..native.delta import frame_bytes_of
+        from .resident import AdmittedRef, _Pending
+
+        cols = rc.cols
+        n_ch = cols.n_changes
+        if n_ch == 0:
+            return None
+        self._refresh_admission_cache()
+        actors = cols.actors
+        rank_of = self.actor_rank
+
+        n_k = len(rc.doc_ids)
+        doc_of_k = np.fromiter((self.doc_index[d] for d in rc.doc_ids),
+                               np.int64, n_k)
+        ch_off = np.asarray(rc.change_off, np.int64)
+        ch_per_k = np.diff(ch_off)
+        chg_doc = np.repeat(doc_of_k, ch_per_k)
+        chg_k = np.repeat(np.arange(n_k), ch_per_k)
+        # The frame's actor table may intern actors that only appear in
+        # deps and have no registered rank yet (their changes haven't
+        # arrived). -1 marks them; any dep on an unknown actor is
+        # unsatisfied, which routes the change to the slow path to queue.
+        perm = np.fromiter((rank_of.get(a, -1) for a in actors),
+                           np.int64, len(actors))
+        arank = perm[np.asarray(cols.change_actor, np.int64)]
+        seq = np.asarray(cols.change_seq, np.int64)
+
+        cc, fs_, hr_, hs_ = (self._clock_cache, self._fsize,
+                             self._hrank, self._hseq)
+        # in-order next change per actor
+        ok = seq == cc[chg_doc, arank] + 1
+        # every declared dep satisfied; frontier head covered by a dep
+        deps_off = np.asarray(cols.deps_off, np.int64)
+        dep_cnt = np.diff(deps_off)
+        cov = np.zeros(n_ch, np.int64)
+        if dep_cnt.any():
+            dep_chg = np.repeat(np.arange(n_ch), dep_cnt)
+            dep_doc = chg_doc[dep_chg]
+            dep_rank = perm[np.asarray(cols.deps_actor, np.int64)]
+            dep_seq = np.asarray(cols.deps_seq, np.int64)
+            safe_rank = np.maximum(dep_rank, 0)
+            bad = np.zeros(n_ch, np.int64)
+            np.add.at(bad, dep_chg,
+                      (dep_rank < 0) | (cc[dep_doc, safe_rank] < dep_seq))
+            ok &= bad == 0
+            np.add.at(cov, dep_chg,
+                      (dep_rank == hr_[dep_doc]) & (dep_seq >= hs_[dep_doc]))
+        own = (arank == hr_[chg_doc]) & (seq - 1 >= hs_[chg_doc])
+        fsz = fs_[chg_doc]
+        ok &= (fsz == 0) | ((fsz == 1) & ((cov > 0) | own))
+        if self._queued_docs:
+            qflag = np.zeros(self.cap_docs, bool)
+            qflag[np.fromiter(self._queued_docs, np.int64,
+                              len(self._queued_docs))] = True
+            ok &= ~qflag[chg_doc]
+        # multi-change docs would need sequential cache updates: slow path
+        ok &= np.repeat(ch_per_k == 1, ch_per_k)
+        k_bad = np.zeros(n_k, np.int64)
+        np.add.at(k_bad, chg_k, ~ok)
+
+        order = sorted(range(n_k), key=lambda k: doc_of_k[k])
+        # fast docs: exactly one change this round and it passed every
+        # check (empty docs are no-ops; multi-change docs went slow above)
+        fast_in_order = [k for k in order
+                        if ch_per_k[k] == 1 and not k_bad[k]]
+        fast_js = ch_off[fast_in_order]
+        fast_docs = doc_of_k[fast_in_order]
+        # clock rows = clock BEFORE each fast change (doc-disjoint, so one
+        # gather), then one batched cache update
+        cmat_fast = cc[fast_docs]
+        cc[fast_docs, arank[fast_js]] = seq[fast_js]
+        fs_[fast_docs] = 1
+        hr_[fast_docs] = arank[fast_js]
+        hs_[fast_docs] = seq[fast_js]
+
+        frames: list[bytes] = [cols.frame_bytes]
+        frame_of: dict[int, int] = {id(cols): 0}
+        adm_frame: list[int] = []
+        adm_idx: list[int] = []
+        adm_doc: list[int] = []
+        aranks: list[int] = []
+        seqs: list[int] = []
+        cidxs: list[int] = []
+        clock_rows: list[np.ndarray] = []
+
+        queued = self._queued_docs
+        change_actor = cols.change_actor
+        fast_pos = 0
+        for k in order:
+            if not ch_per_k[k]:
+                continue
+            i = int(doc_of_k[k])
+            t = self.tables[i]
+            log = self.change_log[i]
+            if ch_per_k[k] == 1 and not k_bad[k]:
+                j = int(ch_off[k])
+                actor = actors[int(change_actor[j])]
+                s = int(seq[j])
+                t.state_clocks[(actor, s)] = (cmat_fast, fast_pos)
+                t.clock[actor] = s
+                t.seen.add((actor, s))
+                t.frontier = {actor: s}
+                clock_rows.append(cmat_fast[fast_pos])
+                log.append(AdmittedRef(cols, j))
+                adm_frame.append(0)
+                adm_idx.append(j)
+                adm_doc.append(i)
+                aranks.append(int(arank[j]))
+                seqs.append(s)
+                cidxs.append(t.n_changes)
+                t.n_changes += 1
+                fast_pos += 1
+                continue
+            # slow path: full causal admission, change by change (may also
+            # release changes queued earlier, possibly from OTHER frames)
+            for j in range(int(ch_off[k]), int(ch_off[k + 1])):
+                actor = actors[int(change_actor[j])]
+                s = int(seq[j])
+                ready = self._admit(t, [_Pending(actor, s,
+                                                 cols.deps_at(j), (cols, j))])
+                if t.queue:
+                    queued.add(i)
+                else:
+                    queued.discard(i)
+                for p in ready:
+                    pc, pj = p.payload
+                    if id(pc) not in frame_of:
+                        frame_of[id(pc)] = len(frames)
+                        frames.append(frame_bytes_of(pc))
+                    clock_rows.append(
+                        self._clock_row(t, p.actor, p.seq, p.deps))
+                    log.append(AdmittedRef(pc, pj))
+                    adm_frame.append(frame_of[id(pc)])
+                    adm_idx.append(pj)
+                    adm_doc.append(i)
+                    aranks.append(rank_of[p.actor])
+                    seqs.append(p.seq)
+                    cidxs.append(t.n_changes)
+                    t.n_changes += 1
+            self._cache_dirty.add(i)
+        if not adm_doc:
+            return None
+
+        self._native.ensure_docs(len(self.doc_ids))
+        self._native.begin()
+        self._native.apply_frames(frames, adm_frame, adm_idx, adm_doc,
+                                  aranks, seqs, cidxs)
+        bd = self._native.finish()
+        for i2 in np.unique(adm_doc):
+            if i2 < len(bd.stats):
+                t2 = self.tables[i2]
+                t2.n_lists = int(bd.stats[i2, 0])
+                t2.max_elems = int(bd.stats[i2, 1])
+        return {
+            "bd": bd,
+            "clock_mat": np.stack(clock_rows),
+            "adm_doc": np.asarray(adm_doc, np.int64),
+            "adm_cidx": np.asarray(cidxs, np.int64),
+        }
+
+    def _dispatch_final(self, trip_list, pre_rows, interpret):
+        """One scatter + one reconcile for the whole micro-batch: round
+        triplets are merged in order with last-wins dedup (rounds only
+        overwrite each other on re-linearized position rows), so the scan
+        over rounds collapses into a single gather-free scatter. Returns
+        the device hash array without reading it back."""
+        parts = [t for t in trip_list if len(t)]
+        if parts:
+            trips = np.concatenate(parts)
+            key = trips[:, 0].astype(np.int64) * self.n_pad + trips[:, 1]
+            # np.unique keeps the FIRST occurrence per key of the reversed
+            # array == the LAST write in round order
+            _, first = np.unique(key[::-1], return_index=True)
+            trips = trips[len(trips) - 1 - first]
+        else:
+            trips = np.zeros((0, 3), np.int32)
+        p = _pad_to(max(len(trips), 1), 8)
+        oob = self._bases()["rows"]
+        padded = np.zeros((p, 3), dtype=np.int32)
+        padded[:len(trips)] = trips
+        padded[len(trips):, 0] = oob
+        if pre_rows is not None:
+            self.rows_dev = jnp.asarray(pre_rows)
+            self._dirty = False
+        self.rows_dev, h = _apply_final(
+            self.rows_dev, jnp.asarray(padded), self.dims(), interpret)
+        return h
+
     def hashes(self, interpret: bool | None = None) -> np.ndarray:
         """Current per-doc state hashes from resident state."""
         if interpret is None:
@@ -613,6 +979,17 @@ class ResidentRowsDocSet(ResidentDocSet):
                                    incremental=False)
         from .batchdoc import oracle_state
         return oracle_state(doc)
+
+
+@partial(jax.jit, static_argnames=("dims", "interpret"),
+         donate_argnums=(0,))
+def _apply_final(rows, trips, dims, interpret):
+    """Merged-batch apply: one ordered-dedup scatter, one reconcile+hash.
+    Async by design — the caller decides when (and whether) to read the
+    hashes back."""
+    rows = rows.at[trips[:, 0], trips[:, 1]].set(trips[:, 2], mode="drop")
+    h = reconcile_rows_hash.__wrapped__(rows, dims, interpret)
+    return rows, h
 
 
 @partial(jax.jit, static_argnames=("dims", "interpret"),

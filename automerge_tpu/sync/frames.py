@@ -154,3 +154,70 @@ def encode_frame(changes: list[Change]) -> bytes:
 
 def decode_frame(data: bytes) -> WireColumns:
     return bytes_to_columns(data)
+
+
+# ---------------------------------------------------------------------------
+# round frames: one frame per sync round, covering MANY documents
+
+ROUND_MAGIC = b"AMR1"
+
+
+class RoundColumns:
+    """A decoded round frame: one WireColumns holding every change of the
+    round, plus the doc table mapping contiguous change ranges to doc ids.
+    `cols.frame_bytes` is the embedded AMW1 frame — the native delta
+    encoder's direct input, shared by all documents of the round."""
+
+    __slots__ = ("doc_ids", "change_off", "cols")
+
+    def __init__(self, doc_ids: list[str], change_off: np.ndarray,
+                 cols: WireColumns):
+        self.doc_ids = doc_ids
+        self.change_off = change_off
+        self.cols = cols
+
+    def changes_of(self, k: int) -> list[Change]:
+        return [self.cols.change_at(j)
+                for j in range(int(self.change_off[k]),
+                               int(self.change_off[k + 1]))]
+
+    def to_dict(self) -> dict[str, list[Change]]:
+        return {d: self.changes_of(k) for k, d in enumerate(self.doc_ids)}
+
+
+def encode_round_frame(deltas: dict[str, list[Change]]) -> bytes:
+    """Serialize one sync round — {doc_id: [Change]} — as a single frame.
+    This is the natural wire for a DocSet sync service: the per-op JSON the
+    reference ships per document (README.md:349-360) becomes ONE columnar
+    batch for the whole round, so the receiver decodes O(1) frames per
+    round instead of O(docs)."""
+    doc_ids = list(deltas)
+    all_changes: list[Change] = []
+    off = np.zeros(len(doc_ids) + 1, np.int32)
+    for k, d in enumerate(doc_ids):
+        chs = deltas[d]
+        if not isinstance(chs, list):
+            chs = chs.to_changes()  # relaying decoded per-doc columns
+        all_changes.extend(chs)
+        off[k + 1] = len(all_changes)
+    inner = columns_to_bytes(changes_to_columns(all_changes))
+    id_off, id_blob = _blob(doc_ids)
+    return b"".join([ROUND_MAGIC, struct.pack("<I", len(doc_ids)),
+                     off.tobytes(), id_off.tobytes(), id_blob, inner])
+
+
+def decode_round_frame(data: bytes) -> RoundColumns:
+    if data[:4] != ROUND_MAGIC:
+        raise ValueError("not a round frame (bad magic)")
+    n_docs = struct.unpack_from("<I", data, 4)[0]
+    pos = 8
+    change_off = np.frombuffer(data, np.int32, n_docs + 1, pos)
+    pos += (n_docs + 1) * 4
+    id_off = np.frombuffer(data, np.int32, n_docs + 1, pos)
+    pos += (n_docs + 1) * 4
+    blob_len = int(id_off[-1]) if n_docs else 0
+    blob = data[pos:pos + blob_len]
+    pos += blob_len
+    doc_ids = [blob[id_off[i]:id_off[i + 1]].decode("utf-8", "surrogatepass")
+               for i in range(n_docs)]
+    return RoundColumns(doc_ids, change_off, bytes_to_columns(data[pos:]))

@@ -324,6 +324,240 @@ class TestResidentRows:
         np.testing.assert_array_equal(hs[-1], self._from_scratch_hashes(full))
 
 
+class TestRoundFrames:
+    """apply_round_frames: the AMR1 multi-doc-frame ingress with fast-path
+    causal admission and merged async dispatch. Every scenario is checked
+    for final-hash parity against the established apply_rounds path on an
+    identical twin DocSet (and transitively against from-scratch encode,
+    which apply_rounds' tests pin)."""
+
+    native = None
+
+    def _mk_set(self, ids):
+        from automerge_tpu.engine.resident_rows import ResidentRowsDocSet
+        return ResidentRowsDocSet(ids, native=self.native)
+
+    def _mk_docs(self, n=4):
+        return TestResidentRows._mk_docs(self, n)
+
+    def _twin_check(self, ids, logs, rounds):
+        """Run `rounds` through apply_round_frames on one set and through
+        apply_rounds on a twin; final hashes must match."""
+        from automerge_tpu.sync.frames import encode_round_frame
+        a = self._mk_set(ids)
+        b = self._mk_set(ids)
+        boot = [{ids[i]: logs[i] for i in range(len(ids))}]
+        a.apply_rounds(boot)
+        b.apply_rounds(boot)
+        frames = [encode_round_frame(r) for r in rounds]
+        h = np.asarray(a.apply_round_frames(frames))[:len(ids)]
+        hs = b.apply_rounds(rounds)
+        np.testing.assert_array_equal(h, hs[-1])
+        # host bookkeeping converged identically too
+        for ta, tb in zip(a.tables, b.tables):
+            assert ta.clock == tb.clock
+            assert ta.n_changes == tb.n_changes
+        return a
+
+    def _deltas(self, docs, ids, edits):
+        """edits: list of (doc_idx, fn) applied in order; returns one round
+        dict of per-doc deltas."""
+        deltas = {}
+        for i, fn in edits:
+            prev = docs[i]
+            new = am.change(prev, fn)
+            deltas.setdefault(ids[i], []).extend(
+                new._doc.opset.get_missing_changes(prev._doc.opset.clock))
+            docs[i] = new
+        return deltas
+
+    def test_in_order_rounds_match_apply_rounds(self):
+        docs, logs = self._mk_docs(4)
+        ids = [f"d{i}" for i in range(4)]
+        rounds = []
+        for rnd in range(3):
+            rounds.append(self._deltas(
+                docs, ids,
+                [(i, lambda d, rnd=rnd, i=i: d.__setitem__(
+                    "n", rnd * 100 + i)) for i in (0, 2, 3)]))
+        self._twin_check(ids, logs, rounds)
+
+    def test_out_of_order_rounds_buffer_and_release(self):
+        docs, logs = self._mk_docs(1)
+        ids = ["d0"]
+        prev = docs[0]
+        s1 = am.change(prev, lambda d: d.__setitem__("a", 1))
+        s2 = am.change(s1, lambda d: d.__setitem__("a", 2))
+        c1 = s1._doc.opset.get_missing_changes(prev._doc.opset.clock)
+        c2 = s2._doc.opset.get_missing_changes(s1._doc.opset.clock)
+        # later change first: queues in round 1, released by round 2
+        self._twin_check(ids, logs, [{ids[0]: c2}, {ids[0]: c1}])
+
+    def test_queued_release_across_frames(self):
+        """A change queued by an earlier apply_round_frames call is released
+        by a later one — the released payload lives in a DIFFERENT frame
+        than the releasing round's."""
+        from automerge_tpu.sync.frames import encode_round_frame
+        docs, logs = self._mk_docs(1)
+        ids = ["d0"]
+        a = self._mk_set(ids)
+        b = self._mk_set(ids)
+        boot = [{ids[0]: logs[0]}]
+        a.apply_rounds(boot)
+        b.apply_rounds(boot)
+        prev = docs[0]
+        s1 = am.change(prev, lambda d: d.__setitem__("x", 1))
+        s2 = am.change(s1, lambda d: d.__setitem__("x", 2))
+        c1 = s1._doc.opset.get_missing_changes(prev._doc.opset.clock)
+        c2 = s2._doc.opset.get_missing_changes(s1._doc.opset.clock)
+        a.apply_round_frames([encode_round_frame({ids[0]: c2})])
+        assert a._queued_docs == {0}
+        h = np.asarray(a.apply_round_frames(
+            [encode_round_frame({ids[0]: c1})]))[:1]
+        assert a._queued_docs == set()
+        hs = b.apply_rounds([{ids[0]: c2}, {ids[0]: c1}])
+        np.testing.assert_array_equal(h, hs[-1])
+
+    def test_unknown_dep_actor_queues_instead_of_crashing(self):
+        """A round frame can carry a change whose declared dep names an
+        actor the DocSet has never seen (its changes not yet delivered):
+        it must queue, not crash, and release when the dep arrives."""
+        from automerge_tpu.sync.frames import encode_round_frame
+        docs, logs = self._mk_docs(1)
+        ids = ["d0"]
+        a = self._mk_set(ids)
+        b = self._mk_set(ids)
+        boot = [{ids[0]: logs[0]}]
+        a.apply_rounds(boot)
+        b.apply_rounds(boot)
+        prev = docs[0]
+        # actor Y edits, then actor Z edits on top: Z's change deps on Y
+        y = am.change(am.merge(am.init("Y"), prev),
+                      lambda d: d.__setitem__("w", 1))
+        z = am.change(am.merge(am.init("Z"), y),
+                      lambda d: d.__setitem__("w", 2))
+        cy = y._doc.opset.get_missing_changes(prev._doc.opset.clock)
+        cz = z._doc.opset.get_missing_changes(y._doc.opset.clock)
+        a.apply_round_frames([encode_round_frame({ids[0]: cz})])  # queues
+        assert a._queued_docs == {0}
+        h = np.asarray(a.apply_round_frames(
+            [encode_round_frame({ids[0]: cy})]))[:1]
+        assert a._queued_docs == set()
+        hs = b.apply_rounds([{ids[0]: cz}, {ids[0]: cy}])
+        np.testing.assert_array_equal(h, hs[-1])
+
+    def test_empty_doc_entry_is_a_noop(self):
+        """A doc mapped to an empty change list in a round frame must not
+        perturb that doc (or steal a neighbour's change)."""
+        from automerge_tpu.sync.frames import encode_round_frame
+        docs, logs = self._mk_docs(2)
+        ids = ["d0", "d1"]
+        a = self._mk_set(ids)
+        b = self._mk_set(ids)
+        boot = [{ids[i]: logs[i] for i in range(2)}]
+        a.apply_rounds(boot)
+        b.apply_rounds(boot)
+        clock_before = dict(a.tables[0].clock)
+        nc_before = a.tables[0].n_changes
+        prev = docs[1]
+        new = am.change(prev, lambda d: d.__setitem__("n", 123))
+        c1 = new._doc.opset.get_missing_changes(prev._doc.opset.clock)
+        h = np.asarray(a.apply_round_frames(
+            [encode_round_frame({ids[0]: [], ids[1]: c1})]))[:2]
+        assert a.tables[0].clock == clock_before
+        assert a.tables[0].n_changes == nc_before
+        hs = b.apply_rounds([{ids[1]: c1}])
+        np.testing.assert_array_equal(h, hs[-1])
+        # empty doc LAST in the frame (the index-past-the-end variant)
+        prev2 = new
+        new2 = am.change(prev2, lambda d: d.__setitem__("n", 456))
+        c2 = new2._doc.opset.get_missing_changes(prev2._doc.opset.clock)
+        h = np.asarray(a.apply_round_frames(
+            [encode_round_frame({ids[1]: c2, ids[0]: []})]))[:2]
+        hs = b.apply_rounds([{ids[1]: c2}])
+        np.testing.assert_array_equal(h, hs[-1])
+
+    def test_duplicate_delivery_is_idempotent(self):
+        docs, logs = self._mk_docs(1)
+        ids = ["d0"]
+        prev = docs[0]
+        new = am.change(prev, lambda d: d.__setitem__("z", 9))
+        c = new._doc.opset.get_missing_changes(prev._doc.opset.clock)
+        docs[0] = new
+        self._twin_check(ids, logs, [{ids[0]: c}, {ids[0]: c}])
+
+    def test_new_actor_in_round_frame(self):
+        docs, logs = self._mk_docs(2)
+        ids = ["d0", "d1"]
+        prev = docs[0]
+        other = am.merge(am.init("AA"), prev)  # rank shifts: A < AA < B
+        other = am.change(other, lambda d: d.__setitem__("n", 777))
+        merged = am.merge(prev, other)
+        delta = merged._doc.opset.get_missing_changes(prev._doc.opset.clock)
+        docs[0] = merged
+        self._twin_check(ids, logs, [{ids[0]: delta}])
+
+    def test_concurrent_heads_fall_back_to_slow_path(self):
+        """Two concurrent changes then a merge change whose deps only
+        partially cover the frontier at admission time: exercises the
+        closure walk (fast path must not claim the full clock)."""
+        docs, logs = self._mk_docs(1)
+        ids = ["d0"]
+        prev = docs[0]
+        x = am.change(am.merge(am.init("X"), prev),
+                      lambda d: d.__setitem__("n", 1))
+        y = am.change(am.merge(am.init("Y"), prev),
+                      lambda d: d.__setitem__("n", 2))
+        m = am.merge(x, y)
+        m = am.change(m, lambda d: d.__setitem__("n", 3))
+        delta = m._doc.opset.get_missing_changes(prev._doc.opset.clock)
+        docs[0] = m
+        self._twin_check(ids, logs, [{ids[0]: delta}])
+
+    def test_list_edits_relinearize(self):
+        docs, logs = self._mk_docs(1)
+        ids = ["d0"]
+        rounds = []
+        for rnd in range(3):
+            rounds.append(self._deltas(
+                docs, ids,
+                [(0, lambda d, rnd=rnd: d["xs"].insert_at(0, rnd * 10))]))
+        self._twin_check(ids, logs, rounds)
+
+    def test_round_frame_wire_roundtrip(self):
+        from automerge_tpu.sync.frames import (decode_round_frame,
+                                               encode_round_frame)
+        docs, logs = self._mk_docs(2)
+        deltas = {"a": logs[0], "b": logs[1]}
+        rc = decode_round_frame(encode_round_frame(deltas))
+        assert rc.doc_ids == ["a", "b"]
+        out = rc.to_dict()
+        for k in deltas:
+            assert [c.to_dict() for c in out[k]] \
+                == [c.to_dict() for c in deltas[k]]
+
+    def test_oracle_state_parity_after_round_frames(self):
+        from automerge_tpu.engine.batchdoc import oracle_state
+        from automerge_tpu.frontend.materialize import apply_changes_to_doc
+        docs, logs = self._mk_docs(2)
+        ids = ["d0", "d1"]
+        rounds = [self._deltas(docs, ids, [
+            (0, lambda d: d.__setitem__("n", 41)),
+            (1, lambda d: d["xs"].insert_at(0, 5))])]
+        a = self._twin_check(ids, logs, rounds)
+        for i in range(2):
+            full = docs[i]._doc.opset.get_missing_changes({})
+            doc = apply_changes_to_doc(am.init("o"), am.init("o")._doc.opset,
+                                       full, incremental=False)
+            assert a.materialize(ids[i]) == oracle_state(doc)
+
+
+class TestRoundFramesPython(TestRoundFrames):
+    """Round-frame ingress again on the Python-encoder fallback."""
+
+    native = False
+
+
 class TestResidentRowsPython(TestResidentRows):
     """Every rows test again on the pure-Python encoder fallback (the path
     taken when the native toolchain is unavailable)."""
