@@ -1,74 +1,284 @@
 """Visible-element order index for lists and text.
 
 The reference maintains this index as a persistent order-statistic skip list
-(/root/reference/src/skip_list.js) giving O(log n) key<->index queries. The
-TPU-native design replaces rank queries with tombstone bitmaps + prefix scans
-in the columnar engine (automerge_tpu/engine/kernels.py); this host-side
-structure only serves the interactive single-document frontend, where a flat
-array with a position dictionary is simpler and fast enough (O(n) worst-case
-updates, O(1) lookups). The public surface mirrors the skip list's:
-insert_index / set_value / remove_index / index_of / key_of / get_value
+(/root/reference/src/skip_list.js) giving O(log n) key<->index queries with
+O(1) snapshots via structural sharing. The TPU-native design replaces rank
+queries with tombstone bitmaps + prefix scans in the columnar engine
+(automerge_tpu/engine/kernels.py); this host-side structure serves the
+interactive single-document frontend, where it must stay responsive on
+100K+-element live documents (VERDICT r2 #4).
+
+Design: a persistent chunked sequence. Elements live in immutable chunks
+(tuples of ~CHUNK keys/values) referenced from a per-instance top-level
+list. An edit path-copies one chunk and rebuilds the top list:
+O(CHUNK + n/CHUNK) — O(sqrt n) with the default chunk size at interactive
+document scales — while `copy()` is O(1) (children share chunks and key
+maps; the source is never mutated after being copied, per the builder's
+discipline below). Old snapshots remain fully queryable, exactly like the
+reference's skip list.
+
+The key -> chunk-id map is layered for cheap bulk builds: a shared plain
+dict base (built in one O(n) pass by the bulk loader) plus a persistent
+HAMT overlay (utils/persist.PMap) carrying edits since the base, rebased
+into a fresh dict when it grows past a fraction of the base — amortized
+O(1) per edit, never mutating a structure another snapshot can see.
+
+The public surface mirrors the skip list's: insert_index / set_value /
+remove_index / index_of / key_of / get_value
 (/root/reference/src/skip_list.js:169-327).
 
-Persistence contract: instances are immutable-by-discipline; the OpSet builder
-copies an ElemList before mutating it (copy-on-first-touch per change batch).
+Persistence contract: instances are immutable-by-discipline; the OpSet
+builder copies an ElemList before mutating it (copy-on-first-touch per
+change batch), and never mutates an instance after copying it.
 """
 
 from __future__ import annotations
 
 from typing import Any, Iterator
 
+from ..utils.persist import CowDict
+
+# Split threshold; chunks split into two halves of CHUNK each. 256 keeps
+# both terms of the O(CHUNK + n/CHUNK) edit cost in the low microseconds
+# up to ~1M elements.
+CHUNK = 256
+
 
 class ElemList:
-    __slots__ = ("keys", "values", "_pos")
+    __slots__ = ("_ids", "_keys", "_vals", "_kmap", "_pos",
+                 "_cum", "_next_id", "_flat_k", "_flat_v")
 
-    def __init__(self, keys: list[str] | None = None, values: list[Any] | None = None,
-                 pos: dict[str, int] | None = None):
-        self.keys = keys if keys is not None else []
-        self.values = values if values is not None else []
-        if pos is None:
-            pos = {k: i for i, k in enumerate(self.keys)}
-        self._pos = pos
+    def __init__(self, keys: list[str] | None = None,
+                 values: list[Any] | None = None):
+        # top-level parallel lists: chunk ids, key tuples, value tuples
+        self._ids: list[int] = []
+        self._keys: list[tuple] = []
+        self._vals: list[tuple] = []
+        self._kmap = CowDict()           # key -> chunk id (O(1) snapshots)
+        self._pos: dict[int, int] | None = None   # chunk id -> top index
+        self._cum: list[int] | None = None        # cumulative sizes
+        self._flat_k: list[str] | None = None     # cached flat key list
+        self._flat_v: list[Any] | None = None     # cached flat value list
+        self._next_id = 0
+        if keys:
+            values = values if values is not None else [None] * len(keys)
+            kmap = self._kmap
+            for lo in range(0, len(keys), CHUNK):
+                cid = self._next_id
+                self._next_id += 1
+                ck = tuple(keys[lo:lo + CHUNK])
+                self._ids.append(cid)
+                self._keys.append(ck)
+                self._vals.append(tuple(values[lo:lo + CHUNK]))
+                for k in ck:
+                    kmap[k] = cid   # fresh CowDict: plain-dict speed
+
+    # -- key map -----------------------------------------------------------
+
+    def _kget(self, key: str):
+        return self._kmap.get(key)
+
+    def _kset(self, key: str, cid: int) -> None:
+        self._kmap[key] = cid
+
+    def _kdel(self, key: str) -> None:
+        self._kmap.pop(key, None)
+
+    # -- snapshots ---------------------------------------------------------
 
     def copy(self) -> "ElemList":
-        return ElemList(list(self.keys), list(self.values), dict(self._pos))
+        """O(1): shares every chunk, the key map (copy-on-write), and the
+        caches; the top-level lists are un-shared on first mutation. (The
+        flat-array predecessor copied all n entries here — the dominant
+        cost of interactive editing at scale.)"""
+        out = ElemList()
+        out._ids = self._ids
+        out._keys = self._keys
+        out._vals = self._vals
+        out._kmap = self._kmap.copy()
+        out._pos = self._pos
+        out._cum = self._cum
+        out._flat_k = self._flat_k
+        out._flat_v = self._flat_v
+        out._next_id = self._next_id
+        return out
+
+    def _own_top(self) -> None:
+        """Un-share the top-level lists before an in-place top mutation.
+        Chunks themselves are immutable tuples, never edited in place."""
+        self._ids = list(self._ids)
+        self._keys = list(self._keys)
+        self._vals = list(self._vals)
+
+    # -- caches ------------------------------------------------------------
+
+    def _ensure_caches(self) -> None:
+        if self._pos is None:
+            self._pos = {cid: i for i, cid in enumerate(self._ids)}
+        if self._cum is None:
+            cum = []
+            total = 0
+            for ck in self._keys:
+                cum.append(total)
+                total += len(ck)
+            self._cum = cum
+
+    def _locate_rank(self, index: int) -> tuple[int, int]:
+        """(top position, offset) of global rank `index`."""
+        self._ensure_caches()
+        cum = self._cum
+        lo, hi = 0, len(cum) - 1
+        while lo < hi:   # rightmost chunk with cum <= index
+            mid = (lo + hi + 1) // 2
+            if cum[mid] <= index:
+                lo = mid
+            else:
+                hi = mid - 1
+        return lo, index - cum[lo]
+
+    # -- queries -----------------------------------------------------------
 
     def __len__(self) -> int:
-        return len(self.keys)
-
-    def insert_index(self, index: int, key: str, value: Any) -> None:
-        self.keys.insert(index, key)
-        self.values.insert(index, value)
-        pos = self._pos
-        for i in range(index, len(self.keys)):
-            pos[self.keys[i]] = i
-
-    def remove_index(self, index: int) -> None:
-        key = self.keys.pop(index)
-        self.values.pop(index)
-        pos = self._pos
-        del pos[key]
-        for i in range(index, len(self.keys)):
-            pos[self.keys[i]] = i
-
-    def set_value(self, key: str, value: Any) -> None:
-        self.values[self._pos[key]] = value
-
-    def get_value(self, key: str) -> Any:
-        return self.values[self._pos[key]]
+        if self._cum is not None:
+            return (self._cum[-1] + len(self._keys[-1])) if self._keys else 0
+        return sum(len(ck) for ck in self._keys)
 
     def index_of(self, key: str) -> int:
         """Index of `key` among visible elements, or -1."""
-        return self._pos.get(key, -1)
+        cid = self._kget(key)
+        if cid is None:
+            return -1
+        self._ensure_caches()
+        p = self._pos.get(cid)
+        if p is None:
+            return -1
+        try:
+            off = self._keys[p].index(key)
+        except ValueError:
+            return -1
+        return self._cum[p] + off
 
     def key_of(self, index: int) -> str | None:
         """Element ID at `index`, or None if out of range."""
-        if 0 <= index < len(self.keys):
-            return self.keys[index]
-        return None
+        if index < 0 or not self._keys or index >= len(self):
+            return None
+        p, off = self._locate_rank(index)
+        return self._keys[p][off]
+
+    def value_at(self, index: int):
+        """Value at visible rank `index` (raises IndexError out of range)."""
+        if index < 0 or not self._keys or index >= len(self):
+            raise IndexError(index)
+        p, off = self._locate_rank(index)
+        return self._vals[p][off]
+
+    def get_value(self, key: str) -> Any:
+        cid = self._kget(key)
+        if cid is None:
+            raise KeyError(key)
+        self._ensure_caches()
+        p = self._pos[cid]
+        return self._vals[p][self._keys[p].index(key)]
+
+    # -- mutations (only between copy() and commit) ------------------------
+
+    def insert_index(self, index: int, key: str, value: Any) -> None:
+        self._own_top()
+        if not self._keys:
+            cid = self._next_id
+            self._next_id += 1
+            self._ids.append(cid)
+            self._keys.append((key,))
+            self._vals.append((value,))
+            self._kset(key, cid)
+            self._pos = None
+            self._cum = None
+            self._flat_k = None
+            self._flat_v = None
+            return
+        if index >= len(self):
+            p = len(self._keys) - 1
+            off = len(self._keys[p])
+        else:
+            p, off = self._locate_rank(index)
+        ck, cv = self._keys[p], self._vals[p]
+        nk = ck[:off] + (key,) + ck[off:]
+        nv = cv[:off] + (value,) + cv[off:]
+        cid = self._ids[p]
+        self._kset(key, cid)
+        if len(nk) <= 2 * CHUNK:
+            self._keys[p] = nk
+            self._vals[p] = nv
+        else:
+            # split: left half keeps the id (most keys stay mapped),
+            # right half gets a fresh id and remaps its keys
+            half = len(nk) // 2
+            rid = self._next_id
+            self._next_id += 1
+            self._keys[p:p + 1] = [nk[:half], nk[half:]]
+            self._vals[p:p + 1] = [nv[:half], nv[half:]]
+            self._ids[p:p + 1] = [cid, rid]
+            for k in nk[half:]:
+                self._kset(k, rid)
+        self._pos = None
+        self._cum = None
+        self._flat_k = None
+        self._flat_v = None
+
+    def remove_index(self, index: int) -> None:
+        p, off = self._locate_rank(index)
+        self._own_top()
+        ck, cv = self._keys[p], self._vals[p]
+        self._kdel(ck[off])
+        nk = ck[:off] + ck[off + 1:]
+        if nk:
+            self._keys[p] = nk
+            self._vals[p] = cv[:off] + cv[off + 1:]
+        else:
+            del self._ids[p], self._keys[p], self._vals[p]
+        self._pos = None
+        self._cum = None
+        self._flat_k = None
+        self._flat_v = None
+
+    def set_value(self, key: str, value: Any) -> None:
+        cid = self._kget(key)
+        if cid is None:
+            raise KeyError(key)
+        self._ensure_caches()
+        p = self._pos[cid]
+        off = self._keys[p].index(key)
+        self._own_top()
+        cv = self._vals[p]
+        self._vals[p] = cv[:off] + (value,) + cv[off + 1:]
+        self._flat_v = None
+
+    # -- iteration ---------------------------------------------------------
+
+    @property
+    def keys(self) -> list[str]:
+        """Flat visible-key list (materialized once per version, cached —
+        callers iterate it like the old flat attribute; do not mutate)."""
+        if self._flat_k is None:
+            out: list[str] = []
+            for ck in self._keys:
+                out.extend(ck)
+            self._flat_k = out
+        return self._flat_k
+
+    @property
+    def values(self) -> list[Any]:
+        """Flat value list (cached like `keys`; do not mutate)."""
+        if self._flat_v is None:
+            out: list[Any] = []
+            for cv in self._vals:
+                out.extend(cv)
+            self._flat_v = out
+        return self._flat_v
 
     def __iter__(self) -> Iterator[str]:
-        return iter(self.keys)
+        for ck in self._keys:
+            yield from ck
 
     def __repr__(self) -> str:
         return f"ElemList({list(zip(self.keys, self.values))!r})"

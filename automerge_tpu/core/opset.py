@@ -30,7 +30,7 @@ from __future__ import annotations
 from typing import Any, Iterator
 
 from ..utils import metrics
-from ..utils.persist import AList, EMPTY_ALIST
+from ..utils.persist import AList, CowDict, EMPTY_ALIST
 from .change import Change, Op
 from .ids import HEAD, ROOT_ID, make_elem_id, parse_elem_id
 from .elems import ElemList
@@ -70,20 +70,25 @@ class ObjState:
 
     def __init__(self, init_action: str):
         self.init_action = init_action
-        self.fields: dict[str, tuple[Op, ...]] = {}
-        self.following: dict[str, tuple[Op, ...]] = {}
-        self.insertion: dict[str, Op] = {}
+        seq = init_action in ("makeList", "makeText")
+        # Sequence objects grow with document length (one fields/insertion
+        # entry per element, tombstones included); CowDict makes their
+        # per-change-batch snapshot O(1) instead of O(n) — the role
+        # Immutable.js Map plays in op_set.js:272-285. Plain maps stay
+        # dicts: small, and their key enumeration order is user-visible.
+        self.fields: dict[str, tuple[Op, ...]] = CowDict() if seq else {}
+        self.following: dict[str, tuple[Op, ...]] = CowDict() if seq else {}
+        self.insertion: dict[str, Op] = CowDict() if seq else {}
         self.inbound: dict[Op, None] = {}
         self.max_elem = 0
-        self.elem_ids: ElemList | None = (
-            ElemList() if init_action in ("makeList", "makeText") else None)
+        self.elem_ids: ElemList | None = ElemList() if seq else None
 
     def copy(self) -> "ObjState":
         out = ObjState.__new__(ObjState)
         out.init_action = self.init_action
-        out.fields = dict(self.fields)
-        out.following = dict(self.following)
-        out.insertion = dict(self.insertion)
+        out.fields = self.fields.copy()
+        out.following = self.following.copy()
+        out.insertion = self.insertion.copy()
         out.inbound = dict(self.inbound)
         out.max_elem = self.max_elem
         out.elem_ids = self.elem_ids  # copied lazily by Builder.elem_ids_mut

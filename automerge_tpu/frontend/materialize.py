@@ -40,15 +40,15 @@ def _build(state, object_id: str, cache: dict) -> Any:
     obj = state.by_object[object_id]
 
     if obj.init_action == "makeText":
-        values, elem_ids = [], []
-        raw = obj.elem_ids
-        for i, key in enumerate(raw.keys):
-            value = raw.values[i]
+        # Lazy view over the (persistent) element index: O(1) per rebuild,
+        # reads resolve on demand — the reference's Text does exactly this
+        # over its skip list (text.js:3-32, no per-char diff folding).
+        def resolve(value, _state=state, _cache=cache):
             if isinstance(value, Link):
-                value = _materialize(state, value.obj, cache)
-            values.append(value)
-            elem_ids.append(key)
-        return Text(values, elem_ids, object_id)
+                return _materialize(_state, value.obj, _cache)
+            return value
+        return Text(object_id=object_id, _elems=obj.elem_ids,
+                    _resolve=resolve)
 
     if obj.init_action == "makeList":
         values, conflicts = [], []

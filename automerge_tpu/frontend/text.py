@@ -1,9 +1,11 @@
 """Text: a character-sequence CRDT view.
 
-Mirrors /root/reference/src/text.js: a Text object is an immutable snapshot of
-a character sequence. Reads go straight to the element order index; editing
-happens through the list proxy inside a change block (insert_at / delete_at),
-exactly as the reference routes Text edits through ListHandler.
+Mirrors /root/reference/src/text.js: a Text object is an immutable snapshot
+of a character sequence whose reads go straight to the element order index —
+the snapshot is NOT materialized per change (text.js:3-32 reads the skip
+list lazily; there is no per-char diff folding). Editing happens through the
+list proxy inside a change block (insert_at / delete_at), exactly as the
+reference routes Text edits through ListHandler.
 
 A fresh `Text()` (empty) can be assigned into a document to create a text
 object; assigning a non-empty Text is not supported (parity with
@@ -18,12 +20,35 @@ from .array_ops import ArrayReadOps
 
 
 class Text(ArrayReadOps):
-    __slots__ = ("_values", "_elem_ids", "_object_id_attr")
+    __slots__ = ("_values_cache", "_elem_ids_cache", "_object_id_attr",
+                 "_elems", "_resolve")
 
-    def __init__(self, values=(), elem_ids=(), object_id: str | None = None):
-        object.__setattr__(self, "_values", tuple(values))
-        object.__setattr__(self, "_elem_ids", tuple(elem_ids))
+    def __init__(self, values=(), elem_ids=(), object_id: str | None = None,
+                 _elems=None, _resolve=None):
+        """Either an eager snapshot (values/elem_ids sequences) or — when
+        `_elems` is given — a lazy view over a persistent ElemList, with
+        `_resolve` mapping raw stored values to application values (link
+        materialization). Lazy views cost O(1) to create; a change touching
+        a 100K-char text no longer rebuilds 100K entries."""
+        if _elems is not None:
+            object.__setattr__(self, "_values_cache", None)
+            object.__setattr__(self, "_elem_ids_cache", None)
+        else:
+            object.__setattr__(self, "_values_cache", tuple(values))
+            object.__setattr__(self, "_elem_ids_cache", tuple(elem_ids))
         object.__setattr__(self, "_object_id_attr", object_id)
+        object.__setattr__(self, "_elems", _elems)
+        object.__setattr__(self, "_resolve", _resolve)
+
+    @property
+    def _values(self) -> tuple:
+        if self._values_cache is None:
+            resolve = self._resolve
+            vals = self._elems.values
+            object.__setattr__(
+                self, "_values_cache",
+                tuple(map(resolve, vals)) if resolve else tuple(vals))
+        return self._values_cache
 
     @property
     def _object_id(self) -> str | None:
@@ -31,14 +56,24 @@ class Text(ArrayReadOps):
 
     @property
     def elem_ids(self) -> tuple[str, ...]:
-        return self._elem_ids
+        if self._elem_ids_cache is None:
+            object.__setattr__(self, "_elem_ids_cache",
+                               tuple(self._elems.keys))
+        return self._elem_ids_cache
 
     def __len__(self) -> int:
-        return len(self._values)
+        if self._values_cache is None:
+            return len(self._elems)
+        return len(self._values_cache)
 
     def get(self, index: int) -> Any:
-        if 0 <= index < len(self._values):
-            return self._values[index]
+        if self._values_cache is None:
+            if 0 <= index < len(self._elems):
+                v = self._elems.value_at(index)
+                return self._resolve(v) if self._resolve else v
+            return None
+        if 0 <= index < len(self._values_cache):
+            return self._values_cache[index]
         return None
 
     def __getitem__(self, index):
