@@ -79,6 +79,11 @@ class Text(ArrayReadOps):
     def __getitem__(self, index):
         if isinstance(index, slice):
             return self._values[index]
+        # lazy per-index read (O(log n) through the chunked element index) —
+        # a caret read per keystroke must not materialize the whole text
+        if self._values_cache is None and 0 <= index < len(self._elems):
+            v = self._elems.value_at(index)
+            return self._resolve(v) if self._resolve else v
         return self._values[index]
 
     def __iter__(self) -> Iterator[Any]:

@@ -618,6 +618,15 @@ def run_resident_rounds(doc_changes, n_rounds=12, fraction=0.2):
         oracle_docs = {i: apply_changes_to_doc(
             am.init("o"), am.init("o2")._doc.opset, doc_changes[i],
             incremental=False) for i in changed}
+        # bring the oracle docs up to the timed horizon (the engine consumed
+        # the warm rounds too): without this the timed deltas are causally
+        # unready and the oracle would just queue them — timing a no-op
+        for r in rounds[:n_rounds]:
+            for i in changed:
+                doc = oracle_docs[i]
+                chs = r[doc_ids[i]]
+                oracle_docs[i] = apply_changes_to_doc(
+                    doc, doc._doc.opset, chs, incremental=True)
         json_rounds = _oracle_wire_rounds(timed_rounds)
         t0 = time.perf_counter()
         for jdeltas in json_rounds:
