@@ -61,6 +61,13 @@ def try_bulk_load(data: str, max_version: int | None = None) -> OpSet | None:
         return None  # malformed for the native parser: let json.loads decide
     if cols is None or cols.n_changes < BULK_MIN_CHANGES:
         return None
+    return try_bulk_build(cols)
+
+
+def try_bulk_build(cols) -> OpSet | None:
+    """build_opset with the GC pause and the observable-fallback contract;
+    None when the log needs the interpretive path. Shared by load() and the
+    adaptive dispatcher (engine/dispatch.py)."""
     # The build allocates hundreds of thousands of long-lived records; the
     # cyclic GC's generational scans over that growing heap cost ~35% of the
     # build at 64K changes. Nothing here creates cycles — pause it.

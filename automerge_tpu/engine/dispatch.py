@@ -1,0 +1,135 @@
+"""Adaptive backend routing: host vs device, by measured cost model.
+
+The reference has exactly one execution path (per-op interpretive JS);
+this framework has three with very different cost shapes:
+
+- host interpretive (core/opset.py): ~O(ops) with a small per-op constant —
+  no fixed costs at all;
+- host bulk build (core/bulkload.py): vectorized from-scratch state build,
+  wins over interpretive from ~BULK_MIN_CHANGES changes per doc;
+- device columnar (engine/pack.py + pallas megakernel): microseconds of
+  per-doc compute, but behind fixed per-dispatch / per-transfer / per-
+  readback costs of the host<->device link (tens of ms each on the
+  tunneled chip this repo benches on — INTERNALS.md §4).
+
+A 200-op single document therefore *belongs on the host*: no batch size of
+one can amortize a ~100ms link roundtrip against a ~1ms job. The DocSet
+batch axis is where the device path wins (128+ documents per dispatch).
+This module is the product-path router that makes that call, the moral
+equivalent of XLA's own host/device offload decisions.
+
+Cost-model constants are measured on this environment's link (see
+INTERNALS.md §4) and overridable via calibrate() for other deployments.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+# Link cost model (seconds) — tunneled TPU v5e, INTERNALS.md §4.
+_LINK = {
+    "dispatch_fixed_s": 0.025,   # per jitted dispatch (amortizable)
+    "h2d_call_s": 0.010,         # per host->device transfer call
+    "h2d_bytes_per_s": 450e6,    # below the ~24MB/call collapse point
+    "d2h_call_s": 0.070,         # per readback call
+    "host_op_s": 6e-6,           # interpretive per-op apply+materialize
+    "bulk_op_s": 1.2e-6,         # bulk-build per-op (past fixed ~1ms)
+    "bulk_fixed_s": 0.001,
+}
+
+
+def calibrate(**overrides) -> None:
+    """Override link constants (e.g. from a deployment's own probe)."""
+    for k, v in overrides.items():
+        if k not in _LINK:
+            raise KeyError(k)
+        _LINK[k] = float(v)
+
+
+@dataclass
+class Plan:
+    backend: str          # "device" | "host"
+    est_device_s: float
+    est_host_s: float
+
+
+def plan_batch(n_docs: int, n_ops: int, wire_bytes: int,
+               passes: int = 1, changes_per_doc: float | None = None) -> Plan:
+    """Choose the backend for a from-scratch batch apply of `n_docs`
+    documents totalling `n_ops` ops, shipping `wire_bytes` per pass,
+    with fixed costs amortized over `passes` identical jobs.
+
+    `changes_per_doc` prices the host side with the SAME predicate
+    apply_host executes (bulk build from BULK_MIN_CHANGES changes per
+    doc); when unknown it is estimated at n_ops/n_docs/2 (ins+set pairs)."""
+    from ..core.bulkload import BULK_MIN_CHANGES
+
+    dev = (_LINK["dispatch_fixed_s"] / passes
+           + _LINK["h2d_call_s"]
+           + wire_bytes / _LINK["h2d_bytes_per_s"]
+           + _LINK["d2h_call_s"] / passes)
+    if changes_per_doc is None:
+        changes_per_doc = n_ops / max(n_docs, 1) / 2
+    if changes_per_doc >= BULK_MIN_CHANGES:
+        host = n_docs * _LINK["bulk_fixed_s"] + n_ops * _LINK["bulk_op_s"]
+    else:
+        host = n_ops * _LINK["host_op_s"]
+    backend = "device" if dev < host else "host"
+    return Plan(backend, dev, host)
+
+
+def plan_for(doc_changes: list, passes: int = 1) -> Plan:
+    """Plan (no execution) for a concrete from-scratch batch: estimates the
+    wire from padded per-doc dims without encoding anything."""
+    from .pack import rows_count
+
+    n_ops = sum(len(c.ops) for chs in doc_changes for c in chs)
+    ops_pad = 8
+    while ops_pad < max((sum(len(c.ops) for c in chs)
+                         for chs in doc_changes), default=1):
+        ops_pad *= 2
+    actors = {c.actor for chs in doc_changes for c in chs}
+    wire_bytes = (rows_count(ops_pad, max(len(actors), 1), 8)
+                  * max(len(doc_changes), 128) * 4)
+    changes_per_doc = (sum(len(chs) for chs in doc_changes)
+                       / max(len(doc_changes), 1))
+    return plan_batch(len(doc_changes), n_ops, wire_bytes, passes,
+                      changes_per_doc=changes_per_doc)
+
+
+def apply_host(changes, actor_id: str = "engine"):
+    """Host-path from-scratch apply of one document's complete change set:
+    bulk vectorized build when the log is big enough and eligible, else
+    interpretive replay. Returns the materialized document (same contract
+    as the oracle path the bench compares against)."""
+    from ..api import init
+    from ..core.bulkload import BULK_MIN_CHANGES, try_bulk_build
+    from ..frontend.materialize import apply_changes_to_doc, materialize_root
+    from ..native.wire import changes_to_columns
+
+    if len(changes) >= BULK_MIN_CHANGES:
+        # try_bulk_build owns the fallback contract (GC pause, observable
+        # bulkload_fallback_keyerror counter); materialize errors surface
+        opset = try_bulk_build(changes_to_columns(changes))
+        if opset is not None:
+            return materialize_root(actor_id, opset)
+    doc = init(actor_id)
+    return apply_changes_to_doc(doc, doc._doc.opset, list(changes),
+                                incremental=False)
+
+
+def apply_batch_adaptive(doc_changes: list, passes: int = 1):
+    """Route a from-scratch DocSet batch through the cheaper backend.
+
+    Returns (plan, result): result is a list of materialized documents on
+    the host path, or the per-doc state-hash array on the device path
+    (the device's readable-state decode is on-demand, engine/batchdoc.py).
+    """
+    import numpy as np
+
+    plan = plan_for(doc_changes, passes)
+    if plan.backend == "host":
+        return plan, [apply_host(chs) for chs in doc_changes]
+    from .batchdoc import apply_batch
+    _encs, _batch, out = apply_batch(doc_changes)
+    return plan, np.asarray(out["hash"])

@@ -79,12 +79,13 @@ class Text(ArrayReadOps):
     def __getitem__(self, index):
         if isinstance(index, slice):
             return self._values[index]
-        # lazy per-index read (O(log n) through the chunked element index) —
+        # per-index reads (incl. negative) go through get()'s lazy path —
         # a caret read per keystroke must not materialize the whole text
-        if self._values_cache is None and 0 <= index < len(self._elems):
-            v = self._elems.value_at(index)
-            return self._resolve(v) if self._resolve else v
-        return self._values[index]
+        n = len(self)
+        i = index + n if index < 0 else index
+        if not 0 <= i < n:
+            raise IndexError("Text index out of range")
+        return self.get(i)
 
     def __iter__(self) -> Iterator[Any]:
         return iter(self._values)

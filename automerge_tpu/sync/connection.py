@@ -118,6 +118,8 @@ class Connection:
                                                   msg["clock"])
         if msg.get("frame") is not None:
             from .frames import decode_frame
+            from ..utils import metrics
+            metrics.bump("wire_frames_received")
             cols = decode_frame(msg["frame"])
             # DocSets exposing a column ingress get the decoded columns
             # as-is (the engine service's native-encoder seam); plain

@@ -1,0 +1,151 @@
+"""Worker process for the device-resident multihost test (VERDICT r2 #7):
+each of two OS processes runs an `EngineDocSet` — documents resident in the
+columnar engine, NOT host objects — syncing over TCP with BINARY columnar
+frames (`wire="columnar"`, sync/frames.py), then joins a global 8-device
+mesh (jax.distributed) for one SPMD reconcile and a cross-host clock-union
+collective. The reference analog being scaled: DocSet + Connection
+anti-entropy (src/connection.js:58-113) over a real network transport.
+
+Usage: python tests/multihost_resident_worker.py <pid> <coord_port> <sync_port>
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+pid = int(sys.argv[1])
+coord_port = sys.argv[2]
+sync_port = int(sys.argv[3])
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=4").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from automerge_tpu.parallel.multihost import (global_mesh,  # noqa: E402
+                                              init_multihost,
+                                              reconcile_global)
+
+init_multihost(f"127.0.0.1:{coord_port}", num_processes=2, process_id=pid)
+assert jax.device_count() == 8 and jax.local_device_count() == 4
+
+import automerge_tpu as am  # noqa: E402
+from automerge_tpu.core.change import Change, Op  # noqa: E402
+from automerge_tpu.core.ids import ROOT_ID  # noqa: E402
+from automerge_tpu.sync.service import EngineDocSet  # noqa: E402
+from automerge_tpu.sync.tcp import (TcpSyncClient, TcpSyncServer,  # noqa: E402
+                                    sync_lock)
+
+N = 8
+ACTOR = f"host{pid}"
+engine = EngineDocSet()
+for i in range(N):
+    if i % 2 == pid:  # each host authors half the fleet
+        d = am.change(am.init(ACTOR), lambda x, i=i: am.assign(
+            x, {"n": i, "xs": [i, i + 1], "owner": ACTOR}))
+        engine.add_doc(f"doc{i}")
+        engine.apply_changes(
+            f"doc{i}", d._doc.opset.get_missing_changes({}))
+
+# --- phase 1: DCN sync, binary columnar frames over TCP ------------------
+if pid == 0:
+    link = TcpSyncServer(engine, port=sync_port, wire="columnar").start()
+else:
+    link = None
+    for _ in range(100):
+        try:
+            link = TcpSyncClient(engine, "127.0.0.1", sync_port,
+                                 wire="columnar").start()
+            break
+        except OSError:
+            time.sleep(0.1)
+    assert link is not None, "could not reach host 0"
+
+deadline = time.time() + 60
+while time.time() < deadline:
+    if (set(engine.doc_ids) >= {f"doc{i}" for i in range(N)}
+            and all(engine.clock_of(f"doc{i}").get(f"host{i % 2}", 0) > 0
+                    for i in range(N))):
+        break
+    time.sleep(0.05)
+else:
+    raise AssertionError(f"[p{pid}] initial columnar sync did not converge: "
+                         f"{sorted(engine.doc_ids)}")
+
+# the other host's docs really arrived as binary frames, not JSON
+assert am.metrics.snapshot().get("wire_frames_received", 0) > 0, \
+    f"[p{pid}] no columnar frames received"
+
+# concurrent edits on a shared doc: both hosts write doc0.winner straight
+# into the resident engine (change assembled against the engine's clock)
+with sync_lock(engine):
+    clk = engine.clock_of("doc0")
+    ch = Change(ACTOR, clk.get(ACTOR, 0) + 1,
+                {a: s for a, s in clk.items() if a != ACTOR},
+                [Op("set", ROOT_ID, key="winner", value=ACTOR)])
+    engine.apply_changes("doc0", [ch])
+
+deadline = time.time() + 60
+while time.time() < deadline:
+    clk = engine.clock_of("doc0")
+    if all(clk.get(f"host{h}", 0) > 0 for h in (0, 1)) \
+            and sum(clk.values()) >= 3:
+        break
+    time.sleep(0.05)
+else:
+    raise AssertionError(f"[p{pid}] concurrent-edit sync did not converge: "
+                         f"{engine.clock_of('doc0')}")
+winner = engine.materialize("doc0")["data"]["winner"]
+assert winner in ("host0", "host1"), f"[p{pid}] LWW winner: {winner}"
+
+# --- phase 2: global SPMD reconcile over the joint mesh ------------------
+mesh = global_mesh()
+with sync_lock(engine):
+    doc_changes = [engine.missing_changes(f"doc{i}", {}) for i in range(N)]
+lo, hi, local_hashes = reconcile_global(doc_changes, mesh)
+
+from automerge_tpu.engine.batchdoc import apply_batch  # noqa: E402
+
+_, _, ref_out = apply_batch(doc_changes)
+ref = np.asarray(ref_out["hash"]).astype(np.uint32)
+want = ref[lo:min(hi, N)]
+assert (local_hashes[:len(want)] == want).all(), \
+    f"[p{pid}] shard hash mismatch"
+
+# the resident engine's own per-doc hashes agree with the mesh reconcile
+eng_hashes = engine.hashes()
+for i in range(N):
+    assert np.uint32(eng_hashes[f"doc{i}"]) == ref[i], \
+        f"[p{pid}] resident hash != mesh hash for doc{i}"
+
+# --- phase 3: cross-host clock-union collective --------------------------
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from automerge_tpu.parallel.collective import global_clock_union  # noqa: E402
+from automerge_tpu.parallel.mesh import DOCS_AXIS  # noqa: E402
+
+actors = sorted({c.actor for chs in doc_changes for c in chs})
+rank = {a: k for k, a in enumerate(actors)}
+clocks = np.zeros((N, len(actors)), np.int32)
+for i in range(N):
+    for a, s in engine.clock_of(f"doc{i}").items():
+        clocks[i, rank[a]] = s
+sh = NamedSharding(mesh, P(DOCS_AXIS))
+arr = jax.make_array_from_process_local_data(
+    sh, np.ascontiguousarray(clocks[lo:hi]), global_shape=clocks.shape)
+union = np.asarray(global_clock_union(arr, mesh))
+want_union = clocks.max(axis=0)
+assert (union == want_union).all(), f"[p{pid}] union {union} != {want_union}"
+assert all(union[rank[f"host{h}"]] > 0 for h in (0, 1))
+
+if link is not None:
+    link.close()
+print(f"MULTIHOST-RESIDENT-OK p{pid} union={union.tolist()}", flush=True)

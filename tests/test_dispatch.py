@@ -1,0 +1,65 @@
+"""Adaptive backend routing (engine/dispatch.py): workloads below the
+host<->device link's fixed-cost floor run on the host path; DocSet-scale
+batches go to the device. The reference has no such choice to make (one
+JS path); for this framework the router IS the product path."""
+
+import automerge_tpu as am
+from automerge_tpu.engine.dispatch import (Plan, apply_batch_adaptive,
+                                           apply_host, plan_batch)
+from automerge_tpu.frontend.materialize import apply_changes_to_doc
+
+
+def _trace_small():
+    d = am.change(am.init("A"), lambda x: am.assign(x, {"n": 1, "xs": [1, 2]}))
+    d = am.change(d, lambda x: x["xs"].insert_at(1, 9))
+    return d._doc.opset.get_missing_changes({})
+
+
+def _trace_bulk(n=200):
+    d = am.change(am.init("A"), lambda x: x.__setitem__("xs", []))
+    for i in range(n):
+        d = am.change(d, lambda x, i=i: x["xs"].insert_at(len(x["xs"]), i))
+    return d._doc.opset.get_missing_changes({})
+
+
+def test_plan_small_single_doc_routes_host():
+    p = plan_batch(n_docs=1, n_ops=200, wire_bytes=120 * 128 * 4)
+    assert p.backend == "host"
+    assert p.est_host_s < p.est_device_s
+
+
+def test_plan_docset_batch_routes_device():
+    p = plan_batch(n_docs=10_000, n_ops=80_000, wire_bytes=5_000_000,
+                   passes=10)
+    assert p.backend == "device"
+
+
+def test_apply_host_interpretive_parity():
+    changes = _trace_small()
+    got = apply_host(changes)
+    doc = am.init("oracle")
+    want = apply_changes_to_doc(doc, doc._doc.opset, changes,
+                                incremental=False)
+    assert am.equals(got, want)
+
+
+def test_apply_host_bulk_parity():
+    changes = _trace_bulk()
+    got = apply_host(changes)  # bulk build engages at this size
+    doc = am.init("oracle")
+    want = apply_changes_to_doc(doc, doc._doc.opset, changes,
+                                incremental=False)
+    assert am.equals(got, want)
+    assert am.save(got) == am.save(want)
+
+
+def test_adaptive_small_batch_returns_host_docs():
+    doc_changes = [_trace_small(), _trace_bulk(80)]
+    plan, result = apply_batch_adaptive(doc_changes)
+    assert isinstance(plan, Plan) and plan.backend == "host"
+    assert len(result) == 2
+    for chs, got in zip(doc_changes, result):
+        doc = am.init("oracle")
+        want = apply_changes_to_doc(doc, doc._doc.opset, chs,
+                                    incremental=False)
+        assert am.equals(got, want)
