@@ -80,21 +80,40 @@ def plan_batch(n_docs: int, n_ops: int, wire_bytes: int,
 
 def plan_for(doc_changes: list, passes: int = 1) -> Plan:
     """Plan (no execution) for a concrete from-scratch batch: estimates the
-    wire from padded per-doc dims without encoding anything."""
+    wire from the same padded dims pack.py will use, and prices the host
+    side per document with apply_host's actual bulk/interpretive predicate."""
+    from ..core.bulkload import BULK_MIN_CHANGES
     from .pack import rows_count
 
-    n_ops = sum(len(c.ops) for chs in doc_changes for c in chs)
-    ops_pad = 8
-    while ops_pad < max((sum(len(c.ops) for c in chs)
-                         for chs in doc_changes), default=1):
-        ops_pad *= 2
+    def _pad(n, minimum=8):
+        p = minimum
+        while p < n:
+            p *= 2
+        return p
+
+    ops_pad = _pad(max((sum(len(c.ops) for c in chs)
+                        for chs in doc_changes), default=1))
+    ins_pad = _pad(max((sum(1 for c in chs for o in c.ops
+                            if o.action == "ins") for chs in doc_changes),
+                       default=1))
     actors = {c.actor for chs in doc_changes for c in chs}
-    wire_bytes = (rows_count(ops_pad, max(len(actors), 1), 8)
-                  * max(len(doc_changes), 128) * 4)
-    changes_per_doc = (sum(len(chs) for chs in doc_changes)
-                       / max(len(doc_changes), 1))
-    return plan_batch(len(doc_changes), n_ops, wire_bytes, passes,
-                      changes_per_doc=changes_per_doc)
+    d_pad = ((len(doc_changes) + 127) // 128) * 128  # pack.py's lane pad
+    wire_bytes = (rows_count(ops_pad, max(len(actors), 1), ins_pad)
+                  * d_pad * 4)
+
+    n_ops = sum(len(c.ops) for chs in doc_changes for c in chs)
+    dev = (_LINK["dispatch_fixed_s"] / passes
+           + _LINK["h2d_call_s"]
+           + wire_bytes / _LINK["h2d_bytes_per_s"]
+           + _LINK["d2h_call_s"] / passes)
+    host = 0.0
+    for chs in doc_changes:
+        doc_ops = sum(len(c.ops) for c in chs)
+        if len(chs) >= BULK_MIN_CHANGES:  # apply_host's own predicate
+            host += _LINK["bulk_fixed_s"] + doc_ops * _LINK["bulk_op_s"]
+        else:
+            host += doc_ops * _LINK["host_op_s"]
+    return Plan("device" if dev < host else "host", dev, host)
 
 
 def apply_host(changes, actor_id: str = "engine"):

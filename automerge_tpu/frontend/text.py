@@ -78,6 +78,13 @@ class Text(ArrayReadOps):
 
     def __getitem__(self, index):
         if isinstance(index, slice):
+            # lazy windowed read: a viewport slice of a 100K-char text must
+            # not materialize all 100K entries
+            if self._values_cache is None:
+                resolve = self._resolve
+                vals = (self._elems.value_at(i)
+                        for i in range(*index.indices(len(self._elems))))
+                return tuple(map(resolve, vals)) if resolve else tuple(vals)
             return self._values[index]
         # per-index reads (incl. negative) go through get()'s lazy path —
         # a caret read per keystroke must not materialize the whole text

@@ -1,0 +1,66 @@
+"""The baseline-calibration cost model (refmodel.py) must be semantically
+faithful to the reference's apply path — same LWW winners, same conflict
+sets, same causal queueing — or its measured time means nothing."""
+
+import automerge_tpu as am
+import refmodel
+from automerge_tpu.core.change import Change, Op
+
+
+def _doc_trace():
+    s1 = am.change(am.init("A"), lambda d: am.assign(
+        d, {"n": 1, "tag": "x", "flags": {"hot": True}}))
+    s2 = am.merge(am.init("B"), s1)
+    s1 = am.change(s1, lambda d: d.__setitem__("n", 2))
+    s2 = am.change(s2, lambda d: am.assign(d, {"n": -1, "owner": "B"}))
+    m = am.merge(s1, s2)
+    return m, m._doc.opset.get_missing_changes({})
+
+
+def _fold_root(diffs):
+    final = {}
+    conflicts = {}
+    for d in diffs:
+        if d.get("type") == "map" and d["obj"] == refmodel.ROOT:
+            if d["action"] == "set":
+                final[d["key"]] = d["value"]
+                if d.get("conflicts"):
+                    conflicts[d["key"]] = {c["actor"]: c["value"]
+                                           for c in d["conflicts"]}
+                else:
+                    conflicts.pop(d["key"], None)
+            elif d["action"] == "remove":
+                final.pop(d["key"], None)
+    return final, conflicts
+
+
+def test_refmodel_lww_and_conflicts_match_oracle():
+    doc, changes = _doc_trace()
+    _, diffs = refmodel.apply_changes(refmodel._init_opset(), changes)
+    final, conflicts = _fold_root(diffs)
+    # scalar root fields must agree with the oracle (links are object ids
+    # in the model; skip them)
+    for k in ("n", "tag", "owner"):
+        assert final[k] == doc[k], (k, final[k], doc[k])
+    # the concurrent n-writes surface the loser as a conflict, like the
+    # oracle's _conflicts (op_set.js:160-176 + getConflicts)
+    want = am.get_conflicts(doc, doc)
+    assert set(conflicts.get("n", {})) == set(want.get("n", {}))
+
+
+def test_refmodel_queues_causally_unready():
+    later = Change("A", 2, {}, (Op("set", refmodel.ROOT, key="k", value=2),))
+    opset, diffs = refmodel.apply_changes(refmodel._init_opset(), [later])
+    assert opset.get("queue") == (later,) and diffs == []
+    first = Change("A", 1, {}, (Op("set", refmodel.ROOT, key="k", value=1),))
+    opset, diffs = refmodel.apply_changes(opset, [first])
+    assert opset.get("queue") == ()
+    final, _ = _fold_root(diffs)
+    assert final["k"] == 2  # both applied, in causal order
+
+
+def test_refmodel_idempotent_redelivery():
+    _, changes = _doc_trace()
+    opset, d1 = refmodel.apply_changes(refmodel._init_opset(), changes)
+    opset2, d2 = refmodel.apply_changes(opset, changes)
+    assert d2 == []  # duplicate (actor, seq) deliveries are dropped
