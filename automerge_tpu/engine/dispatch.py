@@ -64,10 +64,7 @@ def plan_batch(n_docs: int, n_ops: int, wire_bytes: int,
     doc); when unknown it is estimated at n_ops/n_docs/2 (ins+set pairs)."""
     from ..core.bulkload import BULK_MIN_CHANGES
 
-    dev = (_LINK["dispatch_fixed_s"] / passes
-           + _LINK["h2d_call_s"]
-           + wire_bytes / _LINK["h2d_bytes_per_s"]
-           + _LINK["d2h_call_s"] / passes)
+    dev = _device_cost(wire_bytes, passes)
     if changes_per_doc is None:
         changes_per_doc = n_ops / max(n_docs, 1) / 2
     if changes_per_doc >= BULK_MIN_CHANGES:
@@ -76,6 +73,13 @@ def plan_batch(n_docs: int, n_ops: int, wire_bytes: int,
         host = n_ops * _LINK["host_op_s"]
     backend = "device" if dev < host else "host"
     return Plan(backend, dev, host)
+
+
+def _device_cost(wire_bytes: int, passes: int) -> float:
+    return (_LINK["dispatch_fixed_s"] / passes
+            + _LINK["h2d_call_s"]
+            + wire_bytes / _LINK["h2d_bytes_per_s"]
+            + _LINK["d2h_call_s"] / passes)
 
 
 def plan_for(doc_changes: list, passes: int = 1) -> Plan:
@@ -101,11 +105,7 @@ def plan_for(doc_changes: list, passes: int = 1) -> Plan:
     wire_bytes = (rows_count(ops_pad, max(len(actors), 1), ins_pad)
                   * d_pad * 4)
 
-    n_ops = sum(len(c.ops) for chs in doc_changes for c in chs)
-    dev = (_LINK["dispatch_fixed_s"] / passes
-           + _LINK["h2d_call_s"]
-           + wire_bytes / _LINK["h2d_bytes_per_s"]
-           + _LINK["d2h_call_s"] / passes)
+    dev = _device_cost(wire_bytes, passes)
     host = 0.0
     for chs in doc_changes:
         doc_ops = sum(len(c.ops) for c in chs)

@@ -183,3 +183,125 @@ def apply_rows_hash(rows, dims: tuple, n_docs: int, interpret: bool = False):
     (TPU) or its interpreter (tests/CPU). Returns uint32 [n_docs]."""
     from .pallas_kernels import reconcile_rows_hash
     return reconcile_rows_hash(rows, dims, interpret)[:n_docs]
+
+
+# ---------------------------------------------------------------------------
+# Compact wire: dtype-narrowed row buffers
+#
+# The row buffer is all-int32 on device (the megakernel's native layout),
+# but most of its columns are tiny integers — masks, action codes, field
+# ids, actor ranks, clock entries — while only the three content-hash
+# groups need 32 bits. On a link where the host->device hop charges both
+# per-call and per-byte (INTERNALS.md §4), shipping the rows at their
+# NARROWEST safe width and widening on device (one fused cast+concat
+# inside the same dispatch) cuts the wire ~2.5x for map-heavy batches and
+# lets a whole multi-pass timed region ship as three transfer calls.
+# pack_rows_compact chooses int8/int16/int32 PER FIELD from the observed
+# value range, so the format stays exact for any batch.
+
+def _narrow_dtype(part: np.ndarray):
+    lo, hi = (int(part.min()), int(part.max())) if part.size else (0, 0)
+    if -128 <= lo and hi <= 127:
+        return 0, np.int8
+    if -32768 <= lo and hi <= 32767:
+        return 1, np.int16
+    return 2, np.int32
+
+
+def pack_rows_compact(batch: dict, max_fids: int):
+    """Docs-minor row wire with per-field narrow dtypes.
+
+    Returns ((b8, b16, b32), meta, dims, n_docs): three [rows_dt, D_pad]
+    buffers (possibly 0-row) holding the row groups of their width class
+    in kernel order, and meta = ((dtype_idx, n_rows), ...) per ROW_FIELDS
+    group, enough for widen_rows to rebuild the exact int32 layout."""
+    rows, dims, d = pack_rows(batch, max_fids)
+
+    # split back into the ROW_FIELDS groups to classify independently
+    i, a, le = dims[0], dims[1], dims[2]
+    group_rows = (i, i, i, i, i, i, i, i, a * i, le, le, le, le, le)
+    parts8, parts16, parts32, meta = [], [], [], []
+    off = 0
+    for r in group_rows:
+        part = rows[off:off + r]
+        off += r
+        idx, dt = _narrow_dtype(part)
+        (parts8, parts16, parts32)[idx].append(part.astype(dt))
+        meta.append((idx, r))
+    d_pad = rows.shape[1]
+
+    def cat(parts, dt):
+        if not parts:
+            return np.zeros((0, d_pad), dt)
+        return np.concatenate(parts, axis=0)
+
+    return ((cat(parts8, np.int8), cat(parts16, np.int16),
+             cat(parts32, np.int32)), tuple(meta), dims, d)
+
+
+def widen_rows(b8, b16, b32, meta: tuple):
+    """Device-side (inside jit): rebuild the [ROWS, D_pad] int32 row buffer
+    from the narrow wire. One fused cast+concat — XLA folds it into the
+    megakernel's input copy; no extra dispatch."""
+    bufs = (b8, b16, b32)
+    offs = [0, 0, 0]
+    parts = []
+    for idx, r in meta:
+        src = bufs[idx]
+        parts.append(jax.lax.slice(
+            src, (offs[idx], 0),
+            (offs[idx] + r, src.shape[1])).astype(jnp.int32))
+        offs[idx] += r
+    return jnp.concatenate(parts, axis=0)
+
+
+@partial(jax.jit, static_argnames=("meta", "dims", "interpret"))
+def apply_rows_hash_compact(b8, b16, b32, meta: tuple, dims: tuple,
+                            interpret: bool = False):
+    """reconcile_rows_hash over the compact wire (widen + kernel in ONE
+    dispatch). Returns uint32 [D_pad] hashes."""
+    from .pallas_kernels import reconcile_rows_hash
+    rows = widen_rows(b8, b16, b32, meta)
+    return reconcile_rows_hash.__wrapped__(rows, dims, interpret)
+
+
+def pack_rows_bytes(batch: dict, max_fids: int):
+    """The compact wire as ONE contiguous uint8 buffer (the three dtype
+    groups back to back, row-major). A multi-pass timed region can then
+    stack passes on a leading axis and cross the link in a single transfer
+    call. Returns (wire_u8[n_bytes], bmeta, dims, n_docs); bmeta =
+    (meta, (r8, r16, r32), d_pad)."""
+    (b8, b16, b32), meta, dims, n = pack_rows_compact(batch, max_fids)
+    wire = np.concatenate(
+        [np.ascontiguousarray(b).view(np.uint8).ravel()
+         for b in (b8, b16, b32)])
+    bmeta = (meta, (b8.shape[0], b16.shape[0], b32.shape[0]), b8.shape[1])
+    return wire, bmeta, dims, n
+
+
+def widen_bytes(wire_u8, bmeta: tuple):
+    """Device-side (inside jit): [n_bytes] uint8 -> [ROWS, D_pad] int32.
+    Byte-pair/quad reassembly uses bitcast_convert_type on little-endian
+    lanes (XLA's defined in-memory layout on CPU and TPU)."""
+    meta, (r8, r16, r32), d_pad = bmeta
+    o8, o16 = r8 * d_pad, r8 * d_pad + r16 * d_pad * 2
+    end = o16 + r32 * d_pad * 4
+    b8 = jax.lax.bitcast_convert_type(
+        jax.lax.slice(wire_u8, (0,), (o8,)).reshape(r8, d_pad),
+        jnp.int8) if r8 else jnp.zeros((0, d_pad), jnp.int8)
+    b16 = jax.lax.bitcast_convert_type(
+        jax.lax.slice(wire_u8, (o8,), (o16,)).reshape(r16, d_pad, 2),
+        jnp.int16) if r16 else jnp.zeros((0, d_pad), jnp.int16)
+    b32 = jax.lax.bitcast_convert_type(
+        jax.lax.slice(wire_u8, (o16,), (end,)).reshape(r32, d_pad, 4),
+        jnp.int32) if r32 else jnp.zeros((0, d_pad), jnp.int32)
+    return widen_rows(b8, b16, b32, meta)
+
+
+@partial(jax.jit, static_argnames=("bmeta", "dims", "interpret"))
+def apply_rows_hash_bytes(wire_u8, bmeta: tuple, dims: tuple,
+                          interpret: bool = False):
+    """reconcile_rows_hash over the single-buffer byte wire."""
+    from .pallas_kernels import reconcile_rows_hash
+    rows = widen_bytes(wire_u8, bmeta)
+    return reconcile_rows_hash.__wrapped__(rows, dims, interpret)

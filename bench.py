@@ -402,9 +402,11 @@ def run_engine(doc_changes, repeat=10):
     from automerge_tpu.engine.encode import encode_doc, stack_docs
     from automerge_tpu.engine.pack import (ROWS_MAX_ELEMS, ROWS_MAX_OPS,
                                            ROWS_VMEM_BUDGET,
-                                           apply_packed_hash, pack_batch,
-                                           pack_rows, rows_count,
-                                           rows_eligible)
+                                           apply_packed_hash,
+                                           apply_rows_hash,
+                                           apply_rows_hash_bytes, pack_batch,
+                                           pack_rows, pack_rows_bytes,
+                                           rows_count, rows_eligible)
     from automerge_tpu.engine.pallas_kernels import (HAVE_PALLAS,
                                                      reconcile_rows_hash)
 
@@ -429,11 +431,14 @@ def run_engine(doc_changes, repeat=10):
         "eligibility_cutoff": {"ops": ROWS_MAX_OPS, "elems": ROWS_MAX_ELEMS,
                                "vmem_budget_rows": ROWS_VMEM_BUDGET},
     }
-    @partial(jax.jit, static_argnames=("dims",))
-    def apply_all_rows(arrs, dims):
-        return jnp.stack([
-            reconcile_rows_hash.__wrapped__(a, dims, False)
-            for a in arrs])
+    @partial(jax.jit, static_argnames=("bmeta", "dims"))
+    def apply_all_bytes(chunks, bmeta, dims):
+        outs = []
+        for c in chunks:
+            for k in range(c.shape[0]):
+                outs.append(apply_rows_hash_bytes.__wrapped__(
+                    c[k], bmeta, dims, False))
+        return jnp.stack(outs)
 
     @partial(jax.jit, static_argnames=("meta", "max_fids"))
     def apply_all_packed(arrs, meta, max_fids):
@@ -443,47 +448,87 @@ def run_engine(doc_changes, repeat=10):
 
     def build_packed_dispatch():
         wire, meta = pack_batch(batch)
-        return wire, lambda arrs: apply_all_packed(tuple(arrs), meta,
-                                                   max_fids)
+        buffers = [wire.copy() for _ in range(repeat)]  # host-side
+        return wire, buffers, lambda arrs: apply_all_packed(tuple(arrs),
+                                                            meta, max_fids)
+
+    # Transfer plan for the rows path: every pass ships its own copy of the
+    # COMPACT byte wire (pack_rows_bytes: per-field narrow dtypes, one
+    # contiguous uint8 buffer — ~2.5x fewer bytes than int32 rows), with
+    # passes stacked so the whole timed region crosses the link in a few
+    # large calls instead of `repeat` small ones. ~20MB per call stays
+    # below the link's measured per-call bandwidth collapse (INTERNALS §4).
+    CHUNK_BYTES = 20_000_000
+
+    def ship(stacked):
+        per_pass = stacked.shape[1] if stacked.ndim > 1 else 1
+        per_call = max(1, CHUNK_BYTES // max(per_pass, 1))
+        return [jnp.asarray(stacked[i:i + per_call])
+                for i in range(0, stacked.shape[0], per_call)]
 
     if use_rows:
-        wire, dims, n_docs = pack_rows(batch, max_fids)
-        def dispatch(arrs):
-            return apply_all_rows(tuple(arrs), dims)
+        wire, bmeta, dims, n_docs = pack_rows_bytes(batch, max_fids)
+        stacked = np.stack([wire.copy() for _ in range(repeat)])
+        def dispatch(chunks):
+            return apply_all_bytes(tuple(chunks), bmeta, dims)
     else:
-        wire, dispatch = build_packed_dispatch()
+        wire, buffers, dispatch = build_packed_dispatch()
     encode_time = time.perf_counter() - t0
-
-    # Distinct buffer copies per pass so the device transfer is really paid
-    # each iteration (JAX dedups identical host arrays).
-    buffers = [wire.copy() for _ in range(repeat)]
 
     # Warmup: compile AND exercise the transfer + readback paths (the tunnel
     # pays large one-time costs on the first use of each shape/direction).
+    # For the rows path the warmup also cross-checks the compact wire's
+    # device-side widen against the wide int32 path — bit-identical hashes
+    # or we fall back (guards byte-order/bitcast surprises on new backends).
     try:
-        np.asarray(dispatch([jnp.asarray(b) for b in buffers]))
+        if use_rows:
+            got = np.asarray(dispatch(ship(stacked)))
+            rows_wide, dims_w, _n = pack_rows(batch, max_fids)
+            want = np.asarray(apply_rows_hash(
+                jnp.asarray(rows_wide), dims_w, n_docs))
+            if not (got[0][:n_docs] == want[:n_docs]).all():
+                raise AssertionError("compact wire hash mismatch vs wide path")
+        else:
+            np.asarray(dispatch([jnp.asarray(b) for b in buffers]))
     except Exception as e:
         if not use_rows:
             raise
         # The VMEM working-set model in pack.rows_dims_eligible was
-        # optimistic for this shape: fall back to the packed XLA path
-        # instead of losing the config.
+        # optimistic for this shape (or the compact widen misbehaved on
+        # this backend): fall back to the packed XLA path instead of
+        # losing the config.
         kernel_info["rows_kernel_used"] = False
         kernel_info["rows_kernel_fallback_error"] = repr(e)[:200]
         use_rows = False
-        wire, dispatch = build_packed_dispatch()
-        buffers = [wire.copy() for _ in range(repeat)]
+        wire, buffers, dispatch = build_packed_dispatch()
         np.asarray(dispatch([jnp.asarray(b) for b in buffers]))
     del batch
 
-    # Timed: ship every pass's buffer, barrier on the transfers, run ONE
+    # Timed: ship every pass's bytes, barrier on the transfers, run ONE
     # dispatch covering every pass, drain all hashes in one readback.
     t0 = time.perf_counter()
-    arrs = [jnp.asarray(buf) for buf in buffers]
+    if use_rows:
+        arrs = ship(stacked)
+    else:
+        arrs = [jnp.asarray(b) for b in buffers]
     jax.block_until_ready(arrs)
+    t_shipped = time.perf_counter()
     all_hashes = np.asarray(dispatch(arrs))
+    t_done = time.perf_counter()
     assert all_hashes.shape[0] == repeat
-    end_to_end = (time.perf_counter() - t0) / repeat
+    end_to_end = (t_done - t0) / repeat
+    kernel_info["breakdown"] = {
+        "wire_bytes_per_pass": int(wire.nbytes),
+        "transfer_calls": len(arrs),
+        "transfer_s_per_pass": round((t_shipped - t0) / repeat, 5),
+        "dispatch_readback_s_per_pass": round((t_done - t_shipped) / repeat,
+                                              5),
+        "passes": repeat,
+        # the split point is block_until_ready, which this backend may
+        # release before transfers truly land (see module docstring) — the
+        # SUM is exact (readback-bounded); the split is approximate
+        "split_barrier": "block_until_ready (approximate on tunnel)",
+    }
 
     # Device-resident reconcile throughput: inputs already on device, one
     # dispatch + one readback for all passes (what a resident DocSet service

@@ -46,7 +46,7 @@ from __future__ import annotations
 
 import time
 
-from automerge_tpu.utils.persist import PMap
+from automerge_tpu.utils.persist import AList, PMap
 
 _E = PMap()
 
@@ -59,10 +59,12 @@ def _pm(d: dict) -> PMap:
 
 
 def _init_opset() -> PMap:
-    # op_set.js:268-281
+    # op_set.js:268-281. history/states use AList (persistent append-only
+    # views) so growth costs what Immutable.js List.push costs — amortized
+    # O(1), not O(n) tuple copies that would OVER-count the reference.
     return _pm({
         "states": _E, "byObject": _E.set("00000000-0000-0000-0000-000000000000", _E),
-        "clock": _E, "deps": _E, "history": (), "queue": (),
+        "clock": _E, "deps": _E, "history": AList(), "queue": (),
     })
 
 
@@ -140,9 +142,11 @@ def _apply_assign(opset: PMap, op: dict):
         raise KeyError(object_id)
     obj.get("_init")  # objType lookup (op_set.js:181)
     prior = obj.get(op["key"], ())
-    overwritten = tuple(o for o in prior
-                        if not _is_concurrent(opset, o, op))
-    remaining = tuple(o for o in prior if _is_concurrent(opset, o, op))
+    # ONE isConcurrent per pair, like the reference's groupBy
+    # (op_set.js:184-187)
+    flags = [_is_concurrent(opset, o, op) for o in prior]
+    overwritten = tuple(o for o, c in zip(prior, flags) if not c)
+    remaining = tuple(o for o, c in zip(prior, flags) if c)
     for o in overwritten:
         if o["action"] == "link":
             tgt = opset.get("byObject").get(o["value"])
@@ -208,14 +212,16 @@ def _causally_ready(opset: PMap, change) -> bool:
 def _apply_change(opset: PMap, change):
     # op_set.js:224-248
     actor, seq = change.actor, change.seq
-    prior = opset.get("states").get(actor, ())
+    prior = opset.get("states").get(actor)
+    if prior is None:
+        prior = AList()
     if seq <= len(prior):
         return opset, []
     base = dict(change.deps)
     base[actor] = seq - 1
     all_deps = _transitive_deps(opset, base).set(actor, seq)
     opset = opset.set("states", opset.get("states").set(
-        actor, prior + ({"allDeps": all_deps},)))
+        actor, prior.append({"allDeps": all_deps})))
     diffs = []
     for op in change.ops:
         stamped = {"action": op.action, "obj": op.obj, "actor": actor,
@@ -235,7 +241,7 @@ def _apply_change(opset: PMap, change):
     deps = deps.set(actor, seq)
     opset = (opset.set("deps", deps)
                   .set("clock", opset.get("clock").set(actor, seq))
-                  .set("history", opset.get("history") + (change,)))
+                  .set("history", opset.get("history").append(change)))
     return opset, diffs
 
 
