@@ -66,6 +66,10 @@ class DocTables:
         self.frontier: dict[str, int] = {}
         self.seen: set[tuple[str, int]] = set()
         self.queue: list = []  # _Pending records awaiting admission
+        # set to the doc index while the vectorized fast path owns this
+        # table's clock/frontier truth in the dense cache (resident_rows);
+        # _sync_stale_table materializes it back before any dict reader
+        self._stale_idx: int | None = None
         self.n_changes = 0
         self.n_ops = 0
         # capacity stats (mirrored by both the Python and native encoders)
@@ -346,7 +350,12 @@ class ResidentDocSet:
         pending = list(t.queue)
         for p in incoming:
             key = (p.actor, p.seq)
-            if key in t.seen:
+            # duplicates drop idempotently: either already queued/admitted
+            # (seen) or already APPLIED — per-actor seqs are dense and
+            # admitted in order, so clock >= seq means applied (this also
+            # covers changes fast-admitted by the vectorized path, which
+            # updates the dense clock cache without touching `seen`)
+            if key in t.seen or t.clock.get(p.actor, 0) >= p.seq:
                 continue
             pending.append(p)
             t.seen.add(key)

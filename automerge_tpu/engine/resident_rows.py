@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from .encode import _pad_to
 from .resident import ResidentDocSet
 from .pallas_kernels import reconcile_rows_hash
+from ..utils import metrics
 
 
 def _ceil128(n: int) -> int:
@@ -148,12 +149,49 @@ class ResidentRowsDocSet(ResidentDocSet):
 
     # _register_actors/_register_actors_cols are inherited from the base
     # class; only the remap sink differs (host rows mirror vs device state).
+    def sync_tables(self) -> None:
+        """Materialize every fast-path-stale table's clock/frontier dicts
+        from the dense cache. The vectorized admission path leaves table
+        dicts stale (the cache is the authority); internal readers sync
+        per-table on touch, external readers of `tables[i].clock` /
+        `.frontier` call this first."""
+        if getattr(self, "_stale_tables", False):
+            for t in self.tables:
+                self._sync_stale_table(t)
+            self._stale_tables = False
+
+    def _sync_stale_table(self, t) -> None:
+        """Materialize a fast-path-stale table's clock/frontier dicts from
+        the dense cache (the authority while the doc rode the vectorized
+        admission path). Must run before any dict reader touches the table:
+        slow-path _admit, cache rebuild, actor remap."""
+        i = t._stale_idx
+        if i is None:
+            return
+        cc = self._clock_cache
+        if cc is not None:
+            actors = self.actors
+            t.clock = {actors[r]: int(v)
+                       for r, v in enumerate(cc[i].tolist())
+                       if v and r < len(actors)}
+            if self._fsize[i] == 1 and self._hrank[i] >= 0:
+                t.frontier = {actors[int(self._hrank[i])]:
+                              int(self._hseq[i])}
+        t._stale_idx = None
+
+    def _admit(self, t, incoming):
+        self._sync_stale_table(t)
+        return super()._admit(t, incoming)
+
     def _register_actor_names(self, new: set) -> None:
         """Host-mirror version of the base remap (act rows through perm,
         clock_op bands re-gathered)."""
         new = set(new) - set(self.actors)
         if not new:
             return
+        # stale tables read the cache in the OLD rank basis: materialize
+        # them before the cache is invalidated below
+        self.sync_tables()
         # dense clock memos/caches are in the OLD rank basis: materialize
         # memos to actor-name dicts now, rebuild caches lazily
         old_actor_list = list(self.actors)
@@ -652,7 +690,18 @@ class ResidentRowsDocSet(ResidentDocSet):
             for rc in rounds:
                 self._register_round_actors(rc)
             self._precheck_round_frames(rounds)
-            encoded = [self._encode_round_frame(rc) for rc in rounds]
+            # steady-state fast path: ONE vectorized admission + native
+            # encode for the whole micro-batch; falls back to per-round
+            # encode (full protocol handling) when any change breaks the
+            # per-doc in-order chain shape
+            enc_all = self._encode_rounds_batched(rounds)
+            if enc_all is not None:
+                metrics.bump("rows_rounds_batched", len(rounds))
+                encoded = [enc_all]
+            else:
+                if any(rc.cols.n_changes for rc in rounds):
+                    metrics.bump("rows_rounds_fallback", len(rounds))
+                encoded = [self._encode_round_frame(rc) for rc in rounds]
             self._grow_for_rounds(encoded)
             pre_rows = self.rows_host.copy() \
                 if self._dirty or self.rows_dev is None else None
@@ -726,6 +775,9 @@ class ResidentRowsDocSet(ResidentDocSet):
         D, A = self.cap_docs, self.cap_actors
         if self._clock_cache is None \
                 or self._clock_cache.shape != (D, A):
+            # full rebuild reads every table's dicts: materialize any
+            # fast-path-stale tables from the OLD cache before zeroing it
+            self.sync_tables()
             self._clock_cache = np.zeros((D, A), np.int64)
             self._fsize = np.zeros(D, np.int64)
             self._hrank = np.full(D, -1, np.int64)
@@ -740,6 +792,10 @@ class ResidentRowsDocSet(ResidentDocSet):
                           self._hrank, self._hseq)
         for i in dirty:
             t = self.tables[i]
+            if t._stale_idx is not None:
+                # fast-path-stale AND dirtied: the dicts must be current
+                # before this rebuild reads them
+                self._sync_stale_table(t)
             row = cc[i]
             row[:] = 0
             for a, s in t.clock.items():
@@ -751,6 +807,165 @@ class ResidentRowsDocSet(ResidentDocSet):
                 hr[i] = rank_of[a]
                 hs[i] = s
         self._cache_dirty = set()
+
+    def _encode_rounds_batched(self, rounds):
+        """Whole-micro-batch vectorized admission (the streaming steady
+        state): every change in every round rides a per-doc SAME-ACTOR
+        in-order chain — one peer's consecutive edits per document. One
+        classification over the concatenated frame columns, one batched
+        clock-row construction, ONE native encode call for all rounds;
+        per-change Python shrinks to the state-clock memo + change-log
+        appends. Returns the merged enc dict, or None when any change
+        breaks the chain shape (caller falls back to per-round encode,
+        which handles every protocol case)."""
+        from .resident import AdmittedRef
+
+        rcs = [rc for rc in rounds if rc.cols.n_changes]
+        if not rcs:
+            return None
+        self._refresh_admission_cache()
+        rank_of = self.actor_rank
+
+        doc_l, j_l, rnd_l, arank_l, seq_l = [], [], [], [], []
+        dep_rank_l, dep_seq_l, dep_chg_l = [], [], []
+        off = 0
+        for r, rc in enumerate(rcs):
+            cols = rc.cols
+            n_k = len(rc.doc_ids)
+            ch_off = np.asarray(rc.change_off, np.int64)
+            ch_per_k = np.diff(ch_off)
+            if (ch_per_k > 1).any():
+                return None  # multi-change docs: per-round path
+            sel = ch_per_k == 1
+            docs_r = np.fromiter((self.doc_index[d] for d in rc.doc_ids),
+                                 np.int64, n_k)[sel]
+            js_r = ch_off[:-1][sel]
+            perm = np.fromiter((rank_of.get(a, -1) for a in cols.actors),
+                               np.int64, len(cols.actors))
+            arank_r = perm[np.asarray(cols.change_actor, np.int64)[js_r]]
+            seq_r = np.asarray(cols.change_seq, np.int64)[js_r]
+            doc_l.append(docs_r)
+            j_l.append(js_r)
+            rnd_l.append(np.full(len(js_r), r, np.int64))
+            arank_l.append(arank_r)
+            seq_l.append(seq_r)
+            deps_off = np.asarray(cols.deps_off, np.int64)
+            dep_cnt = np.diff(deps_off)
+            if dep_cnt.any():
+                # change index within frame == admitted position (1/doc)
+                dep_chg_frame = np.repeat(np.arange(cols.n_changes), dep_cnt)
+                pos_of_j = np.full(cols.n_changes, -1, np.int64)
+                pos_of_j[js_r] = off + np.arange(len(js_r))
+                dep_pos = pos_of_j[dep_chg_frame]
+                if (dep_pos < 0).any():
+                    return None  # dep rows of unadmitted changes: fallback
+                dep_rank_l.append(perm[np.asarray(cols.deps_actor,
+                                                  np.int64)])
+                dep_seq_l.append(np.asarray(cols.deps_seq, np.int64))
+                dep_chg_l.append(dep_pos)
+            off += len(js_r)
+
+        doc_all = np.concatenate(doc_l)
+        n = len(doc_all)
+        if n == 0:
+            return None
+        j_all = np.concatenate(j_l)
+        rnd_all = np.concatenate(rnd_l)
+        arank_all = np.concatenate(arank_l)
+        seq_all = np.concatenate(seq_l)
+        if (arank_all < 0).any():
+            return None
+        if self._queued_docs:
+            qf = np.zeros(self.cap_docs, bool)
+            qf[np.fromiter(self._queued_docs, np.int64,
+                           len(self._queued_docs))] = True
+            if qf[doc_all].any():
+                return None
+
+        order = np.lexsort((rnd_all, doc_all))
+        d = doc_all[order]
+        a = arank_all[order]
+        s = seq_all[order]
+        starts = np.searchsorted(d, d, side="left")
+        is_first = starts == np.arange(n)
+        cc, fs_, hr_, hs_ = (self._clock_cache, self._fsize,
+                             self._hrank, self._hseq)
+        # single-actor chain, consecutive seqs from the pre-batch clock
+        if (a != a[starts]).any():
+            return None
+        base = cc[d[starts], a[starts]]
+        if not (s == base + 1 + (np.arange(n) - starts)).all():
+            return None
+        # frontier coverage for chain firsts (deps checked below)
+        own = (a == hr_[d]) & (s - 1 >= hs_[d])
+        cov = np.zeros(n, np.int64)
+        deps_ok = True
+        if dep_chg_l:
+            dep_chg = np.concatenate(dep_chg_l)
+            dep_rank = np.concatenate(dep_rank_l)
+            dep_seq = np.concatenate(dep_seq_l)
+            # map dep rows into ordered space
+            inv = np.empty(n, np.int64)
+            inv[order] = np.arange(n)
+            dep_pos = inv[dep_chg]
+            dep_doc = d[dep_pos]
+            safe_rank = np.maximum(dep_rank, 0)
+            sat_pre = (dep_rank >= 0) & (cc[dep_doc, safe_rank] >= dep_seq)
+            sat_chain = (dep_rank == a[dep_pos]) & (dep_seq < s[dep_pos])
+            bad = np.zeros(n, np.int64)
+            np.add.at(bad, dep_pos, ~(sat_pre | sat_chain))
+            deps_ok = not bad.any()
+            np.add.at(cov, dep_pos,
+                      (dep_rank == hr_[dep_doc]) & (dep_seq >= hs_[dep_doc]))
+        if not deps_ok:
+            return None
+        fsz = fs_[d]
+        first_ok = (~is_first) | (fsz == 0) | ((fsz == 1) & ((cov > 0) | own))
+        if not first_ok.all():
+            return None
+
+        # ---- admitted: batched bookkeeping ----
+        # pre-change clock rows: pre-batch row with own entry = seq-1
+        cmat = cc[d].astype(np.int32)
+        cmat[np.arange(n), a] = (s - 1).astype(np.int32)
+        # cache update from each chain's last change
+        last = np.ones(n, bool)
+        last[:-1] = d[1:] != d[:-1]
+        cc[d[last], a[last]] = s[last]
+        fs_[d[last]] = 1
+        hr_[d[last]] = a[last]
+        hs_[d[last]] = s[last]
+
+        j_ord = j_all[order]
+        rnd_ord = rnd_all[order]
+        cidx = np.empty(n, np.int64)
+        tables = self.tables
+        change_log = self.change_log
+        actor_names = self.actors
+        cols_of = [rc.cols for rc in rcs]
+        for pos, (i, j, r, ar, s_) in enumerate(zip(
+                d.tolist(), j_ord.tolist(), rnd_ord.tolist(),
+                a.tolist(), s.tolist())):
+            t = tables[i]
+            t.state_clocks[(actor_names[ar], s_)] = (cmat, pos)
+            change_log[i].append(AdmittedRef(cols_of[r], j))
+            cidx[pos] = t.n_changes
+            t.n_changes += 1
+            t._stale_idx = i
+        self._stale_tables = True
+
+        self._native.ensure_docs(len(self.doc_ids))
+        self._native.begin()
+        self._native.apply_frames([c.frame_bytes for c in cols_of],
+                                  rnd_ord, j_ord, d, a, s, cidx)
+        bd = self._native.finish()
+        for i2 in np.unique(d):
+            if i2 < len(bd.stats):
+                t2 = tables[i2]
+                t2.n_lists = int(bd.stats[i2, 0])
+                t2.max_elems = int(bd.stats[i2, 1])
+        return {"bd": bd, "clock_mat": cmat, "adm_doc": d,
+                "adm_cidx": cidx}
 
     def _encode_round_frame(self, rc):
         """Admission + clock rows for one round frame, then ONE batched
@@ -839,6 +1054,30 @@ class ResidentRowsDocSet(ResidentDocSet):
         hr_[fast_docs] = arank[fast_js]
         hs_[fast_docs] = seq[fast_js]
 
+        # fast bookkeeping, vectorized: the admitted-metadata columns are
+        # sliced straight from the frame vectors; the per-doc dict state
+        # (clock/frontier/seen) is NOT updated — the dense cache is the
+        # authority for these docs until _sync_stale_table materializes it
+        # back (slow-path touch or actor remap; see _admit override). What
+        # stays per-doc: the state-clock memo (read by _clock_row for
+        # later slow changes), the change log, and the change counter.
+        n_fast = len(fast_in_order)
+        cidx_fast = np.empty(n_fast, np.int64)
+        ca_list = np.asarray(cols.change_actor)[fast_js].tolist()
+        seq_list = seq[fast_js].tolist()
+        tables = self.tables
+        change_log = self.change_log
+        for pos, (i, j, ca, s) in enumerate(zip(
+                fast_docs.tolist(), fast_js.tolist(), ca_list, seq_list)):
+            t = tables[i]
+            t.state_clocks[(actors[ca], s)] = (cmat_fast, pos)
+            change_log[i].append(AdmittedRef(cols, j))
+            cidx_fast[pos] = t.n_changes
+            t.n_changes += 1
+            t._stale_idx = i
+        if n_fast:
+            self._stale_tables = True
+
         frames: list[bytes] = [cols.frame_bytes]
         frame_of: dict[int, int] = {id(cols): 0}
         adm_frame: list[int] = []
@@ -851,32 +1090,12 @@ class ResidentRowsDocSet(ResidentDocSet):
 
         queued = self._queued_docs
         change_actor = cols.change_actor
-        fast_pos = 0
         for k in order:
-            if not ch_per_k[k]:
+            if not ch_per_k[k] or (ch_per_k[k] == 1 and not k_bad[k]):
                 continue
             i = int(doc_of_k[k])
             t = self.tables[i]
             log = self.change_log[i]
-            if ch_per_k[k] == 1 and not k_bad[k]:
-                j = int(ch_off[k])
-                actor = actors[int(change_actor[j])]
-                s = int(seq[j])
-                t.state_clocks[(actor, s)] = (cmat_fast, fast_pos)
-                t.clock[actor] = s
-                t.seen.add((actor, s))
-                t.frontier = {actor: s}
-                clock_rows.append(cmat_fast[fast_pos])
-                log.append(AdmittedRef(cols, j))
-                adm_frame.append(0)
-                adm_idx.append(j)
-                adm_doc.append(i)
-                aranks.append(int(arank[j]))
-                seqs.append(s)
-                cidxs.append(t.n_changes)
-                t.n_changes += 1
-                fast_pos += 1
-                continue
             # slow path: full causal admission, change by change (may also
             # release changes queued earlier, possibly from OTHER frames)
             for j in range(int(ch_off[k]), int(ch_off[k + 1])):
@@ -904,24 +1123,58 @@ class ResidentRowsDocSet(ResidentDocSet):
                     cidxs.append(t.n_changes)
                     t.n_changes += 1
             self._cache_dirty.add(i)
-        if not adm_doc:
+
+        n_adm = n_fast + len(adm_doc)
+        if not n_adm:
             return None
+
+        # merge fast (vectors) + slow (lists) into (doc, cidx)-ascending
+        # admitted columns — the order both the native encoder's doc-grouped
+        # output rows and the triplet join's searchsorted key require
+        A_cap = cc.shape[1]
+        if adm_doc:
+            m_frame = np.concatenate([np.zeros(n_fast, np.int64),
+                                      np.asarray(adm_frame, np.int64)])
+            m_idx = np.concatenate([fast_js, np.asarray(adm_idx, np.int64)])
+            m_doc = np.concatenate([fast_docs,
+                                    np.asarray(adm_doc, np.int64)])
+            m_arank = np.concatenate([arank[fast_js],
+                                      np.asarray(aranks, np.int64)])
+            m_seq = np.concatenate([seq[fast_js],
+                                    np.asarray(seqs, np.int64)])
+            m_cidx = np.concatenate([cidx_fast,
+                                     np.asarray(cidxs, np.int64)])
+            m_clock = np.zeros((n_adm, A_cap), np.int32)
+            m_clock[:n_fast] = cmat_fast
+            for r, row in enumerate(clock_rows):
+                m_clock[n_fast + r, :len(row)] = row
+            perm2 = np.lexsort((m_cidx, m_doc))
+            m_frame, m_idx, m_doc = (m_frame[perm2], m_idx[perm2],
+                                     m_doc[perm2])
+            m_arank, m_seq, m_cidx = (m_arank[perm2], m_seq[perm2],
+                                      m_cidx[perm2])
+            m_clock = m_clock[perm2]
+        else:
+            m_frame = np.zeros(n_fast, np.int64)
+            m_idx, m_doc = fast_js, fast_docs
+            m_arank, m_seq, m_cidx = arank[fast_js], seq[fast_js], cidx_fast
+            m_clock = cmat_fast.astype(np.int32)
 
         self._native.ensure_docs(len(self.doc_ids))
         self._native.begin()
-        self._native.apply_frames(frames, adm_frame, adm_idx, adm_doc,
-                                  aranks, seqs, cidxs)
+        self._native.apply_frames(frames, m_frame, m_idx, m_doc,
+                                  m_arank, m_seq, m_cidx)
         bd = self._native.finish()
-        for i2 in np.unique(adm_doc):
+        for i2 in np.unique(m_doc):
             if i2 < len(bd.stats):
                 t2 = self.tables[i2]
                 t2.n_lists = int(bd.stats[i2, 0])
                 t2.max_elems = int(bd.stats[i2, 1])
         return {
             "bd": bd,
-            "clock_mat": np.stack(clock_rows),
-            "adm_doc": np.asarray(adm_doc, np.int64),
-            "adm_cidx": np.asarray(cidxs, np.int64),
+            "clock_mat": m_clock,
+            "adm_doc": m_doc,
+            "adm_cidx": m_cidx,
         }
 
     def _dispatch_final(self, trip_list, pre_rows, interpret):
