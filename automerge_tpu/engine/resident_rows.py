@@ -149,6 +149,78 @@ class ResidentRowsDocSet(ResidentDocSet):
 
     # _register_actors/_register_actors_cols are inherited from the base
     # class; only the remap sink differs (host rows mirror vs device state).
+    class _StaleView:
+        """Read-through guard left in place of a fast-path-stale table's
+        clock/frontier dict: ANY read materializes the real dicts first
+        (via _sync_stale_table), so external readers — e.g. a sync service
+        advertising clocks — can never observe stale values, and writes
+        through a stale reference fail loudly (no __setitem__)."""
+
+        __slots__ = ("_owner", "_t", "_attr")
+
+        def __init__(self, owner, t, attr):
+            self._owner = owner
+            self._t = t
+            self._attr = attr
+
+        def _m(self) -> dict:
+            self._owner._sync_stale_table(self._t)
+            real = getattr(self._t, self._attr)
+            if real is self:  # cache unavailable: invariant broken
+                raise RuntimeError("stale table could not materialize")
+            return real
+
+        def get(self, k, d=None):
+            return self._m().get(k, d)
+
+        def __getitem__(self, k):
+            return self._m()[k]
+
+        def __contains__(self, k):
+            return k in self._m()
+
+        def __iter__(self):
+            return iter(self._m())
+
+        def __len__(self):
+            return len(self._m())
+
+        def __eq__(self, other):
+            return self._m() == other
+
+        def __bool__(self):
+            return bool(self._m())
+
+        def items(self):
+            return self._m().items()
+
+        def keys(self):
+            return self._m().keys()
+
+        def values(self):
+            return self._m().values()
+
+        def __repr__(self):
+            return repr(self._m())
+
+    def _mirror_stats(self, bd, docs) -> None:
+        """Mirror the native encoder's per-doc list/elem stats into the
+        host tables (shared by the batched and per-round encode paths)."""
+        for i in np.unique(docs):
+            if i < len(bd.stats):
+                t = self.tables[i]
+                t.n_lists = int(bd.stats[i, 0])
+                t.max_elems = int(bd.stats[i, 1])
+
+    def _queued_mask(self) -> np.ndarray | None:
+        """Boolean [cap_docs] mask of docs with queued changes, or None."""
+        if not self._queued_docs:
+            return None
+        qf = np.zeros(self.cap_docs, bool)
+        qf[np.fromiter(self._queued_docs, np.int64,
+                       len(self._queued_docs))] = True
+        return qf
+
     def sync_tables(self) -> None:
         """Materialize every fast-path-stale table's clock/frontier dicts
         from the dense cache. The vectorized admission path leaves table
@@ -169,14 +241,19 @@ class ResidentRowsDocSet(ResidentDocSet):
         if i is None:
             return
         cc = self._clock_cache
-        if cc is not None:
-            actors = self.actors
-            t.clock = {actors[r]: int(v)
-                       for r, v in enumerate(cc[i].tolist())
-                       if v and r < len(actors)}
-            if self._fsize[i] == 1 and self._hrank[i] >= 0:
-                t.frontier = {actors[int(self._hrank[i])]:
-                              int(self._hseq[i])}
+        if cc is None:
+            # the only cache-invalidation sites materialize stale tables
+            # first (_register_actor_names, _refresh_admission_cache)
+            raise RuntimeError("stale table with no clock cache")
+        actors = self.actors
+        t.clock = {actors[r]: int(v)
+                   for r, v in enumerate(cc[i].tolist())
+                   if v and r < len(actors)}
+        if self._fsize[i] == 1 and self._hrank[i] >= 0:
+            t.frontier = {actors[int(self._hrank[i])]:
+                          int(self._hseq[i])}
+        elif isinstance(t.frontier, self._StaleView):
+            raise RuntimeError("stale table frontier not single-head")
         t._stale_idx = None
 
     def _admit(self, t, incoming):
@@ -875,12 +952,9 @@ class ResidentRowsDocSet(ResidentDocSet):
         seq_all = np.concatenate(seq_l)
         if (arank_all < 0).any():
             return None
-        if self._queued_docs:
-            qf = np.zeros(self.cap_docs, bool)
-            qf[np.fromiter(self._queued_docs, np.int64,
-                           len(self._queued_docs))] = True
-            if qf[doc_all].any():
-                return None
+        qf = self._queued_mask()
+        if qf is not None and qf[doc_all].any():
+            return None
 
         order = np.lexsort((rnd_all, doc_all))
         d = doc_all[order]
@@ -951,7 +1025,10 @@ class ResidentRowsDocSet(ResidentDocSet):
             change_log[i].append(AdmittedRef(cols_of[r], j))
             cidx[pos] = t.n_changes
             t.n_changes += 1
-            t._stale_idx = i
+            if t._stale_idx is None:
+                t._stale_idx = i
+                t.clock = self._StaleView(self, t, "clock")
+                t.frontier = self._StaleView(self, t, "frontier")
         self._stale_tables = True
 
         self._native.ensure_docs(len(self.doc_ids))
@@ -959,11 +1036,7 @@ class ResidentRowsDocSet(ResidentDocSet):
         self._native.apply_frames([c.frame_bytes for c in cols_of],
                                   rnd_ord, j_ord, d, a, s, cidx)
         bd = self._native.finish()
-        for i2 in np.unique(d):
-            if i2 < len(bd.stats):
-                t2 = tables[i2]
-                t2.n_lists = int(bd.stats[i2, 0])
-                t2.max_elems = int(bd.stats[i2, 1])
+        self._mirror_stats(bd, d)
         return {"bd": bd, "clock_mat": cmat, "adm_doc": d,
                 "adm_cidx": cidx}
 
@@ -1029,10 +1102,8 @@ class ResidentRowsDocSet(ResidentDocSet):
         own = (arank == hr_[chg_doc]) & (seq - 1 >= hs_[chg_doc])
         fsz = fs_[chg_doc]
         ok &= (fsz == 0) | ((fsz == 1) & ((cov > 0) | own))
-        if self._queued_docs:
-            qflag = np.zeros(self.cap_docs, bool)
-            qflag[np.fromiter(self._queued_docs, np.int64,
-                              len(self._queued_docs))] = True
+        qflag = self._queued_mask()
+        if qflag is not None:
             ok &= ~qflag[chg_doc]
         # multi-change docs would need sequential cache updates: slow path
         ok &= np.repeat(ch_per_k == 1, ch_per_k)
@@ -1074,7 +1145,10 @@ class ResidentRowsDocSet(ResidentDocSet):
             change_log[i].append(AdmittedRef(cols, j))
             cidx_fast[pos] = t.n_changes
             t.n_changes += 1
-            t._stale_idx = i
+            if t._stale_idx is None:
+                t._stale_idx = i
+                t.clock = self._StaleView(self, t, "clock")
+                t.frontier = self._StaleView(self, t, "frontier")
         if n_fast:
             self._stale_tables = True
 
@@ -1165,11 +1239,7 @@ class ResidentRowsDocSet(ResidentDocSet):
         self._native.apply_frames(frames, m_frame, m_idx, m_doc,
                                   m_arank, m_seq, m_cidx)
         bd = self._native.finish()
-        for i2 in np.unique(m_doc):
-            if i2 < len(bd.stats):
-                t2 = self.tables[i2]
-                t2.n_lists = int(bd.stats[i2, 0])
-                t2.max_elems = int(bd.stats[i2, 1])
+        self._mirror_stats(bd, m_doc)
         return {
             "bd": bd,
             "clock_mat": m_clock,
