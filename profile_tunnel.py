@@ -1,0 +1,72 @@
+"""One-shot tunnel cost profile (dev tool, not part of the package).
+
+Run when the TPU tunnel is healthy; prints one JSON block measuring the
+link constants the engine's transfer plan and the dispatch router's cost
+model (engine/dispatch.py) depend on: per-call H2D fixed cost + bandwidth
+by size, stacked-vs-separate transfers, D2H readback, dispatch floor, and
+the compact-wire widen overhead.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    out = {"backend": jax.default_backend()}
+
+    def t(f, n=3):
+        f()
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            f()
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    h2d = {}
+    for mb in (0.001, 0.1, 1, 2, 5, 10, 20):
+        a = np.ones(max(int(mb * 1e6 / 4), 1), np.int32)
+        def ship():
+            jax.block_until_ready(jnp.asarray(a))
+        h2d[str(mb)] = round(t(ship) * 1000, 2)
+        print(f"# H2D {mb}MB: {h2d[str(mb)]}ms", file=sys.stderr, flush=True)
+    out["h2d_ms_by_mb"] = h2d
+
+    a2 = [np.ones(1_250_000, np.int32) + i for i in range(10)]  # 10 x 5MB
+    out["h2d_10x5MB_sep_ms"] = round(t(lambda: jax.block_until_ready(
+        [jnp.asarray(b) for b in a2])) * 1000, 1)
+    stacked = np.stack(a2)
+    out["h2d_1x50MB_stacked_ms"] = round(t(lambda: jax.block_until_ready(
+        jnp.asarray(stacked))) * 1000, 1)
+    half = stacked[:4]  # 20MB
+    out["h2d_1x20MB_ms"] = round(t(lambda: jax.block_until_ready(
+        jnp.asarray(half))) * 1000, 1)
+
+    xs = jnp.ones(128, jnp.int32)
+    xb = jnp.ones(25_000_000, jnp.int32)
+    jax.block_until_ready([xs, xb])
+    out["d2h_512B_ms"] = round(t(lambda: np.asarray(xs)) * 1000, 1)
+    out["d2h_100MB_ms"] = round(t(lambda: np.asarray(xb)) * 1000, 1)
+
+    f = jax.jit(lambda x: x + 1)
+    y = jnp.ones((1024, 128), jnp.int32)
+    jax.block_until_ready(f(y))
+    out["tiny_dispatch_plus_readback_ms"] = round(
+        t(lambda: np.asarray(f(y)[0, :1])) * 1000, 1)
+
+    g = jax.jit(lambda u: u.astype(jnp.int32).reshape(200, -1).sum(axis=0))
+    u = jnp.ones(8_000_000, jnp.uint8)
+    jax.block_until_ready(g(u))
+    out["widen8MB_dispatch_readback_ms"] = round(
+        t(lambda: np.asarray(g(u)[:1])) * 1000, 1)
+
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()
