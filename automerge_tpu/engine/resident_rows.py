@@ -68,6 +68,7 @@ class ResidentRowsDocSet(ResidentDocSet):
         self._alloc_rows()
         self.rows_dev = None
         self._dirty = True
+        self._hash_handle = None  # device hashes of the last dispatch
         # dense admission cache (vectorized round-frame fast path): per-doc
         # clock rows + single-head frontier summary. Rebuilt lazily from the
         # authoritative DocTables dicts for docs in _cache_dirty.
@@ -116,6 +117,54 @@ class ResidentRowsDocSet(ResidentDocSet):
     # the docs-major device state of the base class is never built
     def _alloc(self):
         self.state = {}
+
+    def add_docs(self, new_ids: list[str]) -> None:
+        """Grow the document (lane) axis of the rows mirror — a sync
+        service auto-creates docs the way DocSet.apply_changes does
+        (doc_set.js:24-29). Padded lanes are valid empty documents."""
+        from .resident import DocTables
+
+        fresh = [d for d in new_ids if d not in self.doc_index]
+        if not fresh:
+            return
+        self.sync_tables()  # the cache is rebuilt from dicts below
+        for d in fresh:
+            self.doc_index[d] = len(self.doc_ids)
+            self.doc_ids.append(d)
+            self.tables.append(DocTables())
+            self.ins_log.append({})
+            self.list_hash.append({})
+            self.change_log.append([])
+        n = len(self.doc_ids)
+        if n > self.cap_docs:
+            k = _pad_to(n, 8) - self.cap_docs
+            self.cap_docs += k
+            self.op_count = np.concatenate(
+                [self.op_count, np.zeros(k, np.int64)])
+            self.change_count = np.concatenate(
+                [self.change_count, np.zeros(k, np.int64)])
+        new_pad = _ceil128(n)
+        if new_pad > self.n_pad:
+            b = self._bases()
+            grown = np.zeros((b["rows"], new_pad), np.int32)
+            grown[:, :self.n_pad] = self.rows_host
+            cols = slice(self.n_pad, new_pad)
+            I = self.cap_ops
+            le = self.cap_lists * self.cap_elems
+            for g in ("ac", "fid"):
+                grown[b[g]:b[g] + I, cols] = -1
+            for g in ("if", "io"):
+                grown[b[g]:b[g] + le, cols] = -1
+            grown[b["il"]:b["il"] + le, cols] = np.repeat(
+                np.arange(self.cap_lists, dtype=np.int32),
+                self.cap_elems)[:, None]
+            self.rows_host = grown
+            self.n_pad = new_pad
+            self.rows_dev = None
+            self._dirty = True
+        # admission cache rebuilds at the new doc count on next use
+        self._clock_cache = None
+        self._cache_dirty = set(range(n))
 
     def _grow(self, **caps):
         """Re-layout the host mirror for new capacities; device re-uploads."""
@@ -538,6 +587,7 @@ class ResidentRowsDocSet(ResidentDocSet):
             self._dirty = False
         self.rows_dev, hashes = _scan_rounds(
             self.rows_dev, jnp.asarray(stacked), self.dims(), interpret)
+        self._hash_handle = hashes[-1]
         return np.asarray(hashes)[:, :len(self.doc_ids)]
 
     # ------------------------------------------------------------------
@@ -1273,17 +1323,24 @@ class ResidentRowsDocSet(ResidentDocSet):
             self._dirty = False
         self.rows_dev, h = _apply_final(
             self.rows_dev, jnp.asarray(padded), self.dims(), interpret)
+        self._hash_handle = h  # polling hashes() between deltas is free
         return h
 
     def hashes(self, interpret: bool | None = None) -> np.ndarray:
-        """Current per-doc state hashes from resident state."""
+        """Current per-doc state hashes from resident state. Cached between
+        deltas: every apply path ends in a dispatch that already computed
+        them, so polling this does not re-dispatch the reconcile kernel."""
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
         if self.rows_dev is None or self._dirty:
             self.rows_dev = jnp.asarray(self.rows_host)
             self._dirty = False
-        return np.asarray(reconcile_rows_hash(
-            self.rows_dev, self.dims(), interpret))[:len(self.doc_ids)]
+            self._hash_handle = None
+        h = getattr(self, "_hash_handle", None)
+        if h is None:
+            h = reconcile_rows_hash(self.rows_dev, self.dims(), interpret)
+            self._hash_handle = h
+        return np.asarray(h)[:len(self.doc_ids)]
 
     def materialize(self, doc_id: str):
         """Snapshot one document by replaying its admitted change log

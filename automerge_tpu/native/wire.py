@@ -279,3 +279,77 @@ def parse_changes_json(data: bytes | str) -> WireColumns | None:
         return cols
     finally:
         lib.amtpu_free(handle)
+
+
+# ---------------------------------------------------------------------------
+# columnar concatenation (no per-op Python)
+
+def concat_columns(parts: list[WireColumns]) -> WireColumns:
+    """Merge several column batches into one, remapping frame-local string
+    tables into a union. Per-op work is numpy take/where; Python loops only
+    touch the string tables (O(distinct strings), not O(ops)). This is how
+    a sync service coalesces per-doc frames into one round batch without
+    materializing Change objects."""
+    if len(parts) == 1:
+        return parts[0]
+
+    def union_maps(tables: list[list[str]]):
+        interner = _Interner()
+        maps = [np.fromiter((interner.add(s) for s in tbl),
+                            np.int32, len(tbl)) if tbl
+                else np.zeros(1, np.int32)
+                for tbl in tables]
+        return interner.items, maps
+
+    actors, a_maps = union_maps([p.actors for p in parts])
+    objects, o_maps = union_maps([p.objects for p in parts])
+    keys, k_maps = union_maps([p.keys for p in parts])
+    messages, m_maps = union_maps([p.messages for p in parts])
+    strings, s_maps = union_maps([p.strings for p in parts])
+
+    def remap(col, m):
+        col = np.asarray(col, np.int32)
+        return np.where(col >= 0, m[np.maximum(col, 0)], -1).astype(np.int32)
+
+    def cat_off(offs):
+        # concatenate offset arrays: drop each part's leading 0, shift
+        out = [np.zeros(1, np.int32)]
+        base = 0
+        for off in offs:
+            off = np.asarray(off, np.int32)
+            out.append(off[1:] + base)
+            base += int(off[-1])
+        return np.concatenate(out)
+
+    cols = WireColumns(
+        change_actor=np.concatenate(
+            [remap(p.change_actor, a_maps[i]) for i, p in enumerate(parts)]),
+        change_seq=np.concatenate(
+            [np.asarray(p.change_seq, np.int32) for p in parts]),
+        change_msg=np.concatenate(
+            [remap(p.change_msg, m_maps[i]) for i, p in enumerate(parts)]),
+        deps_off=cat_off([p.deps_off for p in parts]),
+        deps_actor=np.concatenate(
+            [remap(p.deps_actor, a_maps[i]) for i, p in enumerate(parts)]),
+        deps_seq=np.concatenate(
+            [np.asarray(p.deps_seq, np.int32) for p in parts]),
+        op_off=cat_off([p.op_off for p in parts]),
+        op_action=np.concatenate(
+            [np.asarray(p.op_action, np.int8) for p in parts]),
+        op_obj=np.concatenate(
+            [remap(p.op_obj, o_maps[i]) for i, p in enumerate(parts)]),
+        op_key=np.concatenate(
+            [remap(p.op_key, k_maps[i]) for i, p in enumerate(parts)]),
+        op_elem=np.concatenate(
+            [np.asarray(p.op_elem, np.int32) for p in parts]),
+        op_vtag=np.concatenate(
+            [np.asarray(p.op_vtag, np.int8) for p in parts]),
+        op_vint=np.concatenate(
+            [np.asarray(p.op_vint, np.int64) for p in parts]),
+        op_vdbl=np.concatenate(
+            [np.asarray(p.op_vdbl, np.float64) for p in parts]),
+        op_vstr=np.concatenate(
+            [remap(p.op_vstr, s_maps[i]) for i, p in enumerate(parts)]),
+        actors=actors, objects=objects, keys=keys, messages=messages,
+        strings=strings)
+    return cols

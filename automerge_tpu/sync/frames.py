@@ -206,6 +206,26 @@ def encode_round_frame(deltas: dict[str, list[Change]]) -> bytes:
                      off.tobytes(), id_off.tobytes(), id_blob, inner])
 
 
+def round_from_columns(deltas: dict[str, "WireColumns"]) -> RoundColumns:
+    """Coalesce per-doc column batches into one decoded round — the rows
+    service's ingress shape — without materializing Change objects
+    (native.wire.concat_columns). The merged frame bytes are attached so
+    the native delta encoder can read them directly."""
+    from ..native.wire import concat_columns
+
+    doc_ids = list(deltas)
+    parts = [deltas[d] for d in doc_ids]
+    off = np.zeros(len(doc_ids) + 1, np.int32)
+    for k, p in enumerate(parts):
+        off[k + 1] = off[k] + p.n_changes
+    merged = concat_columns(parts)
+    # single-part passthrough may already carry its received frame bytes;
+    # only serialize when absent (and cache for the native encoder)
+    if getattr(merged, "frame_bytes", None) is None:
+        merged.frame_bytes = columns_to_bytes(merged)
+    return RoundColumns(doc_ids, off, merged)
+
+
 def decode_round_frame(data: bytes) -> RoundColumns:
     if data[:4] != ROUND_MAGIC:
         raise ValueError("not a round frame (bad magic)")

@@ -64,7 +64,7 @@ class DocHandle:
 
 class EngineDocSet:
     def __init__(self, doc_ids: list[str] | None = None,
-                 live_views: bool = False):
+                 live_views: bool = False, backend: str = "resident"):
         """live_views=True turns the node into a view server: every ingress
         runs the fused apply+reconcile with device-side diff emission
         (engine/diffs.py), per-doc MirrorDoc views are maintained
@@ -73,8 +73,27 @@ class EngineDocSet:
         engine instead of an interpretive OpSet), and subscribers receive
         the raw diff stream. Reads via `view()` then cost zero device work.
         The trade: each ingress pays a reconcile dispatch immediately
-        instead of deferring it to the next hash read."""
-        self._resident = ResidentDocSet(list(doc_ids or []))
+        instead of deferring it to the next hash read.
+
+        backend="rows" stores truth in the docs-minor streaming engine
+        (ResidentRowsDocSet): each ingress becomes a round frame applied
+        through the whole-batch vectorized admission path, and `batch()`
+        coalesces many ingresses into ONE device dispatch — the steady
+        state of a streaming sync service. live_views requires the
+        docs-major backend (device-side diff emission lives there)."""
+        if backend not in ("resident", "rows"):
+            raise ValueError(f"unknown backend {backend!r}")
+        if backend == "rows" and live_views:
+            raise ValueError("live_views requires backend='resident'")
+        self.backend = backend
+        if backend == "rows":
+            from ..engine.resident_rows import ResidentRowsDocSet
+            self._resident = ResidentRowsDocSet(list(doc_ids or []))
+        else:
+            self._resident = ResidentDocSet(list(doc_ids or []))
+        self._pending: dict[str, list] = {}   # rows backend: coalesced round
+        self._batch_depth = 0
+        self._admit_notify: list[str] = []    # docs awaiting handler gossip
         # per doc: actor -> changes ordered by seq (admission guarantees
         # in-order per actor). This is the re-serve log, op_set.js:308-317.
         self._log: dict[str, dict[str, list[Change]]] = {
@@ -156,6 +175,10 @@ class EngineDocSet:
         """Admit a change batch into resident state (causal buffering and
         duplicate-drop happen in the engine's delta encoder) and notify
         handlers so attached Connections gossip the update."""
+        if self.backend == "rows":
+            from ..native.wire import changes_to_columns
+            return self._rows_ingest(doc_id, changes_to_columns(changes))
+
         def apply_fn():
             if self.live_views:
                 _h, diffs = self._resident.apply_and_reconcile(
@@ -172,6 +195,9 @@ class EngineDocSet:
         and the log keeps lazy refs into the frame — no per-op Python
         objects exist unless a lagging peer later needs re-serving. The
         fallback materializes Change objects once (one pass, no JSON)."""
+        if self.backend == "rows":
+            return self._rows_ingest(doc_id, cols)
+
         def apply_fn():
             if self.live_views:
                 _h, diffs = self._resident.apply_and_reconcile_columns(
@@ -184,6 +210,85 @@ class EngineDocSet:
             return None
         handle, _ = self._ingest(doc_id, apply_fn)
         return handle
+
+    # -- rows backend: coalesced round-frame ingress ------------------------
+
+    def _rows_ingest(self, doc_id: str, cols) -> DocHandle:
+        with self._lock:
+            self.add_doc(doc_id)
+            self._pending.setdefault(doc_id, []).append(cols)
+            if not self._batch_depth:
+                self._flush_locked()
+            handle = self.get_doc(doc_id)
+        self._drain_admitted()
+        return handle
+
+    def _flush_locked(self) -> None:
+        """Apply every pending per-doc column batch as ONE round frame
+        through the streaming engine's batched admission; queue handler
+        notifications for the docs that admitted changes."""
+        if not self._pending:
+            return
+        from ..native.wire import concat_columns
+        from .frames import round_from_columns
+
+        pending = self._pending
+        self._pending = {}
+        deltas = {d: (parts[0] if len(parts) == 1
+                      else concat_columns(parts))
+                  for d, parts in pending.items()}
+        rset = self._resident
+        pre = {d: len(rset.change_log[rset.doc_index[d]]) for d in deltas}
+        try:
+            rset.apply_round_frames([round_from_columns(deltas)])
+        except Exception:
+            # nothing was admitted: restore the un-applied ingress so a
+            # later flush can retry instead of silently diverging
+            self._pending = pending
+            raise
+        admitted = [d for d in deltas
+                    if len(rset.change_log[rset.doc_index[d]]) > pre[d]]
+        self._admit_notify.extend(admitted)
+
+    def flush(self) -> None:
+        """Apply any coalesced ingress now (rows backend; no-op otherwise)."""
+        if self.backend != "rows":
+            return
+        with self._lock:
+            self._flush_locked()
+        self._drain_admitted()
+
+    def batch(self):
+        """Context manager: coalesce every ingress inside the block into
+        ONE device dispatch at exit (rows backend). The service lock is
+        held for the duration, so the block must not wait on other threads
+        that ingest into this node."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _cm():
+            with self._lock:
+                self._batch_depth += 1
+                try:
+                    yield self
+                finally:
+                    self._batch_depth -= 1
+                    if not self._batch_depth:
+                        self._flush_locked()
+            self._drain_admitted()
+        return _cm()
+
+    def _drain_admitted(self) -> None:
+        """Notify handlers for admitted docs, outside self._lock (a handler
+        — e.g. a Connection — may call back into this node)."""
+        while True:
+            with self._lock:
+                if not self._admit_notify:
+                    return
+                doc_id = self._admit_notify.pop(0)
+                handle = self.get_doc(doc_id)
+            for handler in list(self.handlers):
+                handler(doc_id, handle)
 
     def _drain_notifications(self) -> None:
         """Deliver queued diff batches to view subscribers in ingress order.
@@ -222,8 +327,14 @@ class EngineDocSet:
 
     # -- protocol reads -------------------------------------------------------
 
+    def _maybe_flush_locked(self) -> None:
+        """Reads must observe pending coalesced ingress (rows backend)."""
+        if self.backend == "rows" and self._pending:
+            self._flush_locked()
+
     def clock_of(self, doc_id: str) -> dict[str, int]:
         with self._lock:
+            self._maybe_flush_locked()
             i = self._resident.doc_index[doc_id]
             return dict(self._resident.tables[i].clock)
 
@@ -232,6 +343,16 @@ class EngineDocSet:
         entries may be lazy frame refs; they materialize here, only for the
         changes a lagging peer actually needs."""
         with self._lock:
+            self._maybe_flush_locked()
+            if self.backend == "rows":
+                # the rows engine's own admitted log is the re-serve source
+                rset = self._resident
+                i = rset.doc_index.get(doc_id)
+                if i is None:
+                    return []
+                return [c if isinstance(c, Change) else c.change()
+                        for c in rset.change_log[i]
+                        if c.seq > clock.get(c.actor, 0)]
             out: list[Change] = []
             for actor, changes in self._log.get(doc_id, {}).items():
                 have = clock.get(actor, 0)
@@ -245,10 +366,12 @@ class EngineDocSet:
         """Converged per-doc state hashes (cached between deltas — polling
         this does not re-dispatch the reconcile kernel)."""
         with self._lock:
+            self._maybe_flush_locked()
             h = self._resident.hashes()
             return {d: int(h[i]) for d, i in self._resident.doc_index.items()}
 
     def materialize(self, doc_id: str):
         """Decode one document's converged state from the device."""
         with self._lock:
+            self._maybe_flush_locked()
             return self._resident.materialize(doc_id)
