@@ -281,7 +281,14 @@ def build_opset(cols) -> OpSet:
             obj_id = objects_tab[op_obj_l[j]]
             if obj_id in by_object:
                 raise BulkUnsupported("duplicate object creation")
-            by_object[obj_id] = ObjState(_ACTIONS[op_action_l[j]])
+            obj = ObjState(_ACTIONS[op_action_l[j]])
+            if obj.is_sequence:
+                # build at plain-dict speed; wrapped back into CowDict
+                # after the per-op loops (CowDict(base) wraps, no copy)
+                obj.fields = {}
+                obj.following = {}
+                obj.insertion = {}
+            by_object[obj_id] = obj
 
     def _stamp(src, actor, seq, _new=Op.__new__, _op=Op):
         o = _new(_op)
@@ -387,6 +394,14 @@ def build_opset(cols) -> OpSet:
     # 7. list order: one native RGA linearization per sequence object,
     # then a bulk ElemList build of the visible elements.
     from ..native.linearize import linearize_host
+
+    # seal the plain-dict sequence state back into CowDicts (wrap, no copy)
+    from ..utils.persist import CowDict
+    for obj in by_object.values():
+        if obj.is_sequence:
+            obj.fields = CowDict(obj.fields)
+            obj.following = CowDict(obj.following)
+            obj.insertion = CowDict(obj.insertion)
 
     actor_rank = {a: r for r, a in enumerate(sorted(set(actors)))}
     for obj in by_object.values():

@@ -127,7 +127,7 @@ class ResidentRowsDocSet(ResidentDocSet):
         fresh = [d for d in new_ids if d not in self.doc_index]
         if not fresh:
             return
-        self.sync_tables()  # the cache is rebuilt from dicts below
+        old_cap_docs = self.cap_docs
         for d in fresh:
             self.doc_index[d] = len(self.doc_ids)
             self.doc_ids.append(d)
@@ -162,9 +162,16 @@ class ResidentRowsDocSet(ResidentDocSet):
             self.n_pad = new_pad
             self.rows_dev = None
             self._dirty = True
-        # admission cache rebuilds at the new doc count on next use
-        self._clock_cache = None
-        self._cache_dirty = set(range(n))
+        # admission cache: fresh lanes are valid empty docs (zero clock,
+        # empty frontier) — grow the cache arrays in place rather than
+        # dropping them, or one-doc-at-a-time ingress of N new docs would
+        # pay N full O(docs) rebuilds
+        if self._clock_cache is not None and self.cap_docs > old_cap_docs:
+            k = self.cap_docs - old_cap_docs
+            self._clock_cache = np.pad(self._clock_cache, ((0, k), (0, 0)))
+            self._fsize = np.pad(self._fsize, (0, k))
+            self._hrank = np.pad(self._hrank, (0, k), constant_values=-1)
+            self._hseq = np.pad(self._hseq, (0, k))
 
     def _grow(self, **caps):
         """Re-layout the host mirror for new capacities; device re-uploads."""

@@ -211,14 +211,23 @@ def round_from_columns(deltas: dict[str, "WireColumns"]) -> RoundColumns:
     service's ingress shape — without materializing Change objects
     (native.wire.concat_columns). The merged frame bytes are attached so
     the native delta encoder can read them directly."""
+    return round_from_parts({d: [c] for d, c in deltas.items()})
+
+
+def round_from_parts(doc_parts: dict[str, list]) -> RoundColumns:
+    """Like round_from_columns but accepting SEVERAL column batches per doc
+    (a coalescing service's pending queue): one concat across everything
+    instead of per-doc merges followed by a cross-doc merge."""
     from ..native.wire import concat_columns
 
-    doc_ids = list(deltas)
-    parts = [deltas[d] for d in doc_ids]
+    doc_ids = list(doc_parts)
+    flat = []
     off = np.zeros(len(doc_ids) + 1, np.int32)
-    for k, p in enumerate(parts):
-        off[k + 1] = off[k] + p.n_changes
-    merged = concat_columns(parts)
+    for k, d in enumerate(doc_ids):
+        parts = doc_parts[d]
+        flat.extend(parts)
+        off[k + 1] = off[k] + sum(p.n_changes for p in parts)
+    merged = concat_columns(flat)
     # single-part passthrough may already carry its received frame bytes;
     # only serialize when absent (and cache for the native encoder)
     if getattr(merged, "frame_bytes", None) is None:

@@ -229,24 +229,20 @@ class EngineDocSet:
         notifications for the docs that admitted changes."""
         if not self._pending:
             return
-        from ..native.wire import concat_columns
-        from .frames import round_from_columns
+        from .frames import round_from_parts
 
         pending = self._pending
         self._pending = {}
-        deltas = {d: (parts[0] if len(parts) == 1
-                      else concat_columns(parts))
-                  for d, parts in pending.items()}
         rset = self._resident
-        pre = {d: len(rset.change_log[rset.doc_index[d]]) for d in deltas}
+        pre = {d: len(rset.change_log[rset.doc_index[d]]) for d in pending}
         try:
-            rset.apply_round_frames([round_from_columns(deltas)])
+            rset.apply_round_frames([round_from_parts(pending)])
         except Exception:
             # nothing was admitted: restore the un-applied ingress so a
             # later flush can retry instead of silently diverging
             self._pending = pending
             raise
-        admitted = [d for d in deltas
+        admitted = [d for d in pending
                     if len(rset.change_log[rset.doc_index[d]]) > pre[d]]
         self._admit_notify.extend(admitted)
 
@@ -280,10 +276,12 @@ class EngineDocSet:
 
     def _drain_admitted(self) -> None:
         """Notify handlers for admitted docs, outside self._lock (a handler
-        — e.g. a Connection — may call back into this node)."""
+        — e.g. a Connection — may call back into this node). Inside a
+        batch() the calling thread still holds the lock, so draining
+        defers to the batch exit (which runs after release)."""
         while True:
             with self._lock:
-                if not self._admit_notify:
+                if self._batch_depth or not self._admit_notify:
                     return
                 doc_id = self._admit_notify.pop(0)
                 handle = self.get_doc(doc_id)
@@ -336,7 +334,9 @@ class EngineDocSet:
         with self._lock:
             self._maybe_flush_locked()
             i = self._resident.doc_index[doc_id]
-            return dict(self._resident.tables[i].clock)
+            out = dict(self._resident.tables[i].clock)
+        self._drain_admitted()  # a read-triggered flush may have admitted
+        return out
 
     def missing_changes(self, doc_id: str, clock: dict[str, int]) -> list[Change]:
         """Per-actor suffixes newer than `clock` (op_set.js:299-306). Log
@@ -348,17 +348,18 @@ class EngineDocSet:
                 # the rows engine's own admitted log is the re-serve source
                 rset = self._resident
                 i = rset.doc_index.get(doc_id)
-                if i is None:
-                    return []
-                return [c if isinstance(c, Change) else c.change()
-                        for c in rset.change_log[i]
-                        if c.seq > clock.get(c.actor, 0)]
-            out: list[Change] = []
-            for actor, changes in self._log.get(doc_id, {}).items():
-                have = clock.get(actor, 0)
-                out.extend(c if isinstance(c, Change) else c.change()
-                           for c in changes if c.seq > have)
-            return out
+                out = [] if i is None else [
+                    c if isinstance(c, Change) else c.change()
+                    for c in rset.change_log[i]
+                    if c.seq > clock.get(c.actor, 0)]
+            else:
+                out = []
+                for actor, changes in self._log.get(doc_id, {}).items():
+                    have = clock.get(actor, 0)
+                    out.extend(c if isinstance(c, Change) else c.change()
+                               for c in changes if c.seq > have)
+        self._drain_admitted()
+        return out
 
     # -- engine reads ---------------------------------------------------------
 
@@ -368,10 +369,14 @@ class EngineDocSet:
         with self._lock:
             self._maybe_flush_locked()
             h = self._resident.hashes()
-            return {d: int(h[i]) for d, i in self._resident.doc_index.items()}
+            out = {d: int(h[i]) for d, i in self._resident.doc_index.items()}
+        self._drain_admitted()
+        return out
 
     def materialize(self, doc_id: str):
         """Decode one document's converged state from the device."""
         with self._lock:
             self._maybe_flush_locked()
-            return self._resident.materialize(doc_id)
+            out = self._resident.materialize(doc_id)
+        self._drain_admitted()
+        return out

@@ -46,7 +46,9 @@ from automerge_tpu.sync.tcp import (TcpSyncClient, TcpSyncServer,  # noqa: E402
 
 N = 8
 ACTOR = f"host{pid}"
-engine = EngineDocSet()
+# AMTPU_MH_BACKEND=rows runs the same protocol over the docs-minor
+# streaming engine (EngineDocSet backend="rows")
+engine = EngineDocSet(backend=os.environ.get("AMTPU_MH_BACKEND", "resident"))
 for i in range(N):
     if i % 2 == pid:  # each host authors half the fleet
         d = am.change(am.init(ACTOR), lambda x, i=i: am.assign(
