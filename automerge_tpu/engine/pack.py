@@ -109,6 +109,20 @@ def rows_count(i: int, a: int, le: int) -> int:
     return 8 * i + a * i + 5 * le
 
 
+def row_bases(i: int, a: int, le: int) -> dict:
+    """Row offsets of each ROW_FIELDS group in the docs-minor buffer — the
+    ONE definition of the layout, shared by the kernel builders
+    (pallas_kernels) and the resident rows mirror (resident_rows._bases)."""
+    co = 8 * i
+    return {
+        "om": 0, "ac": i, "fid": 2 * i, "act": 3 * i, "seq": 4 * i,
+        "chg": 5 * i, "fh": 6 * i, "vh": 7 * i, "co": co,
+        "im": co + a * i, "if": co + a * i + le, "ip": co + a * i + 2 * le,
+        "io": co + a * i + 3 * le, "il": co + a * i + 4 * le,
+        "rows": co + a * i + 5 * le,
+    }
+
+
 def rows_dims_eligible(i: int, a: int, le: int) -> bool:
     """Whether per-doc dims (ops, actors, list-element slots) fit the
     megakernel's VMEM working set. I and LE must be multiples of the kernel
@@ -124,7 +138,10 @@ def rows_eligible(batch: dict, max_fids: int) -> bool:
     d, i = batch["op_mask"].shape
     a = batch["clock"].shape[2]
     l, e = batch["ins_mask"].shape[1:]
-    return rows_dims_eligible(i, a, l * e)
+    if rows_dims_eligible(i, a, l * e):
+        return True
+    from .pallas_kernels import rows_dims_eligible_xl
+    return rows_dims_eligible_xl(i, a, l * e)
 
 
 def pack_rows(batch: dict, max_fids: int) -> tuple[np.ndarray, tuple, int]:

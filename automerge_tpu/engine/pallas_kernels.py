@@ -115,14 +115,13 @@ def _make_reconcile_kernel(I, A, LE, a_set, a_del):
     nothing is unrolled, so compiled code size is independent of I/A/LE and
     the per-doc field count F never appears at all.
     """
+    from .pack import row_bases
+    b = row_bases(I, A, LE)
     r_om, r_ac, r_fid, r_act, r_seq, r_chg, r_fh, r_vh = (
-        0, I, 2 * I, 3 * I, 4 * I, 5 * I, 6 * I, 7 * I)
-    r_co = 8 * I                  # clock_op, actor-major: row a*I + i
-    r_imask = r_co + A * I
-    r_ifid = r_imask + LE
-    r_ipos = r_ifid + LE
-    r_iobj = r_ipos + LE
-    r_ilist = r_iobj + LE
+        b["om"], b["ac"], b["fid"], b["act"], b["seq"], b["chg"],
+        b["fh"], b["vh"])
+    r_co, r_imask, r_ifid = b["co"], b["im"], b["if"]
+    r_ipos, r_iobj, r_ilist = b["ip"], b["io"], b["il"]
 
     def kernel(x_ref, o_ref, *scratch):
         # Mosaic lowers dynamic block addressing only through refs, so every
@@ -243,8 +242,207 @@ def _make_reconcile_kernel(I, A, LE, a_set, a_del):
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("dims", "interpret"))
-def reconcile_rows_hash(rows, dims: tuple, interpret: bool = False):
+def _make_reconcile_kernel_xl(I, A, LE, a_set, a_del, BI=32, BJ=32, BE=8):
+    """XL variant of the reconcile kernel for per-doc dims whose pairwise
+    joins would not fit VMEM with a full axis live: BOTH sides of every
+    join are blocked ([BJ, BI, d] / [BE, BJ, d] intermediates instead of
+    [8, I, d]), nothing full-axis is ever materialized as a value —
+    per-block columns re-read from the input block and the survivor mask
+    recomputed from a `dominated` scratch. Bit-identical to the base
+    kernel (asserted by tests/test_pallas_kernels.py); the price is more
+    loop iterations ((I/BI)*(I/BJ) instead of I/8), which is the right
+    trade when the alternative is not compiling at all."""
+    from .pack import row_bases
+    b = row_bases(I, A, LE)
+    r_om, r_ac, r_fid, r_act, r_seq, r_chg, r_fh, r_vh = (
+        b["om"], b["ac"], b["fid"], b["act"], b["seq"], b["chg"],
+        b["fh"], b["vh"])
+    r_co, r_imask, r_ifid = b["co"], b["im"], b["if"]
+    r_ipos, r_iobj, r_ilist = b["ip"], b["io"], b["il"]
+
+    def kernel(x_ref, o_ref, dom_ref, *scratch):
+        d = x_ref.shape[1]
+
+        def amask_at(j0, n):
+            om_j = x_ref[pl.ds(r_om + j0, n), :]
+            ac_j = x_ref[pl.ds(r_ac + j0, n), :]
+            return (om_j > 0) & (ac_j >= a_set), ac_j
+
+        # ---- domination: (I/BI) x (I/BJ) blocked join --------------------
+        def dom_iblock(ib, carry):
+            i0 = ib * BI
+            fid_i = x_ref[pl.ds(r_fid + i0, BI), :]
+            act_i = x_ref[pl.ds(r_act + i0, BI), :]
+            seq_i = x_ref[pl.ds(r_seq + i0, BI), :]
+            chg_i = x_ref[pl.ds(r_chg + i0, BI), :]
+            am_i, _ = amask_at(i0, BI)
+
+            def dom_jblock(jb, acc):
+                j0 = jb * BJ
+                fid_j = x_ref[pl.ds(r_fid + j0, BJ), :]
+                chg_j = x_ref[pl.ds(r_chg + j0, BJ), :]
+                am_j, _ = amask_at(j0, BJ)
+                base = (am_j[:, None, :] & am_i[None]
+                        & (fid_j[:, None, :] == fid_i[None])
+                        & (chg_j[:, None, :] != chg_i[None]))
+
+                def cp_a(a, cp):
+                    cja = x_ref[pl.ds(r_co + a * I + j0, BJ), :]
+                    hit = ((act_i[None] == a)
+                           & (cja[:, None, :] >= seq_i[None]))
+                    return cp | hit.astype(jnp.int32)
+
+                cp = jax.lax.fori_loop(
+                    0, A, cp_a, jnp.zeros((BJ, BI, d), jnp.int32))
+                return acc | jnp.any(base & (cp > 0),
+                                     axis=0).astype(jnp.int32)
+
+            dom_i = jax.lax.fori_loop(
+                0, I // BJ, dom_jblock, jnp.zeros((BI, d), jnp.int32))
+            dom_ref[pl.ds(i0, BI), :] = dom_i
+            return carry
+
+        jax.lax.fori_loop(0, I // BI, dom_iblock, 0)
+
+        def cand_at(j0, n):
+            """Surviving value-carrying ops of a block (recomputed from the
+            dominated scratch — never held full-axis)."""
+            am_j, ac_j = amask_at(j0, n)
+            return (am_j & (dom_ref[pl.ds(j0, n), :] == 0)
+                    & (ac_j != a_del))
+
+        if LE > 0:
+            vis_ref, rank_ref, isl_ref, oh_ref, rk_ref = scratch
+            # ---- element visibility: (LE/BE) x (I/BJ) --------------------
+            def vis_eblock(eb, carry):
+                e0 = eb * BE
+                ifid_b = x_ref[pl.ds(r_ifid + e0, BE), :]
+
+                def vis_jblock(jb, acc):
+                    j0 = jb * BJ
+                    fid_j = x_ref[pl.ds(r_fid + j0, BJ), :]
+                    cnd_j = cand_at(j0, BJ)
+                    hit = jnp.any((ifid_b[:, None, :] == fid_j[None])
+                                  & cnd_j[None], axis=1)
+                    return acc | hit.astype(jnp.int32)
+
+                hit = jax.lax.fori_loop(
+                    0, I // BJ, vis_jblock,
+                    jnp.zeros((BE, d), jnp.int32))
+                im_b = x_ref[pl.ds(r_imask + e0, BE), :]
+                valid = (im_b > 0) & (ifid_b >= 0)
+                vis_ref[pl.ds(e0, BE), :] = \
+                    (valid & (hit > 0)).astype(jnp.int32)
+                return carry
+
+            jax.lax.fori_loop(0, LE // BE, vis_eblock, 0)
+
+            # ---- visible rank: (LE/BE) x (LE/BE) -------------------------
+            def rank_eblock(eb, carry):
+                e0 = eb * BE
+                pos_b = x_ref[pl.ds(r_ipos + e0, BE), :]
+                lst_b = x_ref[pl.ds(r_ilist + e0, BE), :]
+
+                def rank_fblock(fb, acc):
+                    f0 = fb * BE
+                    pos_f = x_ref[pl.ds(r_ipos + f0, BE), :]
+                    lst_f = x_ref[pl.ds(r_ilist + f0, BE), :]
+                    vis_f = vis_ref[pl.ds(f0, BE), :]
+                    cnt = jnp.sum(
+                        jnp.where((lst_b[:, None, :] == lst_f[None])
+                                  & (vis_f[None] > 0)
+                                  & (pos_f[None] < pos_b[:, None, :]),
+                                  1, 0), axis=1)
+                    return acc + cnt
+
+                cnt = jax.lax.fori_loop(
+                    0, LE // BE, rank_fblock,
+                    jnp.zeros((BE, d), jnp.int32))
+                rank_ref[pl.ds(e0, BE), :] = jnp.where(
+                    vis_ref[pl.ds(e0, BE), :] > 0, cnt, -1)
+                return carry
+
+            jax.lax.fori_loop(0, LE // BE, rank_eblock, 0)
+
+            # ---- op -> elem map: (I/BI) x (LE/BE) ------------------------
+            def opmap_iblock(ib, carry):
+                i0 = ib * BI
+                fid_b = x_ref[pl.ds(r_fid + i0, BI), :]
+
+                def opmap_eblock(eb, acc):
+                    isl, oh, rk = acc
+                    e0 = eb * BE
+                    ifid_e = x_ref[pl.ds(r_ifid + e0, BE), :]
+                    im_e = x_ref[pl.ds(r_imask + e0, BE), :]
+                    iobj_e = x_ref[pl.ds(r_iobj + e0, BE), :]
+                    valid = (im_e > 0) & (ifid_e >= 0)
+                    m = (fid_b[:, None, :] == ifid_e[None]) & valid[None]
+                    isl = isl | jnp.any(m, axis=1).astype(jnp.int32)
+                    oh = jnp.maximum(
+                        oh, jnp.max(jnp.where(m, iobj_e[None], -1), axis=1))
+                    rk = jnp.maximum(
+                        rk, jnp.max(jnp.where(
+                            m, rank_ref[pl.ds(e0, BE), :][None], -1),
+                            axis=1))
+                    return (isl, oh, rk)
+
+                z = jnp.zeros((BI, d), jnp.int32)
+                isl, oh, rk = jax.lax.fori_loop(
+                    0, LE // BE, opmap_eblock,
+                    (z, z - 1, z - 1))
+                isl_ref[pl.ds(i0, BI), :] = isl
+                oh_ref[pl.ds(i0, BI), :] = oh
+                rk_ref[pl.ds(i0, BI), :] = rk
+                return carry
+
+            jax.lax.fori_loop(0, I // BI, opmap_iblock, 0)
+
+        # ---- hash contribution, blocked accumulation ---------------------
+        def hash_iblock(ib, acc):
+            i0 = ib * BI
+            fh_b = x_ref[pl.ds(r_fh + i0, BI), :]
+            vh_b = x_ref[pl.ds(r_vh + i0, BI), :]
+            act_b = x_ref[pl.ds(r_act + i0, BI), :]
+            cnd = cand_at(i0, BI)
+            if LE > 0:
+                isl = isl_ref[pl.ds(i0, BI), :]
+                key1 = jnp.where(isl > 0, oh_ref[pl.ds(i0, BI), :],
+                                 jnp.int32(-7))
+                key2 = jnp.where(isl > 0, rk_ref[pl.ds(i0, BI), :], fh_b)
+            else:
+                key1 = jnp.full_like(fh_b, -7)
+                key2 = fh_b
+            contrib = _mix4_i32(key1, key2, act_b, vh_b)
+            return acc + jnp.sum(jnp.where(cnd, contrib, 0), axis=0,
+                                 keepdims=True)
+
+        o_ref[:] = jax.lax.fori_loop(
+            0, I // BI, hash_iblock, jnp.zeros((1, d), jnp.int32))
+
+    return kernel
+
+
+# XL-kernel block sizes and its VMEM model: the input block plus the
+# dominated/vis/rank/op-map scratches plus [BJ, BI, 128]-sized live join
+# intermediates — no term scales with I*8 anymore.
+_XL_BI = 32
+_XL_BJ = 32
+
+
+def rows_dims_eligible_xl(i: int, a: int, le: int) -> bool:
+    from .pack import ROWS_VMEM_BUDGET, rows_count
+    # live [BJ, BI, 128] int32 join intermediates = BI*BJ [1,128]-row units
+    # each (same unit convention as pack.rows_dims_eligible), three live
+    inter = 3 * _XL_BI * _XL_BJ
+    working = rows_count(i, a, le) + inter + 4 * i + 2 * le
+    return (i % _XL_BI == 0 and (le % 8 == 0)
+            and working <= ROWS_VMEM_BUDGET)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("dims", "interpret", "force_xl"))
+def reconcile_rows_hash(rows, dims: tuple, interpret: bool = False,
+                        force_xl: bool = False):
     """Fused reconcile + state hash over a docs-minor row buffer.
 
     rows: [ROWS, D_pad] int32 (see pack.pack_rows); dims is the static
@@ -262,14 +460,24 @@ def reconcile_rows_hash(rows, dims: tuple, interpret: bool = False):
             f"megakernel dims must be multiples of {_BLK}: I={I}, LE={LE} "
             f"(pad ops/elements before packing)")
     rows_n, d_pad = rows.shape
-    kernel = _make_reconcile_kernel(I, A, LE, a_set, a_del)
-    scratch = []
+    from .pack import rows_dims_eligible
+    if rows_dims_eligible(I, A, LE) and not force_xl:
+        kernel = _make_reconcile_kernel(I, A, LE, a_set, a_del)
+        scratch = []
+    else:
+        # base working set would blow VMEM (live [8, I, d] intermediates):
+        # the doubly-blocked XL kernel, dominated mask in scratch
+        if I % _XL_BI:
+            raise ValueError(f"XL kernel needs I % {_XL_BI} == 0, I={I}")
+        kernel = _make_reconcile_kernel_xl(I, A, LE, a_set, a_del,
+                                           _XL_BI, _XL_BJ)
+        scratch = [pltpu.VMEM((I, 128), jnp.int32)]    # dominated
     if LE > 0:
-        scratch = [pltpu.VMEM((LE, 128), jnp.int32),   # elem visibility
-                   pltpu.VMEM((LE, 128), jnp.int32),   # elem rank
-                   pltpu.VMEM((I, 128), jnp.int32),    # op is-list
-                   pltpu.VMEM((I, 128), jnp.int32),    # op objhash
-                   pltpu.VMEM((I, 128), jnp.int32)]    # op rank
+        scratch += [pltpu.VMEM((LE, 128), jnp.int32),  # elem visibility
+                    pltpu.VMEM((LE, 128), jnp.int32),  # elem rank
+                    pltpu.VMEM((I, 128), jnp.int32),   # op is-list
+                    pltpu.VMEM((I, 128), jnp.int32),   # op objhash
+                    pltpu.VMEM((I, 128), jnp.int32)]   # op rank
     out = pl.pallas_call(
         kernel,
         grid=(d_pad // 128,),

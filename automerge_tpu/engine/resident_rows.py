@@ -82,18 +82,9 @@ class ResidentRowsDocSet(ResidentDocSet):
     # row layout
 
     def _bases(self):
-        I, A = self.cap_ops, self.cap_actors
-        LE = self.cap_lists * self.cap_elems
-        om = 0
-        co = 8 * I
-        return {
-            "om": om, "ac": om + I, "fid": om + 2 * I, "act": om + 3 * I,
-            "seq": om + 4 * I, "chg": om + 5 * I, "fh": om + 6 * I,
-            "vh": om + 7 * I, "co": co, "im": co + A * I,
-            "if": co + A * I + LE, "ip": co + A * I + 2 * LE,
-            "io": co + A * I + 3 * LE, "il": co + A * I + 4 * LE,
-            "rows": co + A * I + 5 * LE,
-        }
+        from .pack import row_bases
+        return row_bases(self.cap_ops, self.cap_actors,
+                         self.cap_lists * self.cap_elems)
 
     def dims(self) -> tuple:
         from .encode import A_DEL, A_SET

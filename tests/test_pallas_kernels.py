@@ -188,3 +188,77 @@ def test_reconcile_rows_convergence_hash():
         ba._doc.opset.get_missing_changes({})])
     np.testing.assert_array_equal(ref, got)
     assert got[0] == got[1]
+
+
+def _xl_parity(doc_changes):
+    """force_xl vs base kernel: bit-identical hashes (interpret mode)."""
+    import jax.numpy as jnp
+
+    from automerge_tpu.engine.encode import encode_doc, stack_docs
+    from automerge_tpu.engine.pack import pack_rows
+    from automerge_tpu.engine.pallas_kernels import reconcile_rows_hash
+
+    actors = sorted({c.actor for chs in doc_changes for c in chs})
+    encs = [encode_doc(c, actors) for c in doc_changes]
+    batch = stack_docs(encs)
+    mf = batch.pop("max_fids")
+    rows, dims, n = pack_rows(batch, mf)
+    assert dims[0] % 32 == 0, f"test shape must pad I to 32: {dims}"
+    interp = jax.default_backend() != "tpu"
+    base = np.asarray(reconcile_rows_hash(
+        jnp.asarray(rows), dims, interp, False))[:n]
+    xl = np.asarray(reconcile_rows_hash(
+        jnp.asarray(rows), dims, interp, True))[:n]
+    np.testing.assert_array_equal(base, xl)
+    return dims
+
+
+def test_xl_kernel_parity_maps_and_lists():
+    """The doubly-blocked XL kernel (for dims whose joins would blow VMEM
+    with a full axis live) hashes bit-identically to the base kernel."""
+    import automerge_tpu as am
+
+    docs = []
+    for i in range(5):
+        s1 = am.change(am.init("A"), lambda d, i=i: am.assign(
+            d, {"n": i, "xs": [1, 2, 3]}))
+        s2 = am.merge(am.init("B"), s1)
+        s1 = am.change(s1, lambda d: d["xs"].delete_at(0))
+        s2 = am.change(s2, lambda d, i=i: am.assign(d, {"n": -i, "o": "B"}))
+        for k in range(10):
+            s1 = am.change(s1, lambda d, k=k: d.__setitem__(f"k{k}", k))
+        m = am.merge(s1, s2)
+        docs.append(m._doc.opset.get_missing_changes({}))
+    _xl_parity(docs)
+
+
+def test_xl_kernel_parity_concurrent_text():
+    """Concurrent text editing (tombstones, rank shifts, 3 actors) through
+    the XL kernel: the shape class config 3 batched lands in."""
+    import random
+
+    import automerge_tpu as am
+
+    rng = random.Random(9)
+    docs = []
+    for _ in range(2):
+        def mk(d):
+            d["t"] = am.Text()
+            d["t"].insert_at(0, *"hello world ok")
+        base = am.change(am.init("base"), mk)
+        reps = {a: am.merge(am.init(a), base) for a in "AB"}
+        for step in range(30):
+            a = rng.choice("AB")
+            d = reps[a]
+            n = len(d["t"])
+            if rng.random() < 0.7 or n == 0:
+                d = am.change(d, lambda x, p=rng.randint(0, n):
+                              x["t"].insert_at(p, rng.choice("xyz")))
+            else:
+                d = am.change(d, lambda x, p=rng.randrange(n):
+                              x["t"].delete_at(p))
+            reps[a] = d
+        m = am.merge(reps["A"], reps["B"])
+        docs.append(m._doc.opset.get_missing_changes({}))
+    dims = _xl_parity(docs)
+    assert dims[0] >= 32 and dims[2] >= 32  # ops and elems both blocked
