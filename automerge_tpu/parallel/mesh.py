@@ -89,3 +89,51 @@ def reconcile_sharded(doc_changes, mesh: Mesh):
     arrays = shard_batch(batch, mesh)
     out = sharded_apply(arrays, max_fids, mesh)
     return encodings, out, len(doc_changes)
+
+
+def reconcile_rows_sharded(doc_changes, mesh: Mesh, interpret: bool | None = None):
+    """Mesh-sharded megakernel reconcile: the docs-minor row buffer's LANE
+    axis (documents) is sharded over the mesh with `shard_map`, and each
+    device runs `reconcile_rows_hash` on its own 128-aligned lane shard —
+    the pod-scale shape of the streaming engine (no cross-shard
+    communication: documents are independent; clock unions ride
+    parallel/collective.py). Returns (hashes[n_docs] uint32, n_docs).
+
+    The per-shard lane count is padded to a multiple of 128 * mesh size so
+    every shard is a whole number of TPU lane tiles."""
+    from functools import partial
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    from ..engine.encode import encode_doc, stack_docs
+    from ..engine.pack import pack_rows
+    from ..engine.pallas_kernels import reconcile_rows_hash
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n = mesh.devices.size
+    actors = sorted({c.actor for chs in doc_changes for c in chs})
+    encodings = [encode_doc(c, actors) for c in doc_changes]
+    batch = stack_docs(encodings)
+    max_fids = batch.pop("max_fids")
+    # pad the docs axis so every shard is a whole 128-lane block
+    batch = _pad_docs(batch, 128 * n)
+    rows, dims, _d = pack_rows(batch, max_fids)
+
+    # replication/vma checks off: pallas_call's out_shape carries no
+    # varying-mesh-axes annotation; the out_spec states the sharding
+    # explicitly. (kwarg renamed check_rep -> check_vma across jax versions)
+    body = partial(reconcile_rows_hash.__wrapped__, dims=dims,
+                   interpret=interpret)
+    try:
+        fn = shard_map(body, mesh=mesh, in_specs=P(None, DOCS_AXIS),
+                       out_specs=P(DOCS_AXIS), check_vma=False)
+    except TypeError:
+        fn = shard_map(body, mesh=mesh, in_specs=P(None, DOCS_AXIS),
+                       out_specs=P(DOCS_AXIS), check_rep=False)
+    sharded = jax.device_put(rows, NamedSharding(mesh, P(None, DOCS_AXIS)))
+    hashes = jax.jit(fn)(sharded)
+    return np.asarray(hashes)[:len(doc_changes)], len(doc_changes)
