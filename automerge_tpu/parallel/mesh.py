@@ -69,17 +69,19 @@ def sharded_apply(arrays: dict, max_fids: int, mesh: Mesh):
     return fn(arrays)
 
 
-def encode_padded_batch(doc_changes, mesh: Mesh):
+def encode_padded_batch(doc_changes, mesh: Mesh, multiple: int | None = None):
     """Encode per-document change sets into a stacked batch padded to the
-    mesh size. Deterministic given the change sets alone (sorted global
-    actor order), so every host of a multi-host run produces a
+    mesh size (or an explicit `multiple`, e.g. 128 * mesh size for lane-
+    sharded kernels). Deterministic given the change sets alone (sorted
+    global actor order), so every host of a multi-host run produces a
     bit-identical description — the precondition for contributing local
     shards of one global array (parallel/multihost.py)."""
     all_actors = sorted({c.actor for changes in doc_changes for c in changes})
     encodings = [encode_doc(changes, all_actors) for changes in doc_changes]
     batch = stack_docs(encodings)
     max_fids = batch.pop("max_fids")
-    return encodings, _pad_docs(batch, mesh.devices.size), max_fids
+    return (encodings,
+            _pad_docs(batch, multiple or mesh.devices.size), max_fids)
 
 
 def reconcile_sharded(doc_changes, mesh: Mesh):
@@ -101,6 +103,31 @@ def reconcile_rows_sharded(doc_changes, mesh: Mesh, interpret: bool | None = Non
 
     The per-shard lane count is padded to a multiple of 128 * mesh size so
     every shard is a whole number of TPU lane tiles."""
+    from ..engine.pack import pack_rows
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n = mesh.devices.size
+    # pad the docs axis so every shard is a whole 128-lane block
+    _encs, batch, max_fids = encode_padded_batch(doc_changes, mesh,
+                                                 multiple=128 * n)
+    rows, dims, _d = pack_rows(batch, max_fids)
+    fn = _sharded_rows_fn(mesh, dims, interpret)
+    sharded = jax.device_put(rows, NamedSharding(mesh, P(None, DOCS_AXIS)))
+    hashes = fn(sharded)
+    return np.asarray(hashes)[:len(doc_changes)], len(doc_changes)
+
+
+_SHARDED_ROWS_CACHE: dict = {}
+
+
+def _sharded_rows_fn(mesh: Mesh, dims: tuple, interpret: bool):
+    """Jitted shard_map'd megakernel, cached per (mesh, dims, interpret) so
+    repeated reconciles do not retrace/recompile."""
+    key = (id(mesh), dims, interpret)
+    fn = _SHARDED_ROWS_CACHE.get(key)
+    if fn is not None:
+        return fn
     from functools import partial
 
     try:
@@ -108,32 +135,19 @@ def reconcile_rows_sharded(doc_changes, mesh: Mesh, interpret: bool | None = Non
     except ImportError:  # older jax
         from jax.experimental.shard_map import shard_map
 
-    from ..engine.encode import encode_doc, stack_docs
-    from ..engine.pack import pack_rows
     from ..engine.pallas_kernels import reconcile_rows_hash
 
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    n = mesh.devices.size
-    actors = sorted({c.actor for chs in doc_changes for c in chs})
-    encodings = [encode_doc(c, actors) for c in doc_changes]
-    batch = stack_docs(encodings)
-    max_fids = batch.pop("max_fids")
-    # pad the docs axis so every shard is a whole 128-lane block
-    batch = _pad_docs(batch, 128 * n)
-    rows, dims, _d = pack_rows(batch, max_fids)
-
+    body = partial(reconcile_rows_hash.__wrapped__, dims=dims,
+                   interpret=interpret)
     # replication/vma checks off: pallas_call's out_shape carries no
     # varying-mesh-axes annotation; the out_spec states the sharding
     # explicitly. (kwarg renamed check_rep -> check_vma across jax versions)
-    body = partial(reconcile_rows_hash.__wrapped__, dims=dims,
-                   interpret=interpret)
     try:
-        fn = shard_map(body, mesh=mesh, in_specs=P(None, DOCS_AXIS),
+        sm = shard_map(body, mesh=mesh, in_specs=P(None, DOCS_AXIS),
                        out_specs=P(DOCS_AXIS), check_vma=False)
     except TypeError:
-        fn = shard_map(body, mesh=mesh, in_specs=P(None, DOCS_AXIS),
+        sm = shard_map(body, mesh=mesh, in_specs=P(None, DOCS_AXIS),
                        out_specs=P(DOCS_AXIS), check_rep=False)
-    sharded = jax.device_put(rows, NamedSharding(mesh, P(None, DOCS_AXIS)))
-    hashes = jax.jit(fn)(sharded)
-    return np.asarray(hashes)[:len(doc_changes)], len(doc_changes)
+    fn = jax.jit(sm)
+    _SHARDED_ROWS_CACHE[key] = fn
+    return fn

@@ -78,14 +78,14 @@ def test_graft_entry_multichip():
     graft.dryrun_multichip(8)
 
 
-def test_rows_megakernel_sharded_over_mesh():
+def test_rows_megakernel_sharded_over_mesh(mesh):
     """The docs-minor megakernel runs under shard_map with the document
     lane axis sharded across all 8 devices — per-doc hashes bit-identical
     to the unsharded engine (documents are independent; no collectives in
     the forward pass)."""
     import automerge_tpu as am
     from automerge_tpu.engine.batchdoc import apply_batch
-    from automerge_tpu.parallel.mesh import make_mesh, reconcile_rows_sharded
+    from automerge_tpu.parallel.mesh import reconcile_rows_sharded
 
     docs = []
     for i in range(40):
@@ -97,7 +97,6 @@ def test_rows_megakernel_sharded_over_mesh():
         m = am.merge(s1, s2)
         docs.append(m._doc.opset.get_missing_changes({}))
 
-    mesh = make_mesh()
     got, n = reconcile_rows_sharded(docs, mesh)
     assert n == len(docs)
     _, _, ref = apply_batch(docs)

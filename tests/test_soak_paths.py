@@ -1,0 +1,138 @@
+"""Cross-path randomized soaks: every execution path the engine has —
+interpretive oracle, packed XLA, docs-minor rows kernel, XL kernel, compact
+byte wire — must produce identical state hashes on random mixed workloads;
+and the streaming frames path must match the apply_rounds twin under
+adversarial rounds (duplicates, multi-change docs, new actors)."""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import automerge_tpu as am
+from automerge_tpu.engine.batchdoc import apply_batch
+from automerge_tpu.engine.encode import encode_doc, stack_docs
+from automerge_tpu.engine.pack import (apply_rows_hash,
+                                       apply_rows_hash_bytes, pack_rows,
+                                       pack_rows_bytes, rows_eligible)
+from automerge_tpu.engine.pallas_kernels import reconcile_rows_hash
+
+CHARS = "abcxyz "
+
+
+def _random_doc(seed):
+    r = random.Random(seed)
+    base = am.change(am.init("base"), lambda d: am.assign(
+        d, {"n": 0, "xs": [1], "t": am.Text()}))
+    reps = {a: am.merge(am.init(a), base)
+            for a in ("A", "B", "C")[:r.randint(1, 3)]}
+    for _ in range(r.randint(3, 18)):
+        a = r.choice(list(reps))
+        d = reps[a]
+        k = r.random()
+        if k < 0.3:
+            d = am.change(d, lambda x: x.__setitem__(
+                r.choice("nmpq"), r.randint(0, 99)))
+        elif k < 0.5:
+            n = len(d["xs"])
+            d = am.change(d, lambda x: x["xs"].insert_at(
+                r.randint(0, n), r.randint(0, 9)))
+        elif k < 0.65 and len(d["xs"]):
+            d = am.change(d, lambda x: x["xs"].delete_at(
+                r.randrange(len(x["xs"]))))
+        elif k < 0.85:
+            n = len(d["t"])
+            d = am.change(d, lambda x: x["t"].insert_at(
+                r.randint(0, n), r.choice(CHARS)))
+        elif len(d["t"]):
+            d = am.change(d, lambda x: x["t"].delete_at(
+                r.randrange(len(x["t"]))))
+        if r.random() < 0.2 and len(reps) > 1:
+            d = am.merge(d, reps[r.choice([x for x in reps if x != a])])
+        reps[a] = d
+    m = None
+    for d in reps.values():
+        m = d if m is None else am.merge(m, d)
+    return m._doc.opset.get_missing_changes({})
+
+
+def test_all_batch_paths_hash_identically():
+    docs = [_random_doc(i) for i in range(30)]
+    n = len(docs)
+    _, _, ref = apply_batch(docs)
+    want = np.asarray(ref["hash"])[:n].astype(np.uint32)
+
+    actors = sorted({c.actor for chs in docs for c in chs})
+    encs = [encode_doc(c, actors) for c in docs]
+    batch = stack_docs(encs)
+    mf = batch.pop("max_fids")
+    assert rows_eligible(batch, mf)
+    rows, dims, _n = pack_rows(batch, mf)
+    interp = jax.default_backend() != "tpu"
+    base = np.asarray(apply_rows_hash(
+        jnp.asarray(rows), dims, n, interpret=interp)).astype(np.uint32)
+    np.testing.assert_array_equal(base, want)
+    xl = np.asarray(reconcile_rows_hash(
+        jnp.asarray(rows), dims, interp, True))[:n].astype(np.uint32)
+    np.testing.assert_array_equal(xl, want)
+    wire, bmeta, dims2, _n2 = pack_rows_bytes(batch, mf)
+    byt = np.asarray(apply_rows_hash_bytes(
+        jnp.asarray(wire), bmeta, dims2, interp))[:n].astype(np.uint32)
+    np.testing.assert_array_equal(byt, want)
+
+
+def test_streaming_frames_adversarial_rounds():
+    from automerge_tpu.engine.resident_rows import ResidentRowsDocSet
+    from automerge_tpu.sync.frames import encode_round_frame
+
+    rng = random.Random(77)
+    N = 8
+    ids = [f"d{i}" for i in range(N)]
+    docs, logs = {}, {}
+    for i, did in enumerate(ids):
+        d = am.change(am.init("M"), lambda x, i=i: am.assign(
+            x, {"n": i, "xs": [i], "t": am.Text()}))
+        docs[did] = d
+        logs[did] = d._doc.opset.get_missing_changes({})
+    a, b = ResidentRowsDocSet(ids), ResidentRowsDocSet(ids)
+    boot = [{d: logs[d] for d in ids}]
+    a.apply_rounds(boot)
+    b.apply_rounds(boot)
+    pending_dups = []
+    for rnd in range(12):
+        deltas = {}
+        for did in rng.sample(ids, rng.randint(1, N)):
+            prev = docs[did]
+            new = prev
+            for _ in range(rng.randint(1, 3)):
+                k = rng.random()
+                if k < 0.5:
+                    new = am.change(new, lambda x, r=rng.randint(0, 999):
+                                    x.__setitem__("n", r))
+                elif k < 0.8:
+                    n = len(new["t"])
+                    new = am.change(new, lambda x, p=rng.randint(0, n):
+                                    x["t"].insert_at(p, rng.choice("qrs")))
+                else:
+                    peer = am.change(
+                        am.merge(am.init(f"P{rng.randint(0, 3)}"), new),
+                        lambda x: x.__setitem__("p", 1))
+                    new = am.merge(new, peer)  # new actors appear
+            deltas[did] = new._doc.opset.get_missing_changes(
+                prev._doc.opset.clock)
+            docs[did] = new
+        if deltas and rng.random() < 0.3:
+            pending_dups.append(dict(deltas))
+        if pending_dups and rng.random() < 0.4:
+            for did, chs in pending_dups.pop(0).items():
+                deltas[did] = list(deltas.get(did, [])) + list(chs)  # dups
+        h = np.asarray(a.apply_round_frames(
+            [encode_round_frame(deltas)]))[:N]
+        hs = b.apply_rounds([deltas])
+        np.testing.assert_array_equal(h, hs[-1], err_msg=f"round {rnd}")
+    a.sync_tables()
+    b.sync_tables()
+    for ta, tb in zip(a.tables, b.tables):
+        assert ta.clock == tb.clock
+        assert ta.n_changes == tb.n_changes
