@@ -51,8 +51,16 @@ HEADLINE_METRIC = ("ops-applied/sec, 10K-doc DocSet merge with "
 # pass ships its own wire bytes and runs its own reconcile; the fixed
 # per-dispatch/per-readback link costs amortize across the pipeline. The
 # value is disclosed in the final record (passes_per_dispatch) and per
-# config (megakernel.breakdown.passes).
+# config (megakernel.breakdown.passes). On the CPU fallback there is no
+# link to amortize and per-pass compute is the cost, so the pipeline is
+# shallow there (coverage matters more than amortization).
 PASSES = 24
+CPU_PASSES = 4
+
+
+def _passes() -> int:
+    import jax
+    return PASSES if jax.default_backend() == "tpu" else CPU_PASSES
 
 
 def _load_package():
@@ -380,7 +388,7 @@ def run_oracle_split(doc_changes):
     return t2 - t0, t1 - t0, t2 - t1, n_first
 
 
-def run_engine(doc_changes, repeat=PASSES):
+def run_engine(doc_changes, repeat=None):
     """Columnar engine: batch assembly + device apply + hash readback.
 
     Encoding to columnar form is *not* timed: per the north-star design the
@@ -405,6 +413,8 @@ def run_engine(doc_changes, repeat=PASSES):
     Returns (apply_time, device_time, encode_time).
     """
     import jax
+    if repeat is None:
+        repeat = _passes()
     import jax.numpy as jnp
     from functools import partial
     from automerge_tpu.engine.encode import encode_doc, stack_docs
@@ -967,7 +977,9 @@ def _final_record(results_by_cfg: dict, backend: str | None, attempts: list):
             rec["baseline_calibration"] = headline["baseline_calibration"]
         if "oracle_linearity" in headline:
             rec["oracle_linearity"] = headline["oracle_linearity"]
-        rec["passes_per_dispatch"] = PASSES
+        # from the worker's own measurement — the parent never inits jax
+        rec["passes_per_dispatch"] = (headline.get("megakernel", {})
+                                      .get("breakdown", {}).get("passes"))
         rec["note"] = ("end-to-end figure is dominated by the tunneled "
                        "single-chip host<->device roundtrip; every device "
                        "config pipelines PASSES identical jobs per "
