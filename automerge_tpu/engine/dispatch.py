@@ -46,6 +46,14 @@ def calibrate(**overrides) -> None:
         _LINK[k] = float(v)
 
 
+# apply_host engages the vectorized bulk build above this many changes per
+# document. Higher than bulkload's own load() threshold (64): bulk's win
+# comes from replacing per-op interpretive application, which pays off
+# later on short CONCURRENT traces (survivor grouping over many actors)
+# than on the single-actor logs load() sees.
+HOST_BULK_MIN_CHANGES = 256
+
+
 @dataclass
 class Plan:
     backend: str          # "device" | "host"
@@ -60,14 +68,12 @@ def plan_batch(n_docs: int, n_ops: int, wire_bytes: int,
     with fixed costs amortized over `passes` identical jobs.
 
     `changes_per_doc` prices the host side with the SAME predicate
-    apply_host executes (bulk build from BULK_MIN_CHANGES changes per
+    apply_host executes (bulk build from HOST_BULK_MIN_CHANGES changes per
     doc); when unknown it is estimated at n_ops/n_docs/2 (ins+set pairs)."""
-    from ..core.bulkload import BULK_MIN_CHANGES
-
     dev = _device_cost(wire_bytes, passes)
     if changes_per_doc is None:
         changes_per_doc = n_ops / max(n_docs, 1) / 2
-    if changes_per_doc >= BULK_MIN_CHANGES:
+    if changes_per_doc >= HOST_BULK_MIN_CHANGES:
         host = n_docs * _LINK["bulk_fixed_s"] + n_ops * _LINK["bulk_op_s"]
     else:
         host = n_ops * _LINK["host_op_s"]
@@ -86,7 +92,6 @@ def plan_for(doc_changes: list, passes: int = 1) -> Plan:
     """Plan (no execution) for a concrete from-scratch batch: estimates the
     wire from the same padded dims pack.py will use, and prices the host
     side per document with apply_host's actual bulk/interpretive predicate."""
-    from ..core.bulkload import BULK_MIN_CHANGES
     from .pack import rows_count
 
     def _pad(n, minimum=8):
@@ -109,7 +114,7 @@ def plan_for(doc_changes: list, passes: int = 1) -> Plan:
     host = 0.0
     for chs in doc_changes:
         doc_ops = sum(len(c.ops) for c in chs)
-        if len(chs) >= BULK_MIN_CHANGES:  # apply_host's own predicate
+        if len(chs) >= HOST_BULK_MIN_CHANGES:  # apply_host's predicate
             host += _LINK["bulk_fixed_s"] + doc_ops * _LINK["bulk_op_s"]
         else:
             host += doc_ops * _LINK["host_op_s"]
@@ -122,11 +127,11 @@ def apply_host(changes, actor_id: str = "engine"):
     interpretive replay. Returns the materialized document (same contract
     as the oracle path the bench compares against)."""
     from ..api import init
-    from ..core.bulkload import BULK_MIN_CHANGES, try_bulk_build
+    from ..core.bulkload import try_bulk_build
     from ..frontend.materialize import apply_changes_to_doc, materialize_root
     from ..native.wire import changes_to_columns
 
-    if len(changes) >= BULK_MIN_CHANGES:
+    if len(changes) >= HOST_BULK_MIN_CHANGES:
         # try_bulk_build owns the fallback contract (GC pause, observable
         # bulkload_fallback_keyerror counter); materialize errors surface
         opset = try_bulk_build(changes_to_columns(changes))

@@ -832,9 +832,16 @@ def run_config(cfg: int, n_docs: int | None = None, oracle_cap_docs=1000):
                                                    plan_for)
         if plan_for(doc_changes).backend == "host":
             plan, res = apply_batch_adaptive(doc_changes)  # warm caches
-            t0 = time.perf_counter()
-            plan, res = apply_batch_adaptive(doc_changes)
-            adaptive_time = time.perf_counter() - t0
+            # millisecond-scale single-doc jobs are timer-noise-dominated:
+            # best-of-3 on BOTH sides
+            adaptive_time = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                plan, res = apply_batch_adaptive(doc_changes)
+                adaptive_time = min(adaptive_time,
+                                    time.perf_counter() - t0)
+            oracle_time = min(oracle_time, run_oracle(doc_changes),
+                              run_oracle(doc_changes))
             doc = am.init("bench")
             want = apply_changes_to_doc(doc, doc._doc.opset, doc_changes[0],
                                         incremental=False)
