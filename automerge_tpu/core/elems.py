@@ -209,6 +209,13 @@ class ElemList:
         if len(nk) <= 2 * CHUNK:
             self._keys[p] = nk
             self._vals[p] = nv
+            # common case: chunk set unchanged — shift the rank cache
+            # incrementally instead of invalidating (a keystroke would
+            # otherwise pay a full O(chunks) rebuild on its next read)
+            if self._cum is not None:
+                cum = self._cum = list(self._cum)
+                for i in range(p + 1, len(cum)):
+                    cum[i] += 1
         else:
             # split: left half keeps the id (most keys stay mapped),
             # right half gets a fresh id and remaps its keys
@@ -220,8 +227,8 @@ class ElemList:
             self._ids[p:p + 1] = [cid, rid]
             for k in nk[half:]:
                 self._kset(k, rid)
-        self._pos = None
-        self._cum = None
+            self._pos = None
+            self._cum = None
         self._flat_k = None
         self._flat_v = None
 
@@ -234,10 +241,14 @@ class ElemList:
         if nk:
             self._keys[p] = nk
             self._vals[p] = cv[:off] + cv[off + 1:]
+            if self._cum is not None:  # chunk set unchanged: shift ranks
+                cum = self._cum = list(self._cum)
+                for i in range(p + 1, len(cum)):
+                    cum[i] -= 1
         else:
             del self._ids[p], self._keys[p], self._vals[p]
-        self._pos = None
-        self._cum = None
+            self._pos = None
+            self._cum = None
         self._flat_k = None
         self._flat_v = None
 
