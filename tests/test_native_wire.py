@@ -118,3 +118,40 @@ class TestReviewRegressions:
         native_changes = parse_changes_json(wire).to_changes()
         py_changes = [coerce_change(c) for c in json.loads(wire)]
         assert native_changes == py_changes
+
+
+class TestConcatColumns:
+    def test_remaps_tables_and_preserves_value_types(self):
+        from automerge_tpu.core.change import Change, Op
+        from automerge_tpu.core.ids import ROOT_ID
+        from automerge_tpu.native.wire import (changes_to_columns,
+                                               concat_columns)
+
+        a = changes_to_columns([Change("X", 1, {}, (
+            Op("set", ROOT_ID, key="k", value=1.5),
+            Op("set", ROOT_ID, key="big", value=2**70),
+        ), "msg-a")])
+        b = changes_to_columns([Change("Y", 1, {"X": 1}, (
+            Op("set", ROOT_ID, key="k", value=True),
+            Op("set", ROOT_ID, key="s", value="str"),
+        ))])
+        m = concat_columns([a, b])
+        chs = m.to_changes()
+        assert [c.actor for c in chs] == ["X", "Y"]
+        assert chs[0].message == "msg-a" and chs[1].message is None
+        assert chs[1].deps == {"X": 1}
+        vals = [op.value for c in chs for op in c.ops]
+        assert vals == [1.5, 2**70, True, "str"]
+        # shared strings interned once across parts
+        assert m.objects.count(ROOT_ID) == 1
+        assert m.keys.count("k") == 1
+
+    def test_single_part_passthrough(self):
+        from automerge_tpu.core.change import Change, Op
+        from automerge_tpu.core.ids import ROOT_ID
+        from automerge_tpu.native.wire import (changes_to_columns,
+                                               concat_columns)
+
+        a = changes_to_columns([Change("X", 1, {}, (
+            Op("set", ROOT_ID, key="k", value=1),))])
+        assert concat_columns([a]) is a
