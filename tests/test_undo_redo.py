@@ -125,3 +125,85 @@ class TestRedo:
         # undo restores both conflicting ops
         assert s1["f"] == "b"
         assert s1._conflicts == {"f": {"A": "a"}}
+
+
+class TestUndoRedoRemoteInteraction:
+    """Reference behaviors around undo/redo interleaved with OTHER actors'
+    changes (test.js:840-849, 871-881, 932-950, 1032-1071)."""
+
+    def test_ignores_other_actors_updates_to_undo_reverted_field(self):
+        # test.js:840 — the undo's inverse op supersedes a remote write the
+        # undoer had already seen
+        s1 = am.change(am.init("A"), lambda d: d.__setitem__("value", 1))
+        s1 = am.change(s1, lambda d: d.__setitem__("value", 2))
+        s2 = am.merge(am.init("B"), s1)
+        s2 = am.change(s2, lambda d: d.__setitem__("value", 3))
+        s1 = am.merge(s1, s2)
+        assert s1["value"] == 3
+        s1 = am.undo(s1)
+        assert s1["value"] == 1
+
+    def test_undo_link_deletion_restores_object(self):
+        # test.js:871 — deleting a link is undone by re-linking the object
+        s1 = am.change(am.init("A"), lambda d: d.__setitem__(
+            "fish", ["trout", "sea bass"]))
+        s1 = am.change(s1, lambda d: d.__setitem__(
+            "birds", ["heron", "magpie"]))
+        s2 = am.change(s1, lambda d: d.__delitem__("fish"))
+        assert "fish" not in s2
+        s2 = am.undo(s2)
+        assert s2["fish"] == ["trout", "sea bass"]
+        assert s2["birds"] == ["heron", "magpie"]
+
+    def test_winding_history_backwards_and_forwards_repeatedly(self):
+        # test.js:932
+        s1 = am.init("A")
+        s1 = am.change(s1, lambda d: d.__setitem__("sparrows", 1))
+        s1 = am.change(s1, lambda d: d.__setitem__("skylarks", 1))
+        s1 = am.change(s1, lambda d: d.__setitem__("sparrows", 2))
+        s1 = am.change(s1, lambda d: d.__delitem__("skylarks"))
+        states = [{}, {"sparrows": 1}, {"sparrows": 1, "skylarks": 1},
+                  {"sparrows": 2, "skylarks": 1}, {"sparrows": 2}]
+        for _ in range(3):
+            for undo in range(len(states) - 2, -1, -1):
+                s1 = am.undo(s1)
+                assert am.equals(am.inspect(s1), states[undo])
+            for redo in range(1, len(states)):
+                s1 = am.redo(s1)
+                assert am.equals(am.inspect(s1), states[redo])
+
+    def test_redo_assignments_by_other_actors_preceding_undo(self):
+        # test.js:1032
+        s1 = am.change(am.init("A"), lambda d: d.__setitem__("value", 1))
+        s1 = am.change(s1, lambda d: d.__setitem__("value", 2))
+        s2 = am.merge(am.init("B"), s1)
+        s2 = am.change(s2, lambda d: d.__setitem__("value", 3))
+        s1 = am.merge(s1, s2)
+        s1 = am.undo(s1)
+        assert s1["value"] == 1
+        s1 = am.redo(s1)
+        assert s1["value"] == 3
+
+    def test_overwrite_assignments_by_other_actors_following_undo(self):
+        # test.js:1046
+        s1 = am.change(am.init("A"), lambda d: d.__setitem__("value", 1))
+        s1 = am.change(s1, lambda d: d.__setitem__("value", 2))
+        s1 = am.undo(s1)
+        s2 = am.merge(am.init("B"), s1)
+        s2 = am.change(s2, lambda d: d.__setitem__("value", 3))
+        s1 = am.merge(s1, s2)
+        assert s1["value"] == 3
+        s1 = am.redo(s1)
+        assert s1["value"] == 2
+
+    def test_redo_merges_with_concurrent_changes_to_other_fields(self):
+        # test.js:1060
+        s1 = am.change(am.init("A"), lambda d: d.__setitem__("trout", 2))
+        s1 = am.change(s1, lambda d: d.__setitem__("trout", 3))
+        s1 = am.undo(s1)
+        s2 = am.merge(am.init("B"), s1)
+        s2 = am.change(s2, lambda d: d.__setitem__("salmon", 1))
+        s1 = am.merge(s1, s2)
+        assert s1["trout"] == 2 and s1["salmon"] == 1
+        s1 = am.redo(s1)
+        assert s1["trout"] == 3 and s1["salmon"] == 1
