@@ -1042,6 +1042,65 @@ def worker_main(args):
     sys.exit(rc)
 
 
+def _run_worker(cmd: list[str], budget: float):
+    """Run one worker attempt with BOTH a wall-clock budget and an early
+    hang detector: a worker that has not printed its BACKEND line within
+    AMTPU_BENCH_INIT_TIMEOUT seconds is stuck in device-backend init (the
+    tunnel hangs rather than raising when its upstream is down — observed
+    for hours at a stretch) and is killed immediately so the CPU fallback
+    gets the budget instead. Returns (stdout, stderr, rc)."""
+    import threading
+
+    init_timeout = float(os.environ.get("AMTPU_BENCH_INIT_TIMEOUT", "240"))
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    out_lines: list[str] = []
+    err_chunks: list[str] = []
+    saw_backend = threading.Event()
+
+    def read_out():
+        for line in proc.stdout:
+            out_lines.append(line)
+            if line.startswith("BACKEND "):
+                saw_backend.set()
+
+    def read_err():
+        err_chunks.append(proc.stderr.read() or "")
+
+    t_out = threading.Thread(target=read_out, daemon=True)
+    t_err = threading.Thread(target=read_err, daemon=True)
+    t_out.start()
+    t_err.start()
+
+    start = time.time()
+    rc: object = None
+    while True:
+        ret = proc.poll()
+        if ret is not None:
+            rc = ret
+            break
+        elapsed = time.time() - start
+        # init-hang check FIRST: even when the attempt budget is smaller
+        # than the init timeout, a worker that never reported its backend
+        # must be classified as a hang (the recurrence guard keys on it)
+        if not saw_backend.is_set() and elapsed >= min(init_timeout, budget):
+            rc = "backend-init-hang"
+            break
+        if elapsed >= budget:
+            rc = "timeout"
+            break
+        time.sleep(0.5)
+    if not isinstance(rc, int):
+        proc.kill()
+        try:
+            proc.wait(timeout=10)  # reap; releases pipes/tunnel handles
+        except Exception:
+            pass
+    t_out.join(timeout=10)
+    t_err.join(timeout=10)
+    return "".join(out_lines), "".join(err_chunks), rc
+
+
 def parent_main(args, passthrough: list[str]):
     """Never-crash orchestrator: worker subprocess per attempt, wall-clock
     timeout, partial-result harvesting, CPU fallback, exit 0 always."""
@@ -1067,6 +1126,11 @@ def parent_main(args, passthrough: list[str]):
         # rather than burning it on a possibly-hanging TPU tunnel.
         if remaining < 240 and not force_cpu:
             continue
+        # A backend-init hang recurs (the tunnel stays down for hours when
+        # its upstream dies): don't pay for a second TPU attempt.
+        if not force_cpu and any(a["rc"] == "backend-init-hang"
+                                 for a in attempts):
+            continue
         attempts_left = len(plan) - attempt + 1
         budget = (max(20, int(remaining)) if force_cpu
                   else max(60, int(remaining / attempts_left)))
@@ -1079,15 +1143,7 @@ def parent_main(args, passthrough: list[str]):
         backend = None
         finished = False
         try:
-            proc = subprocess.run(cmd, capture_output=True, text=True,
-                                  timeout=budget)
-            out, err, rc = proc.stdout, proc.stderr, proc.returncode
-        except subprocess.TimeoutExpired as e:
-            out = (e.stdout or b"").decode("utf-8", "replace") \
-                if isinstance(e.stdout, bytes) else (e.stdout or "")
-            err = (e.stderr or b"").decode("utf-8", "replace") \
-                if isinstance(e.stderr, bytes) else (e.stderr or "")
-            rc = "timeout"
+            out, err, rc = _run_worker(cmd, budget)
         except Exception as e:  # spawn failure itself
             out, err, rc = "", repr(e), "spawn-error"
         for line in err.splitlines()[-40:]:
