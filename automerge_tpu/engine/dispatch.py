@@ -46,6 +46,31 @@ def calibrate(**overrides) -> None:
         _LINK[k] = float(v)
 
 
+def calibrate_from_profile(profile: dict) -> dict:
+    """Update the link model from a profile_tunnel.py record (the repo-root
+    dev tool's JSON). Returns the constants actually applied. Unknown or
+    missing fields are skipped — partial profiles calibrate partially."""
+    applied = {}
+    h2d = profile.get("h2d_ms_by_mb") or {}
+    if "0.001" in h2d:
+        applied["h2d_call_s"] = float(h2d["0.001"]) / 1e3
+    sizes = sorted((float(mb), float(ms)) for mb, ms in h2d.items()
+                   if float(mb) >= 1)
+    if len(sizes) >= 2:
+        (mb0, ms0), (mb1, ms1) = sizes[0], sizes[-1]
+        if ms1 > ms0:
+            applied["h2d_bytes_per_s"] = ((mb1 - mb0) * 1e6
+                                          / ((ms1 - ms0) / 1e3))
+    if "d2h_512B_ms" in profile:
+        applied["d2h_call_s"] = float(profile["d2h_512B_ms"]) / 1e3
+    if "tiny_dispatch_plus_readback_ms" in profile:
+        total = float(profile["tiny_dispatch_plus_readback_ms"]) / 1e3
+        applied["dispatch_fixed_s"] = max(
+            total - applied.get("d2h_call_s", _LINK["d2h_call_s"]), 1e-4)
+    calibrate(**applied)
+    return applied
+
+
 # apply_host engages the vectorized bulk build above this many changes per
 # document. Higher than bulkload's own load() threshold (64): bulk's win
 # comes from replacing per-op interpretive application, which pays off
