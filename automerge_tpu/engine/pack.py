@@ -322,3 +322,114 @@ def apply_rows_hash_bytes(wire_u8, bmeta: tuple, dims: tuple,
     from .pallas_kernels import reconcile_rows_hash
     rows = widen_bytes(wire_u8, bmeta)
     return reconcile_rows_hash.__wrapped__(rows, dims, interpret)
+
+
+# ---------------------------------------------------------------------------
+# Field-sharding wide documents across virtual doc columns
+#
+# Survivor analysis only ever joins ops that share a field id, and the state
+# hash is a commutative uint32 SUM over surviving assigns (kernels.state_hash)
+# — so a wide document can be partitioned BY FIELD into several virtual
+# documents whose hashes add back to the real document's hash exactly. This
+# turns per-doc op count from a VMEM bound into a docs-axis parallelism
+# bound: a 2048-op map document becomes four 512-op lane columns. List
+# objects are atomic (their elements' rank join spans the list), so every
+# list field group rides virtual doc 0 with the doc's insertion tables;
+# make/ins op rows carry no kernel state (amask needs action >= set, and
+# insertion data travels in the ins tables) and are dropped outright.
+
+def shard_batch_by_fields(batch: dict, max_fids: int, target_ops: int = 512):
+    """Split docs with more than `target_ops` assigns into field-disjoint
+    virtual docs of at most `target_ops` assigns each.
+
+    Returns (sharded_batch, owner): owner[v] = real doc index of virtual doc
+    v; real_hash[d] = uint32 sum of virtual hashes with owner == d."""
+    from .encode import A_SET
+
+    d, i = batch["op_mask"].shape
+    om = np.asarray(batch["op_mask"])
+    action = np.asarray(batch["action"])
+    fid = np.asarray(batch["fid"])
+    ins_mask = np.asarray(batch["ins_mask"])
+    ins_fid = np.asarray(batch["ins_fid"])
+
+    virtuals: list[tuple[int, np.ndarray, bool]] = []  # (owner, op_idx, ins)
+    max_bin = 1
+    for dd in range(d):
+        assigns = np.nonzero(om[dd] & (action[dd] >= A_SET))[0]
+        if len(assigns) <= target_ops:
+            virtuals.append((dd, assigns, True))
+            max_bin = max(max_bin, len(assigns))
+            continue
+        list_fids = set(ins_fid[dd][ins_mask[dd]].tolist())
+        list_fids.discard(-1)
+        f_of = fid[dd][assigns]
+        is_list_op = np.isin(f_of, list(list_fids)) if list_fids \
+            else np.zeros(len(assigns), bool)
+        bins: list[list[np.ndarray]] = [[assigns[is_list_op]]]
+        sizes = [int(is_list_op.sum())]
+        # group map assigns by fid, largest groups first (greedy best-fit)
+        map_ops = assigns[~is_list_op]
+        if len(map_ops):
+            mf = fid[dd][map_ops]
+            order = np.argsort(mf, kind="stable")
+            srt = map_ops[order]
+            fs = mf[order]
+            bounds = np.nonzero(np.r_[True, fs[1:] != fs[:-1]])[0]
+            groups = [srt[lo:hi] for lo, hi in
+                      zip(bounds, np.r_[bounds[1:], len(srt)])]
+            groups.sort(key=len, reverse=True)
+            for g in groups:
+                placed = False
+                for b in range(len(bins)):
+                    if sizes[b] + len(g) <= target_ops:
+                        bins[b].append(g)
+                        sizes[b] += len(g)
+                        placed = True
+                        break
+                if not placed:
+                    bins.append([g])
+                    sizes.append(len(g))
+        for b, parts in enumerate(bins):
+            idx = np.concatenate(parts) if parts else np.zeros(0, np.int64)
+            virtuals.append((dd, idx, b == 0))
+            max_bin = max(max_bin, len(idx))
+
+    i_t = 8
+    while i_t < max_bin:
+        i_t *= 2
+    owner = np.fromiter((v[0] for v in virtuals), np.int64, len(virtuals))
+    V = len(virtuals)
+
+    out = {}
+    fills = {"op_mask": False, "action": -1, "fid": -1, "value": -1}
+    for name in ("op_mask", "action", "fid", "actor", "seq", "change_idx",
+                 "value", "fid_hash", "value_hash"):
+        src = np.asarray(batch[name])
+        fill = fills.get(name, 0)
+        arr = np.full((V, i_t), fill, dtype=src.dtype)
+        for v, (dd, idx, _ins) in enumerate(virtuals):
+            arr[v, :len(idx)] = src[dd, idx]
+        out[name] = arr
+    clock = np.asarray(batch["clock"])
+    out["clock"] = clock[owner]
+    for name in ("ins_mask", "ins_elem", "ins_actor", "ins_parent",
+                 "ins_fid", "ins_pos", "list_obj", "list_obj_hash"):
+        src = np.asarray(batch[name])
+        fill = {"ins_mask": False, "ins_elem": 0, "ins_actor": 0}.get(
+            name, -1)
+        arr = np.full((V,) + src.shape[1:], fill, dtype=src.dtype)
+        for v, (dd, _idx, takes_ins) in enumerate(virtuals):
+            if takes_ins:
+                arr[v] = src[dd]
+        out[name] = arr
+    return out, owner
+
+
+def recombine_hashes(virtual_hashes: np.ndarray, owner: np.ndarray,
+                     n_docs: int) -> np.ndarray:
+    """real_hash[d] = uint32 wraparound sum of its virtual docs' hashes."""
+    out = np.zeros(n_docs, np.uint32)
+    np.add.at(out, owner, np.asarray(virtual_hashes)[:len(owner)]
+              .astype(np.uint32))
+    return out

@@ -108,3 +108,46 @@ def test_compact_wide_values_fall_back_to_int32():
         jax.numpy.asarray(b32), meta))
     np.testing.assert_array_equal(rebuilt, rows)
     assert b32.shape[0] >= 24  # the three hash groups stay 32-bit
+
+
+def test_field_sharded_virtual_docs_recombine_exactly():
+    """A wide map document (2 actors x many LWW sets, config-1 shape) splits
+    into field-disjoint virtual docs whose megakernel hashes SUM back to the
+    real document's hash — survivor analysis is per-field independent and
+    the state hash is a commutative uint32 sum."""
+    from automerge_tpu.engine.batchdoc import apply_batch
+    from automerge_tpu.engine.pack import (recombine_hashes,
+                                           shard_batch_by_fields)
+
+    docs = []
+    for rep in range(2):
+        a = am.init("A")
+        for i in range(150):
+            a = am.change(a, lambda d, i=i: d.__setitem__(
+                f"k{i % 40}", f"A{i}"))
+        b = am.merge(am.init("B"), a)
+        b = am.change(b, lambda d: d.__setitem__("xs", [1, 2]))
+        for i in range(120):
+            b = am.change(b, lambda d, i=i: d.__setitem__(
+                f"k{i % 40}", f"B{i}"))
+        b = am.change(b, lambda d: d["xs"].insert_at(1, 9))
+        m = am.merge(a, b)
+        docs.append(m._doc.opset.get_missing_changes({}))
+    # plus one small doc that must pass through whole
+    small = am.change(am.init("C"), lambda d: am.assign(d, {"n": 1}))
+    docs.append(small._doc.opset.get_missing_changes({}))
+
+    batch, max_fids = _batch_of(docs)
+    n = len(docs)
+    sharded, owner = shard_batch_by_fields(batch, max_fids, target_ops=64)
+    assert len(owner) > n, "wide docs did not split"
+    assert sharded["op_mask"].shape[1] <= 128  # virtual op axis shrank
+    assert rows_eligible(sharded, max_fids)
+    rows, dims, nv = pack_rows(sharded, max_fids)
+    interp = jax.default_backend() != "tpu"
+    vh = np.asarray(apply_rows_hash(jax.numpy.asarray(rows), dims, nv,
+                                    interpret=interp))
+    got = recombine_hashes(vh, owner, n)
+    _, _, ref = apply_batch(docs)
+    want = np.asarray(ref["hash"])[:n].astype(np.uint32)
+    np.testing.assert_array_equal(got, want)
