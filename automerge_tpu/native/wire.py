@@ -42,21 +42,9 @@ class WireColumns:
 
     def op_value(self, j: int):
         """Decode op j's scalar value (None for absent/null)."""
-        tag = self.op_vtag[j]
-        if tag in (V_NONE, V_NULL):
-            return None
-        if tag == V_TRUE:
-            return True
-        if tag == V_FALSE:
-            return False
-        if tag == V_INT:
-            return int(self.op_vint[j])
-        if tag == V_DOUBLE:
-            return float(self.op_vdbl[j])
-        if tag == V_BIGINT:
-            # integer token outside int64 range, carried verbatim
-            return int(self.strings[self.op_vstr[j]])
-        return self.strings[self.op_vstr[j]]
+        return _decode_vtag(int(self.op_vtag[j]), int(self.op_vint[j]),
+                            float(self.op_vdbl[j]), int(self.op_vstr[j]),
+                            self.strings)
 
     def deps_at(self, i: int) -> dict:
         """Change i's dependency frontier as {actor: seq}."""
@@ -85,15 +73,84 @@ class WireColumns:
                       int(self.change_seq[i]), self.deps_at(i), ops, msg)
 
     def to_changes(self):
-        """Materialize Change objects from the columns. (The column-direct
-        engine ingest path that skips Change construction entirely is
-        native/delta.py + ResidentDocSet.apply_columns; this is the
-        interactive-frontend fallback.)"""
-        return [self.change_at(i) for i in range(self.n_changes)]
+        """Materialize Change objects from the columns, bulk-converting
+        every column to plain lists first (numpy scalar indexing costs ~3x
+        list indexing — this loop is the host ingress floor when columns
+        must become interactive Change objects). (The column-direct engine
+        ingest path that skips Change construction entirely is
+        native/delta.py + ResidentDocSet.apply_columns.)"""
+        from ..core.change import Change, Op
+        from ..storage import _ACTIONS
+
+        n = self.n_changes
+        if n == 0:
+            return []
+        ch_actor = np.asarray(self.change_actor).tolist()
+        ch_seq = np.asarray(self.change_seq).tolist()
+        ch_msg = np.asarray(self.change_msg).tolist()
+        d_off = np.asarray(self.deps_off).tolist()
+        d_actor = np.asarray(self.deps_actor).tolist()
+        d_seq = np.asarray(self.deps_seq).tolist()
+        o_off = np.asarray(self.op_off).tolist()
+        o_act = np.asarray(self.op_action).tolist()
+        o_obj = np.asarray(self.op_obj).tolist()
+        o_key = np.asarray(self.op_key).tolist()
+        o_elem = np.asarray(self.op_elem).tolist()
+        o_vtag = np.asarray(self.op_vtag).tolist()
+        o_vint = np.asarray(self.op_vint).tolist()
+        o_vdbl = np.asarray(self.op_vdbl).tolist()
+        o_vstr = np.asarray(self.op_vstr).tolist()
+        actors, objects, keys = self.actors, self.objects, self.keys
+        messages, strings = self.messages, self.strings
+        new_op = Op.__new__
+
+        out = []
+        for i in range(n):
+            ops = []
+            for j in range(o_off[i], o_off[i + 1]):
+                action = _ACTIONS[o_act[j]]
+                value = None
+                if action in ("set", "link"):
+                    value = _decode_vtag(o_vtag[j], o_vint[j], o_vdbl[j],
+                                         o_vstr[j], strings)
+                op = new_op(Op)
+                op.action = action
+                op.obj = objects[o_obj[j]]
+                op.key = keys[o_key[j]] if o_key[j] >= 0 else None
+                op.value = value
+                op.elem = o_elem[j] if o_elem[j] >= 0 else None
+                op.actor = None
+                op.seq = None
+                ops.append(op)
+            deps = {actors[d_actor[k]]: d_seq[k]
+                    for k in range(d_off[i], d_off[i + 1])}
+            msg = messages[ch_msg[i]] if ch_msg[i] >= 0 else None
+            out.append(Change(actors[ch_actor[i]], ch_seq[i], deps, ops,
+                              msg))
+        return out
 
 
 _I64_MIN = -(2 ** 63)
 _I64_MAX = 2 ** 63 - 1
+
+
+def _decode_vtag(tag, vint, vdbl, vstr, strings):
+    """THE value-tag decode (one source of truth for per-change and bulk
+    materialization paths)."""
+    if tag == V_INT:
+        return vint
+    if tag == V_STR:
+        return strings[vstr]
+    if tag == V_DOUBLE:
+        return vdbl
+    if tag == V_TRUE:
+        return True
+    if tag == V_FALSE:
+        return False
+    if tag == V_BIGINT:
+        # integer token outside int64 range, carried verbatim
+        return int(strings[vstr])
+    return None  # V_NONE / V_NULL
 
 
 class _Interner:

@@ -176,13 +176,11 @@ class RoundColumns:
         self.change_off = change_off
         self.cols = cols
 
-    def changes_of(self, k: int) -> list[Change]:
-        return [self.cols.change_at(j)
-                for j in range(int(self.change_off[k]),
-                               int(self.change_off[k + 1]))]
-
     def to_dict(self) -> dict[str, list[Change]]:
-        return {d: self.changes_of(k) for k, d in enumerate(self.doc_ids)}
+        chs = self.cols.to_changes()  # bulk materialization, one pass
+        off = self.change_off
+        return {d: chs[int(off[k]):int(off[k + 1])]
+                for k, d in enumerate(self.doc_ids)}
 
 
 def encode_round_frame(deltas: dict[str, list[Change]]) -> bytes:

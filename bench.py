@@ -658,9 +658,12 @@ def run_resident_rounds(doc_changes, n_rounds=12, fraction=0.2):
 
     On TPU the engine path is the docs-minor resident state
     (`resident_rows.ResidentRowsDocSet`): all rounds of the micro-batch run
-    in ONE device dispatch (lax.scan of scatter+megakernel), which is the
-    posture of a streaming sync service on a link where each dispatch has a
-    large fixed cost. Elsewhere the docs-major per-round path is used.
+    in ONE device dispatch, the posture of a streaming sync service on a
+    link where each dispatch has a large fixed cost. On non-accelerator
+    backends (the CPU fallback) there is no link to amortize, so the
+    dispatch router's answer is the HOST incremental path — the engine
+    then measures host apply from its real wire (binary round frames,
+    bulk-materialized), vs the oracle's per-op JSON wire.
 
     Returns (engine_round_s, oracle_round_s, ops_per_round).
     """
@@ -669,8 +672,6 @@ def run_resident_rounds(doc_changes, n_rounds=12, fraction=0.2):
     import jax as _jax
 
     from automerge_tpu.core.change import Change
-    from automerge_tpu.engine.resident import ResidentDocSet
-    from automerge_tpu.sync.frames import decode_frame, encode_frame
 
     rng = random.Random(3)
     n = len(doc_changes)
@@ -764,17 +765,11 @@ def run_resident_rounds(doc_changes, n_rounds=12, fraction=0.2):
                             for c in d)
         return engine_round, oracle_round, ops_per_round
 
-    resident = ResidentDocSet(doc_ids)
-    resident.apply_changes({doc_ids[i]: doc_changes[i] for i in range(n)})
-    # Pre-size for the incremental horizon: each round appends one 1-op
-    # change per touched doc. Without the reservation a capacity doubling
-    # mid-run changes the resident shapes and forces a multi-second XLA
-    # recompile in the middle of the timed loop.
-    resident.reserve(
-        ops_per_doc=int(resident.op_count.max()) + n_rounds + 1,
-        changes_per_doc=int(resident.change_count.max()) + n_rounds + 1)
-    resident.reconcile()  # warm state + compile
-
+    # Non-accelerator backend (the CPU fallback): there are no fixed link
+    # costs to amortize, so the dispatch router's answer for incremental
+    # sync IS the host incremental path (engine/dispatch.py's logic). The
+    # engine's edge over the reference here is the WIRE: binary columnar
+    # frames decoded by numpy views vs per-op JSON parse + dict folding.
     changed = rng.sample(range(n), max(1, int(n * fraction)))
     rounds = []
     for rnd in range(n_rounds):
@@ -787,29 +782,35 @@ def run_resident_rounds(doc_changes, n_rounds=12, fraction=0.2):
                 prev._doc.opset.clock)
             docs[i] = new
         rounds.append(deltas)
-    frame_rounds = [{d: encode_frame(chs) for d, chs in r.items()}
-                    for r in rounds]
+    from automerge_tpu.sync.frames import decode_round_frame, \
+        encode_round_frame
+    wire_frames = [encode_round_frame(r) for r in rounds]
 
-    # engine rounds via the fused single-dispatch path (first one warms the
-    # delta-shape compile). The timed region starts from the wire frames —
-    # the service's real ingress: frame decode + delta encode (native C++
-    # when available) + scatter + reconcile + hash readback.
-    resident.apply_and_reconcile(rounds[0])
+    eng_docs = {i: apply_changes_to_doc(
+        am.init("e"), am.init("e2")._doc.opset, doc_changes[i],
+        incremental=False) for i in changed}
+    # settle residual async/GC work from the preceding device measurements
+    # (both timed loops get the same barrier, or the first-measured side
+    # absorbs it and the comparison skews)
+    import gc
+    gc.collect()
+    time.sleep(0.3)
     t0 = time.perf_counter()
-    for frames in frame_rounds[1:]:
-        if resident._native is not None:
-            cols = {d: decode_frame(f) for d, f in frames.items()}
-            resident.apply_and_reconcile_columns(cols)
-        else:
-            deltas = {d: decode_frame(f).to_changes()
-                      for d, f in frames.items()}
-            resident.apply_and_reconcile(deltas)
-    engine_round = (time.perf_counter() - t0) / max(len(rounds) - 1, 1)
+    for f in wire_frames:
+        rc_round = decode_round_frame(f)
+        per_doc = rc_round.to_dict()
+        for i in changed:
+            doc = eng_docs[i]
+            eng_docs[i] = apply_changes_to_doc(
+                doc, doc._doc.opset, per_doc[doc_ids[i]], incremental=True)
+    engine_round = (time.perf_counter() - t0) / len(rounds)
 
     # oracle rounds from its real wire (JSON parse + incremental apply)
     oracle_docs = {i: apply_changes_to_doc(am.init("o"), am.init("o2")._doc.opset,
                                            doc_changes[i], incremental=False)
                    for i in changed}
+    gc.collect()
+    time.sleep(0.3)
     json_rounds = _oracle_wire_rounds(rounds)
     t0 = time.perf_counter()
     for jdeltas in json_rounds:
