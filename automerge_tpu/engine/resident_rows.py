@@ -20,6 +20,7 @@ from-scratch batch path.
 
 from __future__ import annotations
 
+import contextlib
 from functools import partial
 
 import numpy as np
@@ -35,6 +36,16 @@ from ..utils import metrics
 
 def _ceil128(n: int) -> int:
     return ((n + 127) // 128) * 128
+
+
+class DeviceDispatchError(RuntimeError):
+    """The device dispatch of an already-admitted batch failed (plausible on
+    the tunneled TPU). Host truth — change_log, per-doc clocks, and the
+    rows_host mirror (kept current by _cols_triplets BEFORE dispatch) — is
+    fully consistent; only the device buffer is suspect, and the engine has
+    marked itself dirty so the next dispatch re-uploads the mirror. Callers
+    must NOT replay the ingress: the clock dedup would drop it while the log
+    already records it as admitted."""
 
 
 class ResidentRowsDocSet(ResidentDocSet):
@@ -512,6 +523,108 @@ class ResidentRowsDocSet(ResidentDocSet):
         return trips
 
     # ------------------------------------------------------------------
+    # failure recovery (ADVICE r3): every apply path runs
+    #   precheck -> admission (change_log/clock dicts) -> mirror scatter
+    #   (rows_host) -> device dispatch
+    # and each stage can fail with host state progressively ahead of the
+    # device. The guards keep the instance consistent at every boundary.
+
+    @contextlib.contextmanager
+    def _dispatch_guard(self):
+        """Wrap the device dispatch/readback. Host truth — change_log,
+        clocks, and the rows_host mirror — is already fully updated when
+        the dispatch runs, so the cheap recovery is: drop the (possibly
+        donated-away) device buffer, mark dirty so the next dispatch
+        re-uploads the mirror, and raise the typed error so the sync
+        service knows the admission SUCCEEDED and must not be replayed."""
+        try:
+            yield
+        except Exception as e:
+            self.rows_dev = None
+            self._dirty = True
+            self._hash_handle = None
+            metrics.bump("rows_dispatch_failed")
+            raise DeviceDispatchError(str(e)) from e
+
+    @contextlib.contextmanager
+    def _admission_guard(self):
+        """Wrap the admission + mirror-scatter region. A failure midway
+        (encoder error, grow/copy MemoryError, the defensive budget check)
+        can leave change_log/clocks ahead of the rows_host mirror — a state
+        no retry can fix incrementally, because the clock dedup would drop
+        the replay. If anything was admitted, rebuild row state from the
+        authoritative log and report the batch as admitted (typed error);
+        if nothing was admitted, the original error propagates and the
+        caller may safely retry the ingress."""
+        log_lens = [len(log) for log in self.change_log]
+        try:
+            yield
+        except DeviceDispatchError:
+            raise  # dispatch guard already recovered; admission stands
+        except Exception as e:
+            if any(len(log) != n
+                   for log, n in zip(self.change_log, log_lens)):
+                if getattr(self, "_rebuilding", False):
+                    # a rebuild replay must not trigger a nested rebuild
+                    # (the failure is deterministic) — poison and fail fast
+                    self._poison(e)
+                    raise
+                metrics.bump("rows_rebuilt_from_log")
+                self._rebuild_from_log()
+                raise DeviceDispatchError(str(e)) from e
+            raise
+
+    def _poison(self, cause) -> None:
+        self._poisoned = (f"resident row state no longer reflects the "
+                          f"admitted change log ({cause!r}); rebuild the "
+                          f"node from its durable log")
+        metrics.bump("rows_poisoned")
+
+    def _check_poisoned(self) -> None:
+        msg = getattr(self, "_poisoned", None)
+        if msg:
+            raise RuntimeError(msg)
+
+    def _rebuild_from_log(self) -> None:
+        """Disaster recovery: reconstruct the whole instance from the
+        admitted change log (the authoritative record) plus any causally-
+        buffered queue payloads, then adopt the fresh state in place. A
+        device outage during the rebuild is fine — the fresh instance's
+        own dispatch guard leaves it host-consistent and dirty, and the
+        next read re-uploads its mirror. If the replay fails for any OTHER
+        reason (the original failure was deterministic, e.g. the batch
+        genuinely exceeds capacity), the instance is poisoned: serving
+        reads would silently drop admitted changes, so every later
+        apply/read raises loudly instead."""
+        from .resident import AdmittedRef
+
+        docs = list(self.doc_ids)
+        round_: dict[str, list] = {}
+        for i, d in enumerate(docs):
+            chs = [c.change() if isinstance(c, AdmittedRef) else c
+                   for c in self.change_log[i]]
+            for p in self.tables[i].queue:
+                pay = p.payload
+                chs.append(AdmittedRef(*pay).change()
+                           if isinstance(pay, tuple) else pay)
+            if chs:
+                round_[d] = chs
+        fresh = ResidentRowsDocSet(docs, actors=list(self.actors),
+                                   native=self._native is not None)
+        fresh._rebuilding = True
+        try:
+            if round_:
+                fresh.apply_rounds([round_])
+        except DeviceDispatchError:
+            pass
+        except Exception as e:
+            self._poison(e)
+            raise
+        fresh._rebuilding = False
+        self.__dict__.clear()
+        self.__dict__.update(fresh.__dict__)
+
+    # ------------------------------------------------------------------
     # device path
 
     def apply_rounds(self, rounds, interpret: bool | None = None):
@@ -531,6 +644,7 @@ class ResidentRowsDocSet(ResidentDocSet):
         `hashes()` call after the batch). The FINAL round's hash always
         equals the canonical post-batch hash.
         """
+        self._check_poisoned()
         if self._native is not None:
             from ..native.wire import changes_to_columns
             return self.apply_rounds_cols(
@@ -541,10 +655,12 @@ class ResidentRowsDocSet(ResidentDocSet):
         for r in rounds:
             self._register_actors(r)
         self._reserve_for(rounds)
-        pre_rows = self.rows_host.copy() \
-            if self._dirty or self.rows_dev is None else None
-        trip_list = [self._round_triplets(r) for r in rounds]
-        return self._dispatch_rounds(trip_list, pre_rows, interpret)
+        with self._admission_guard():
+            pre_rows = self.rows_host.copy() \
+                if self._dirty or self.rows_dev is None else None
+            trip_list = [self._round_triplets(r) for r in rounds]
+            with self._dispatch_guard():
+                return self._dispatch_rounds(trip_list, pre_rows, interpret)
 
     def apply_rounds_cols(self, rounds, interpret: bool | None = None):
         """Columnar-native variant of apply_rounds: each round maps doc_id ->
@@ -554,6 +670,7 @@ class ResidentRowsDocSet(ResidentDocSet):
         admission and clock rows stay per-CHANGE Python, as in the base
         class's apply_columns). Same return and actor-universe semantics as
         apply_rounds."""
+        self._check_poisoned()
         if self._native is None:
             return self.apply_rounds(
                 [{d: c.to_changes() for d, c in r.items()} for r in rounds],
@@ -566,12 +683,14 @@ class ResidentRowsDocSet(ResidentDocSet):
         # (seen-sets, clocks, change logs, C++ tables); afterwards the
         # instance could no longer retry the same changes.
         self._precheck_rows_budget_cols(rounds)
-        encoded = [self._native_encode_round(r) for r in rounds]
-        self._grow_for_rounds(encoded)
-        pre_rows = self.rows_host.copy() \
-            if self._dirty or self.rows_dev is None else None
-        trip_list = [self._cols_triplets(e) for e in encoded]
-        return self._dispatch_rounds(trip_list, pre_rows, interpret)
+        with self._admission_guard():
+            encoded = [self._native_encode_round(r) for r in rounds]
+            self._grow_for_rounds(encoded)
+            pre_rows = self.rows_host.copy() \
+                if self._dirty or self.rows_dev is None else None
+            trip_list = [self._cols_triplets(e) for e in encoded]
+            with self._dispatch_guard():
+                return self._dispatch_rounds(trip_list, pre_rows, interpret)
 
     def _dispatch_rounds(self, trip_list, pre_rows, interpret):
         p = _pad_to(max((len(t) for t in trip_list), default=1), 8)
@@ -793,6 +912,7 @@ class ResidentRowsDocSet(ResidentDocSet):
         """
         from ..sync.frames import RoundColumns, decode_round_frame
 
+        self._check_poisoned()
         rounds = [f if isinstance(f, RoundColumns) else decode_round_frame(f)
                   for f in frames]
         if self._native is None:
@@ -819,19 +939,22 @@ class ResidentRowsDocSet(ResidentDocSet):
             # encode for the whole micro-batch; falls back to per-round
             # encode (full protocol handling) when any change breaks the
             # per-doc in-order chain shape
-            enc_all = self._encode_rounds_batched(rounds)
-            if enc_all is not None:
-                metrics.bump("rows_rounds_batched", len(rounds))
-                encoded = [enc_all]
-            else:
-                if any(rc.cols.n_changes for rc in rounds):
-                    metrics.bump("rows_rounds_fallback", len(rounds))
-                encoded = [self._encode_round_frame(rc) for rc in rounds]
-            self._grow_for_rounds(encoded)
-            pre_rows = self.rows_host.copy() \
-                if self._dirty or self.rows_dev is None else None
-            trip_list = [self._cols_triplets(e) for e in encoded]
-            return self._dispatch_final(trip_list, pre_rows, interpret)
+            with self._admission_guard():
+                enc_all = self._encode_rounds_batched(rounds)
+                if enc_all is not None:
+                    metrics.bump("rows_rounds_batched", len(rounds))
+                    encoded = [enc_all]
+                else:
+                    if any(rc.cols.n_changes for rc in rounds):
+                        metrics.bump("rows_rounds_fallback", len(rounds))
+                    encoded = [self._encode_round_frame(rc) for rc in rounds]
+                self._grow_for_rounds(encoded)
+                pre_rows = self.rows_host.copy() \
+                    if self._dirty or self.rows_dev is None else None
+                trip_list = [self._cols_triplets(e) for e in encoded]
+                with self._dispatch_guard():
+                    return self._dispatch_final(trip_list, pre_rows,
+                                                interpret)
         finally:
             if was_enabled:
                 gc.enable()
@@ -1328,17 +1451,24 @@ class ResidentRowsDocSet(ResidentDocSet):
         """Current per-doc state hashes from resident state. Cached between
         deltas: every apply path ends in a dispatch that already computed
         them, so polling this does not re-dispatch the reconcile kernel."""
+        self._check_poisoned()
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
-        if self.rows_dev is None or self._dirty:
-            self.rows_dev = jnp.asarray(self.rows_host)
-            self._dirty = False
-            self._hash_handle = None
-        h = getattr(self, "_hash_handle", None)
-        if h is None:
-            h = reconcile_rows_hash(self.rows_dev, self.dims(), interpret)
-            self._hash_handle = h
-        return np.asarray(h)[:len(self.doc_ids)]
+        # The dispatch is async: a tunnel failure during execution often
+        # surfaces HERE, at the readback barrier, not at dispatch time. The
+        # same recovery applies — the host mirror is authoritative, so drop
+        # the buffer, mark dirty, and let the next call re-upload + retry.
+        with self._dispatch_guard():
+            if self.rows_dev is None or self._dirty:
+                self.rows_dev = jnp.asarray(self.rows_host)
+                self._dirty = False
+                self._hash_handle = None
+            h = getattr(self, "_hash_handle", None)
+            if h is None:
+                h = reconcile_rows_hash(self.rows_dev, self.dims(),
+                                        interpret)
+                self._hash_handle = h
+            return np.asarray(h)[:len(self.doc_ids)]
 
     def materialize(self, doc_id: str):
         """Snapshot one document by replaying its admitted change log

@@ -28,6 +28,7 @@ from typing import Callable
 
 from ..core.change import Change
 from ..engine.resident import ResidentDocSet
+from ..engine.resident_rows import DeviceDispatchError
 
 
 class _HandleOpSet:
@@ -214,12 +215,16 @@ class EngineDocSet:
     # -- rows backend: coalesced round-frame ingress ------------------------
 
     def _rows_ingest(self, doc_id: str, cols) -> DocHandle:
-        with self._lock:
-            self.add_doc(doc_id)
-            self._pending.setdefault(doc_id, []).append(cols)
-            if not self._batch_depth:
-                self._flush_locked()
-            handle = self.get_doc(doc_id)
+        try:
+            with self._lock:
+                self.add_doc(doc_id)
+                self._pending.setdefault(doc_id, []).append(cols)
+                if not self._batch_depth:
+                    self._flush_locked()
+                handle = self.get_doc(doc_id)
+        except BaseException:
+            self._drain_admitted_shielded()
+            raise
         self._drain_admitted()
         return handle
 
@@ -237,10 +242,31 @@ class EngineDocSet:
         pre = {d: len(rset.change_log[rset.doc_index[d]]) for d in pending}
         try:
             rset.apply_round_frames([round_from_parts(pending)])
+        except DeviceDispatchError:
+            # The admitted part of the flush is durable on the host
+            # (change_log, clocks and the row mirror are consistent — a
+            # dispatch failure, or a mid-admission failure recovered by
+            # rebuild-from-log). Replaying an ADMITTED doc would silently
+            # diverge: the clock dedup drops it while the log records it.
+            # But a partial-admission rebuild also lands here, so restore
+            # any docs whose log verifiably did not advance — their
+            # changes never admitted and a later flush must retry them.
+            self._pending = {
+                d: cols for d, cols in pending.items()
+                if len(rset.change_log[rset.doc_index[d]]) == pre[d]}
         except Exception:
-            # nothing was admitted: restore the un-applied ingress so a
-            # later flush can retry instead of silently diverging
-            self._pending = pending
+            # Pre-admission failure (budget precheck, malformed frame, …).
+            # Restore ONLY the docs whose changes verifiably did not admit
+            # (per-doc change_log count vs `pre`); re-queueing an admitted
+            # doc would make the retry drop its changes as duplicates while
+            # its ops are already in row state — silent divergence. Docs
+            # that did admit still gossip below via the shared tail.
+            self._pending = {
+                d: cols for d, cols in pending.items()
+                if len(rset.change_log[rset.doc_index[d]]) == pre[d]}
+            self._admit_notify.extend(
+                d for d in pending
+                if len(rset.change_log[rset.doc_index[d]]) > pre[d])
             raise
         admitted = [d for d in pending
                     if len(rset.change_log[rset.doc_index[d]]) > pre[d]]
@@ -250,8 +276,12 @@ class EngineDocSet:
         """Apply any coalesced ingress now (rows backend; no-op otherwise)."""
         if self.backend != "rows":
             return
-        with self._lock:
-            self._flush_locked()
+        try:
+            with self._lock:
+                self._flush_locked()
+        except BaseException:
+            self._drain_admitted_shielded()
+            raise
         self._drain_admitted()
 
     def batch(self):
@@ -263,16 +293,29 @@ class EngineDocSet:
 
         @contextlib.contextmanager
         def _cm():
-            with self._lock:
-                self._batch_depth += 1
-                try:
-                    yield self
-                finally:
-                    self._batch_depth -= 1
-                    if not self._batch_depth:
-                        self._flush_locked()
+            try:
+                with self._lock:
+                    self._batch_depth += 1
+                    try:
+                        yield self
+                    finally:
+                        self._batch_depth -= 1
+                        if not self._batch_depth:
+                            self._flush_locked()
+            except BaseException:
+                self._drain_admitted_shielded()
+                raise
             self._drain_admitted()
         return _cm()
+
+    def _drain_admitted_shielded(self) -> None:
+        """Drain on an exception path: admitted docs must still gossip, but
+        a handler error must not replace the original (retryable) error
+        propagating past the caller."""
+        try:
+            self._drain_admitted()
+        except Exception:
+            pass
 
     def _drain_admitted(self) -> None:
         """Notify handlers for admitted docs, outside self._lock (a handler
@@ -331,10 +374,14 @@ class EngineDocSet:
             self._flush_locked()
 
     def clock_of(self, doc_id: str) -> dict[str, int]:
-        with self._lock:
-            self._maybe_flush_locked()
-            i = self._resident.doc_index[doc_id]
-            out = dict(self._resident.tables[i].clock)
+        try:
+            with self._lock:
+                self._maybe_flush_locked()
+                i = self._resident.doc_index[doc_id]
+                out = dict(self._resident.tables[i].clock)
+        except BaseException:
+            self._drain_admitted_shielded()
+            raise
         self._drain_admitted()  # a read-triggered flush may have admitted
         return out
 
@@ -342,22 +389,26 @@ class EngineDocSet:
         """Per-actor suffixes newer than `clock` (op_set.js:299-306). Log
         entries may be lazy frame refs; they materialize here, only for the
         changes a lagging peer actually needs."""
-        with self._lock:
-            self._maybe_flush_locked()
-            if self.backend == "rows":
-                # the rows engine's own admitted log is the re-serve source
-                rset = self._resident
-                i = rset.doc_index.get(doc_id)
-                out = [] if i is None else [
-                    c if isinstance(c, Change) else c.change()
-                    for c in rset.change_log[i]
-                    if c.seq > clock.get(c.actor, 0)]
-            else:
-                out = []
-                for actor, changes in self._log.get(doc_id, {}).items():
-                    have = clock.get(actor, 0)
-                    out.extend(c if isinstance(c, Change) else c.change()
-                               for c in changes if c.seq > have)
+        try:
+            with self._lock:
+                self._maybe_flush_locked()
+                if self.backend == "rows":
+                    # the rows engine's admitted log is the re-serve source
+                    rset = self._resident
+                    i = rset.doc_index.get(doc_id)
+                    out = [] if i is None else [
+                        c if isinstance(c, Change) else c.change()
+                        for c in rset.change_log[i]
+                        if c.seq > clock.get(c.actor, 0)]
+                else:
+                    out = []
+                    for actor, changes in self._log.get(doc_id, {}).items():
+                        have = clock.get(actor, 0)
+                        out.extend(c if isinstance(c, Change) else c.change()
+                                   for c in changes if c.seq > have)
+        except BaseException:
+            self._drain_admitted_shielded()
+            raise
         self._drain_admitted()
         return out
 
@@ -366,17 +417,26 @@ class EngineDocSet:
     def hashes(self) -> dict[str, int]:
         """Converged per-doc state hashes (cached between deltas — polling
         this does not re-dispatch the reconcile kernel)."""
-        with self._lock:
-            self._maybe_flush_locked()
-            h = self._resident.hashes()
-            out = {d: int(h[i]) for d, i in self._resident.doc_index.items()}
+        try:
+            with self._lock:
+                self._maybe_flush_locked()
+                h = self._resident.hashes()
+                out = {d: int(h[i])
+                       for d, i in self._resident.doc_index.items()}
+        except BaseException:
+            self._drain_admitted_shielded()
+            raise
         self._drain_admitted()
         return out
 
     def materialize(self, doc_id: str):
         """Decode one document's converged state from the device."""
-        with self._lock:
-            self._maybe_flush_locked()
-            out = self._resident.materialize(doc_id)
+        try:
+            with self._lock:
+                self._maybe_flush_locked()
+                out = self._resident.materialize(doc_id)
+        except BaseException:
+            self._drain_admitted_shielded()
+            raise
         self._drain_admitted()
         return out

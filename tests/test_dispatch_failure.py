@@ -1,0 +1,230 @@
+"""Dispatch-failure recovery on the rows sync service (ADVICE r3 medium).
+
+A device dispatch can fail AFTER host admission succeeded (plausible on the
+tunneled TPU). The engine keeps rows_host as an exact pre-dispatch mirror, so
+the correct recovery is: keep the admission (change_log / clocks / mirror are
+consistent), drop the device buffer, and rebuild it lazily — NOT re-queue the
+ingress, which the clock dedup would then drop as duplicates while the log
+records it as admitted (silent divergence).
+
+Pre-admission failures (budget precheck, malformed frames) must instead
+restore exactly the docs whose changes did not admit, so a later flush can
+retry them.
+"""
+
+import numpy as np
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu.engine.resident_rows import DeviceDispatchError
+from automerge_tpu.sync.service import EngineDocSet
+
+from tests.test_rows_service import oracle_hash
+
+
+def make_doc(i):
+    d = am.change(am.init("W"), lambda x, i=i: am.assign(
+        x, {"n": i, "xs": [i, i + 1]}))
+    return d._doc.opset.get_missing_changes({})
+
+
+def test_dispatch_failure_keeps_admission_and_recovers():
+    e = EngineDocSet(backend="rows")
+    rset = e._resident
+    if rset._native is None:
+        pytest.skip("python-encoder fallback has no dispatch stage")
+
+    chs0 = make_doc(0)
+    e.apply_changes("d0", chs0)     # healthy ingress first
+
+    # Fail the NEXT device dispatch only; admission runs before it.
+    real = rset._dispatch_final
+    calls = {"n": 0}
+
+    def failing(trip_list, pre_rows, interpret):
+        calls["n"] += 1
+        raise RuntimeError("tunnel dropped mid-dispatch")
+
+    rset._dispatch_final = failing
+    chs1 = make_doc(1)
+    try:
+        # the service swallows DeviceDispatchError: truth was admitted
+        e.apply_changes("d1", chs1)
+    finally:
+        rset._dispatch_final = real
+    assert calls["n"] == 1
+
+    # not re-queued, logged as admitted, clocks advanced
+    assert e._pending == {}
+    assert len(rset.change_log[rset.doc_index["d1"]]) == len(chs1)
+    assert e.clock_of("d1").get("W", 0) == len(chs1)
+    # replaying the same ingress is a duplicate-drop, not a double-apply
+    e.apply_changes("d1", chs1)
+    assert len(rset.change_log[rset.doc_index["d1"]]) == len(chs1)
+
+    # the device buffer was dropped and marked dirty; the next read
+    # re-uploads the host mirror and converges to the oracle
+    h = e.hashes()
+    assert np.uint32(h["d0"]) == oracle_hash(chs0)
+    assert np.uint32(h["d1"]) == oracle_hash(chs1)
+    assert e.materialize("d1")["data"]["n"] == 1
+
+
+def test_engine_raises_typed_error_and_marks_dirty():
+    from automerge_tpu.engine.resident_rows import ResidentRowsDocSet
+    from automerge_tpu.native.wire import changes_to_columns
+    from automerge_tpu.sync.frames import round_from_parts
+
+    rset = ResidentRowsDocSet(["d0"])
+    if rset._native is None:
+        pytest.skip("python-encoder fallback has no dispatch stage")
+    real = rset._dispatch_final
+    rset._dispatch_final = lambda *a, **k: (_ for _ in ()).throw(
+        RuntimeError("boom"))
+    chs = make_doc(7)
+    frame = round_from_parts({"d0": [changes_to_columns(chs)]})
+    with pytest.raises(DeviceDispatchError):
+        rset.apply_round_frames([frame])
+    rset._dispatch_final = real
+    assert rset.rows_dev is None and rset._dirty
+    # log records the admission; the mirror re-uploads to the oracle hash
+    assert len(rset.change_log[rset.doc_index["d0"]]) == len(chs)
+    assert np.uint32(rset.hashes()[0]) == oracle_hash(chs)
+
+
+def test_readback_failure_recovers_at_next_read():
+    """The dispatch is async: a tunnel failure often surfaces at the
+    np.asarray readback barrier inside hashes(), not at dispatch time.
+    The same mirror recovery must engage there."""
+    from automerge_tpu.engine.resident_rows import ResidentRowsDocSet
+    from automerge_tpu.native.wire import changes_to_columns
+    from automerge_tpu.sync.frames import round_from_parts
+
+    rset = ResidentRowsDocSet(["d0"])
+    if rset._native is None:
+        pytest.skip("python-encoder fallback has no dispatch stage")
+    chs = make_doc(5)
+    frame = round_from_parts({"d0": [changes_to_columns(chs)]})
+    rset.apply_round_frames([frame])
+
+    class BoomHandle:
+        def __array__(self, *a, **k):
+            raise RuntimeError("tunnel dropped during readback")
+
+    rset._hash_handle = BoomHandle()
+    with pytest.raises(DeviceDispatchError):
+        rset.hashes()
+    assert rset.rows_dev is None and rset._dirty
+    # next read re-uploads the mirror and recomputes
+    assert np.uint32(rset.hashes()[0]) == oracle_hash(chs)
+
+
+def test_midadmission_failure_rebuilds_from_log():
+    """A failure between admission and the mirror scatter (e.g. a grow
+    MemoryError) leaves change_log ahead of rows_host; the engine must
+    rebuild from the log rather than let them diverge."""
+    e = EngineDocSet(backend="rows")
+    rset = e._resident
+    if rset._native is None:
+        pytest.skip("python-encoder fallback exercises a different path")
+
+    chs0 = make_doc(0)
+    e.apply_changes("d0", chs0)
+
+    real = rset._cols_triplets
+    rset._cols_triplets = lambda enc: (_ for _ in ()).throw(
+        MemoryError("grow failed mid-scatter"))
+    chs1 = make_doc(1)
+    e.apply_changes("d1", chs1)   # DeviceDispatchError swallowed by service
+    rset = e._resident            # rebuild replaced engine internals
+
+    # admitted in the (rebuilt) log, not re-queued, and row state converges
+    assert e._pending == {}
+    assert len(rset.change_log[rset.doc_index["d1"]]) == len(chs1)
+    h = e.hashes()
+    assert np.uint32(h["d0"]) == oracle_hash(chs0)
+    assert np.uint32(h["d1"]) == oracle_hash(chs1)
+    # replay of the same ingress is still a duplicate-drop
+    e.apply_changes("d1", chs1)
+    assert len(rset.change_log[rset.doc_index["d1"]]) == len(chs1)
+    # the rebuild swapped in fresh internals, clearing the monkeypatch
+    assert "_cols_triplets" not in rset.__dict__
+
+
+def test_partial_admission_restores_only_unadmitted_docs():
+    """A DeviceDispatchError can cover a PARTIAL admission (mid-admission
+    rebuild): docs whose log did not advance must return to pending so a
+    later flush retries them, while admitted docs must not be replayed."""
+    e = EngineDocSet(backend="rows")
+    rset = e._resident
+    if rset._native is None:
+        pytest.skip("python-encoder fallback exercises a different path")
+    e.add_doc("a")
+    e.add_doc("b")
+    chs_a, chs_b = make_doc(1), make_doc(2)
+
+    real = rset.apply_round_frames
+
+    def partial(frames, interpret=None):
+        rset.change_log[rset.doc_index["a"]].extend(chs_a)  # A admitted
+        raise DeviceDispatchError("failed after admitting a, before b")
+
+    rset.apply_round_frames = partial
+    with e.batch():
+        e.apply_changes("a", chs_a)
+        e.apply_changes("b", chs_b)
+    rset.apply_round_frames = real
+
+    assert "a" not in e._pending          # admitted: must not replay
+    assert "b" in e._pending              # never admitted: must retry
+    e.flush()
+    assert e._pending == {}
+    assert np.uint32(e.hashes()["b"]) == oracle_hash(chs_b)
+
+
+def test_poisoned_when_rebuild_is_impossible():
+    """If the rebuild replay hits the same deterministic failure, the node
+    must fail loudly on every later apply/read instead of serving hashes
+    that silently drop admitted changes."""
+    from automerge_tpu.engine.resident_rows import ResidentRowsDocSet
+    from automerge_tpu.native.wire import changes_to_columns
+    from automerge_tpu.sync.frames import round_from_parts
+
+    rset = ResidentRowsDocSet(["d0"])
+    if rset._native is None:
+        pytest.skip("python-encoder fallback exercises a different path")
+    rset._rebuilding = True   # simulate being inside a rebuild replay
+    rset._cols_triplets = lambda enc: (_ for _ in ()).throw(
+        MemoryError("deterministic capacity failure"))
+    frame = round_from_parts({"d0": [changes_to_columns(make_doc(1))]})
+    with pytest.raises(MemoryError):
+        rset.apply_round_frames([frame])
+    with pytest.raises(RuntimeError, match="no longer reflects"):
+        rset.hashes()
+    with pytest.raises(RuntimeError, match="no longer reflects"):
+        rset.apply_round_frames([frame])
+
+
+def test_preadmission_failure_restores_unadmitted_docs():
+    e = EngineDocSet(backend="rows")
+    rset = e._resident
+    if rset._native is None:
+        pytest.skip("python-encoder fallback exercises a different path")
+
+    chs = make_doc(3)
+    real = rset.apply_round_frames
+
+    def precheck_boom(frames, interpret=None):
+        raise RuntimeError("batch would blow the VMEM budget")
+
+    rset.apply_round_frames = precheck_boom
+    with pytest.raises(RuntimeError, match="VMEM"):
+        e.apply_changes("d3", chs)
+    rset.apply_round_frames = real
+
+    # nothing admitted -> the ingress was restored for retry
+    assert "d3" in e._pending
+    assert len(rset.change_log[rset.doc_index["d3"]]) == 0
+    e.flush()
+    assert e._pending == {}
+    assert np.uint32(e.hashes()["d3"]) == oracle_hash(chs)
