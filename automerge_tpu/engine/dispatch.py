@@ -146,6 +146,47 @@ def plan_for(doc_changes: list, passes: int = 1) -> Plan:
     return Plan("device" if dev < host else "host", dev, host)
 
 
+def _causal_order(changes):
+    """Stable causal (re)ordering of a complete change list. Returns the
+    input unchanged when it is already causally ordered (one O(n) clock
+    pass), a stably reordered copy when a causal order exists, or None when
+    none does (missing deps, duplicate or gapped seqs) — the interpretive
+    path owns those semantics (causal queueing, seq-reuse errors).
+
+    Why: bulk build requires application order (bulkload.py validates it),
+    but get_missing_changes emits per-actor runs whose deps point across
+    runs (op_set.js:299-306 does the same) — without this reorder every
+    merged-doc log paid a failed bulk attempt and fell back (the r3 bench's
+    config-3 routing tax). Typical logs settle in ~2 passes; the worst case
+    is O(n^2) but only for orders no peer actually emits."""
+    clock: dict[str, int] = {}
+    for c in changes:
+        if c.seq != clock.get(c.actor, 0) + 1 or any(
+                clock.get(a, 0) < s for a, s in c.deps.items()):
+            break
+        clock[c.actor] = c.seq
+    else:
+        return changes
+    clock = {}
+    pending = list(changes)
+    out = []
+    while pending:
+        rest = []
+        progressed = False
+        for c in pending:
+            if c.seq == clock.get(c.actor, 0) + 1 and all(
+                    clock.get(a, 0) >= s for a, s in c.deps.items()):
+                clock[c.actor] = c.seq
+                out.append(c)
+                progressed = True
+            else:
+                rest.append(c)
+        if not progressed:
+            return None
+        pending = rest
+    return out
+
+
 def apply_host(changes, actor_id: str = "engine"):
     """Host-path from-scratch apply of one document's complete change set:
     bulk vectorized build when the log is big enough and eligible, else
@@ -159,9 +200,13 @@ def apply_host(changes, actor_id: str = "engine"):
     if len(changes) >= HOST_BULK_MIN_CHANGES:
         # try_bulk_build owns the fallback contract (GC pause, observable
         # bulkload_fallback_keyerror counter); materialize errors surface
-        opset = try_bulk_build(changes_to_columns(changes))
-        if opset is not None:
-            return materialize_root(actor_id, opset)
+        ordered = _causal_order(changes)
+        if ordered is not None:
+            opset = try_bulk_build(changes_to_columns(ordered))
+            if opset is not None:
+                from ..utils import metrics
+                metrics.bump("host_bulk_built")
+                return materialize_root(actor_id, opset)
     doc = init(actor_id)
     return apply_changes_to_doc(doc, doc._doc.opset, list(changes),
                                 incremental=False)

@@ -516,12 +516,33 @@ def run_engine(doc_changes, repeat=None):
     else:
         wire, dispatch = build_packed_dispatch()
     encode_time = time.perf_counter() - t0
-    # per-pass copies are bench scaffolding (so each pass really ships its
-    # own bytes), not encode work — built outside encode_time
+
+    # Per-pass payloads are DISTINCT (VERDICT r3 weak #5): pass k>0 gets the
+    # value_hash column cyclically permuted, so every pass ships different
+    # bytes and computes different hashes — no cache anywhere in the stack
+    # can help. Permutation (not mutation) keeps every per-field min/max
+    # identical, so the compact wire's dtype narrowing and therefore bmeta/
+    # shapes are bit-stable across passes. Pass 0 stays canonical for the
+    # parity cross-checks. Scaffolding, not encode work — outside
+    # encode_time.
+    def _vary_pass(k):
+        if k == 0:
+            return wire
+        vb = dict(batch)
+        vh = np.asarray(batch["value_hash"])
+        vb["value_hash"] = np.roll(vh.reshape(-1), 17 * k + 1) \
+            .reshape(vh.shape)
+        if use_rows:
+            w, bm, _dims, _n = pack_rows_bytes(vb, max_fids)
+            assert bm == bmeta, "per-pass wire layout drifted"
+            return w
+        w, _meta = pack_batch(vb)
+        return w
+
     if use_rows:
-        stacked = np.stack([wire.copy() for _ in range(repeat)])
+        stacked = np.stack([_vary_pass(k) for k in range(repeat)])
     else:
-        buffers = [wire.copy() for _ in range(repeat)]  # host-side
+        buffers = [_vary_pass(k) for k in range(repeat)]  # host-side
 
     # Warmup: compile AND exercise the transfer + readback paths (the tunnel
     # pays large one-time costs on the first use of each shape/direction).
@@ -572,9 +593,8 @@ def run_engine(doc_changes, repeat=None):
                 "ops": int(i_), "actors": int(a_), "elems": int(l_ * e_),
                 "fids": int(max_fids), "rows": rows_count(i_, a_, l_ * e_)}
         wire, dispatch = build_packed_dispatch()
-        buffers = [wire.copy() for _ in range(repeat)]
+        buffers = [_vary_pass(k) for k in range(repeat)]
         np.asarray(dispatch([jnp.asarray(b) for b in buffers]))
-    del batch
 
     # Timed: ship every pass's bytes, barrier on the transfers, run ONE
     # dispatch covering every pass, drain all hashes in one readback.
@@ -615,6 +635,28 @@ def run_engine(doc_changes, repeat=None):
     t0 = time.perf_counter()
     np.asarray(dispatch(arrs))
     device_time = (time.perf_counter() - t0) / repeat
+
+    # Single-dispatch latency (VERDICT r3 weak #5 / ADVICE r3): the
+    # pipelined figure above amortizes the link's fixed per-dispatch and
+    # per-readback costs over `repeat` passes; this is the UNpipelined
+    # number — ONE pass shipping its own bytes through one transfer, one
+    # dispatch, one readback. Published alongside so the fixed-cost
+    # amortization is visible in the record itself.
+    if repeat > 1:
+        # fresh, never-shipped payloads (same distinct-bytes discipline as
+        # the pipelined region — pass indices beyond the ones already
+        # used); host packing is scaffolding, but the transfer itself
+        # belongs inside the timed region like the pipelined figure's
+        def one_pass(w):
+            return ship(w[None, :]) if use_rows else [jnp.asarray(w)]
+        np.asarray(dispatch(one_pass(_vary_pass(repeat + 1))))  # warm shapes
+        w_fresh = _vary_pass(repeat)
+        t0 = time.perf_counter()
+        np.asarray(dispatch(one_pass(w_fresh)))
+        kernel_info["breakdown"]["single_dispatch_s"] = round(
+            time.perf_counter() - t0, 5)
+    else:
+        kernel_info["breakdown"]["single_dispatch_s"] = round(end_to_end, 5)
     return end_to_end, device_time, encode_time, kernel_info
 
 
@@ -827,17 +869,23 @@ def run_resident_rounds(doc_changes, n_rounds=12, fraction=0.2):
 
 def _oracle_capped(doc_changes, cap_docs: int):
     """Interpretive-baseline time for a doc batch, measured directly up to
-    cap_docs and extrapolated linearly past it — with the linearity of the
-    measured region recorded (VERDICT r1 weak #5: the extrapolation must
-    carry its own empirical check). Returns (seconds, linearity|None,
-    measured_subset)."""
+    cap_docs and extrapolated past it — with the linearity of the measured
+    region recorded (VERDICT r1 weak #5) AND the correction applied
+    (VERDICT r3 weak #2): the tail beyond the cap is extrapolated at the
+    measured STEADY-STATE per-doc rate (the second half of the subset),
+    not the whole-subset average. When per-doc cost falls as the
+    interpreter warms (linearity < 1), whole-average extrapolation
+    overstates the oracle and inflates the speedup; the second-half rate
+    is the better estimate of marginal cost at scale in either direction.
+    Returns (seconds, linearity|None, measured_subset)."""
     if len(doc_changes) > cap_docs:
         subset = doc_changes[:cap_docs]
-        scale = len(doc_changes) / len(subset)
         cap_time, first_s, second_s, n_first = run_oracle_split(subset)
-        linearity = round((second_s / max(len(subset) - n_first, 1))
-                          / (first_s / n_first), 3)
-        return cap_time * scale, linearity, subset
+        n_second = max(len(subset) - n_first, 1)
+        linearity = round((second_s / n_second) / (first_s / n_first), 3)
+        steady_rate = second_s / n_second
+        est = cap_time + steady_rate * (len(doc_changes) - len(subset))
+        return est, linearity, subset
     return run_oracle(doc_changes), None, doc_changes
 
 
@@ -987,7 +1035,10 @@ def run_config(cfg: int, n_docs: int | None = None, oracle_cap_docs=1000):
         "docs": len(doc_changes),
         "ops": ops,
         **({"oracle_linearity": linearity,
-            "oracle_extrapolated_from": len(subset)} if linearity else {}),
+            "oracle_extrapolated_from": len(subset),
+            "oracle_extrapolation": ("measured cap + steady-state "
+                                     "(second-half) per-doc rate for the "
+                                     "tail")} if linearity else {}),
         "gen_s": round(gen_time, 3),
         "encode_s": round(encode_time, 4),
         "oracle_s": round(oracle_time, 4),
@@ -1043,14 +1094,55 @@ def _final_record(results_by_cfg: dict, backend: str | None, attempts: list):
         # from the worker's own measurement — the parent never inits jax
         rec["passes_per_dispatch"] = (headline.get("megakernel", {})
                                       .get("breakdown", {}).get("passes"))
-        rec["note"] = ("end-to-end figure is dominated by the tunneled "
-                       "single-chip host<->device roundtrip; every device "
-                       "config pipelines PASSES identical jobs per "
-                       "dispatch (each shipping its own bytes); the device "
-                       "reconcile itself takes device_s")
+        single = (headline.get("megakernel", {})
+                  .get("breakdown", {}).get("single_dispatch_s"))
+        if single:
+            # the UNpipelined latency of one whole job (one transfer, one
+            # dispatch, one readback) next to the pipelined throughput
+            rec["single_dispatch_s"] = single
+            rec["single_dispatch_vs_baseline"] = round(
+                headline["oracle_s"] / single, 2)
+        rec["note"] = ("end-to-end figure is the pipelined-throughput "
+                       "posture: every device config pipelines PASSES "
+                       "jobs per dispatch, each shipping its own DISTINCT "
+                       "payload bytes; single_dispatch_s is the "
+                       "unpipelined one-job latency; the device reconcile "
+                       "itself takes device_s")
     if attempts:
         rec["attempts"] = attempts
     return rec
+
+
+def _compact_record(rec: dict) -> dict:
+    """The one-line contract record (driver-parsed): headline fields only,
+    kept well under the driver's tail-capture window (VERDICT r3 weak #6).
+    Full per-config breakdowns, megakernel info, notes and attempt logs go
+    to the BENCH_DETAIL.json sidecar."""
+    out = {k: rec[k] for k in
+           ("metric", "value", "unit", "vs_baseline", "backend")
+           if k in rec}
+    out["configs"] = {k: v.get("speedup")
+                      for k, v in rec.get("configs", {}).items()}
+    batched = {k: v["batched_speedup"]
+               for k, v in rec.get("configs", {}).items()
+               if "batched_speedup" in v}
+    if batched:
+        out["batched"] = batched
+    for k in ("device_resident_vs_baseline", "single_dispatch_s",
+              "single_dispatch_vs_baseline", "oracle_linearity",
+              "passes_per_dispatch"):
+        if k in rec:
+            out[k] = rec[k]
+    rs = rec.get("incremental_sync", {}).get("resident_speedup")
+    if rs is not None:
+        out["resident_speedup"] = rs
+    if rec.get("attempts"):
+        out["attempts"] = [f"{'cpu' if a.get('force_cpu') else 'dflt'}:"
+                           f"{a.get('rc')}" for a in rec["attempts"]]
+    if rec.get("errors"):
+        out["errors"] = len(rec["errors"])
+    out["detail"] = "BENCH_DETAIL.json"
+    return out
 
 
 def worker_main(args):
@@ -1234,7 +1326,19 @@ def parent_main(args, passthrough: list[str]):
     unresolved = [e for e in errors if e.get("config") not in results_by_cfg]
     if unresolved:
         rec["errors"] = unresolved[:10]
-    print(json.dumps(rec))
+    # Full record -> sidecar; the contract line stays compact so the
+    # driver's tail capture always parses it (VERDICT r3 weak #6).
+    detail_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
+    compact = _compact_record(rec)
+    try:
+        with open(detail_path, "w") as f:
+            json.dump(rec, f, indent=1)
+    except Exception as e:
+        # never point at a stale previous run's sidecar
+        compact["detail"] = None
+        compact["detail_error"] = repr(e)[:120]
+    print(json.dumps(compact))
     sys.exit(0)
 
 

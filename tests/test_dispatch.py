@@ -86,3 +86,62 @@ def test_calibrate_from_profile_partial_and_full():
         assert set(applied2) == {"d2h_call_s"}
     finally:
         dp.calibrate(**before)
+
+
+def _trace_concurrent(n_per_actor=100):
+    """Merged multi-actor doc: get_missing_changes emits per-actor runs
+    whose deps cross runs — NOT causal application order."""
+    a = am.change(am.init("A"), lambda x: x.__setitem__("xs", []))
+    b = am.merge(am.init("B"), a)
+    c = am.merge(am.init("C"), a)
+    for i in range(n_per_actor):
+        a = am.change(a, lambda x, i=i: x.__setitem__(f"a{i % 9}", i))
+        b = am.change(b, lambda x, i=i: x["xs"].insert_at(0, i))
+        c = am.change(c, lambda x, i=i: x.__setitem__(f"c{i % 9}", -i))
+    m = am.merge(am.merge(a, b), c)
+    return m._doc.opset.get_missing_changes({})
+
+
+def test_causal_order_passthrough_and_reorder():
+    from automerge_tpu.engine.dispatch import _causal_order
+
+    linear = _trace_bulk(20)
+    assert _causal_order(linear) is linear  # already causal: no copy
+
+    # force a non-causal permutation: per-actor runs with the dependent
+    # actors' runs FIRST (their deps point at changes that come later)
+    conc = _trace_concurrent(10)
+    shuffled = sorted(conc, key=lambda c: (c.actor != "C", c.actor != "B",
+                                           c.seq))
+    assert _causal_order(shuffled) is not shuffled  # really non-causal
+    ordered = _causal_order(shuffled)
+    assert ordered is not None
+    assert sorted((c.actor, c.seq) for c in ordered) \
+        == sorted((c.actor, c.seq) for c in shuffled)
+    clock = {}
+    for c in ordered:
+        assert c.seq == clock.get(c.actor, 0) + 1
+        assert all(clock.get(a, 0) >= s for a, s in c.deps.items())
+        clock[c.actor] = c.seq
+
+    # an incomplete log has no causal order -> interpretive semantics
+    assert _causal_order(shuffled[1:]) is None
+
+
+def test_apply_host_bulk_engages_on_concurrent_log():
+    """The r3 bench's config-3 routing tax: a merged multi-actor log used
+    to pay a failed bulk attempt (causal-order bail) and fall back. After
+    the stable reorder, bulk must ENGAGE and match the interpretive result
+    exactly."""
+    changes = _trace_concurrent()       # > HOST_BULK_MIN_CHANGES changes
+    assert len(changes) >= 256
+    am.metrics.reset()
+    got = apply_host(changes)
+    doc = am.init("oracle")
+    want = apply_changes_to_doc(doc, doc._doc.opset, changes,
+                                incremental=False)
+    assert am.equals(got, want)
+    snap = am.metrics.snapshot()
+    assert snap.get("bulkload_fallback_keyerror", 0) == 0
+    # positive signal: the bulk path really built (not interpretive)
+    assert snap.get("host_bulk_built", 0) == 1, snap
