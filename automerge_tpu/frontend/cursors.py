@@ -56,3 +56,35 @@ class Cursor:
     def apply(self, records: list[dict]) -> "Cursor":
         self.index = transform_index(self.index, records, self.obj)
         return self
+
+
+@dataclass
+class Selection:
+    """A two-endpoint range selection [start, end) on one list/Text object,
+    maintained by transforming each endpoint with the same fold as Cursor.
+
+    Validity rests on two properties, both proven on random concurrent
+    traces in tests/test_cursor_equivalence.py:
+    - equivalence: each endpoint lands where the oracle's per-op
+      application-ordered stream (op_set.js:105-176) would put it whenever
+      its anchor survives, and inside the same ambiguity zone when not;
+    - monotonicity: transform_index is order-preserving (insert at i adds 1
+      to every index >= i; remove at i subtracts 1 from every index > i),
+      so start <= end is invariant under EITHER stream and the range never
+      inverts.
+    Together they extend the single-cursor theorem to selections: both
+    streams map a selection to the same range whenever both anchors
+    survive."""
+
+    obj: str
+    start: int
+    end: int
+
+    def apply(self, records: list[dict]) -> "Selection":
+        self.start = transform_index(self.start, records, self.obj)
+        self.end = transform_index(self.end, records, self.obj)
+        return self
+
+    @property
+    def collapsed(self) -> bool:
+        return self.start == self.end
