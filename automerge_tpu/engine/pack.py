@@ -225,6 +225,63 @@ def _narrow_dtype(part: np.ndarray):
     return 2, np.int32
 
 
+_DTYPES = (np.int8, np.int16, np.int32)
+# ROW_FIELDS positions of the content-hash groups: never narrowable.
+_HASH_GROUPS = frozenset((ROW_FIELDS.index("fid_hash"),
+                          ROW_FIELDS.index("value_hash"),
+                          ROW_FIELDS.index("elem_objhash")))
+
+
+def _width_of_bound(lo: int, hi: int) -> int:
+    if -128 <= lo and hi <= 127:
+        return 0
+    if -32768 <= lo and hi <= 32767:
+        return 1
+    return 2
+
+
+def classify_row_groups(rows, dims: tuple, max_fids: int) -> tuple:
+    """Batch-stable per-group dtype classes (ADVICE r3, pack.py:318): the
+    classification is part of the jit static key, so it must not flap
+    between batches of a stream. Three policies by group:
+
+    - capacity-derived where the layout itself bounds the values (masks
+      0/1, the action enum, fid < max_fids, actor rank < A, ins_pos < LE):
+      no data inspection at all — identical for every batch of the same
+      declared shape;
+    - always-int32 for the content-hash groups (hashes span the word);
+    - observed-max quantized with 2x headroom for the genuinely data-
+      dependent counters (seq, change_idx, clock_op, elem_list): the class
+      only changes when a counter actually crosses HALF a dtype boundary,
+      so a streaming deployment retraces O(log) times over its lifetime
+      instead of whenever a value grazes a boundary."""
+    i, a, le = dims[0], dims[1], dims[2]
+    cap_hi = {
+        ROW_FIELDS.index("op_mask"): 1,
+        ROW_FIELDS.index("action"): 32,       # enum, ~10 actions
+        ROW_FIELDS.index("fid"): max(max_fids, 1),
+        ROW_FIELDS.index("actor"): max(a, 1),
+        ROW_FIELDS.index("ins_mask"): 1,
+        ROW_FIELDS.index("ins_fid"): max(max_fids, 1),
+        ROW_FIELDS.index("ins_pos"): max(le, 1),
+    }
+    group_rows = (i, i, i, i, i, i, i, i, a * i, le, le, le, le, le)
+    widths = []
+    off = 0
+    for g, r in enumerate(group_rows):
+        part = rows[off:off + r]
+        off += r
+        if g in _HASH_GROUPS:
+            widths.append(2)
+        elif g in cap_hi:
+            widths.append(_width_of_bound(-1, cap_hi[g]))
+        else:
+            lo, hi = ((int(part.min()), int(part.max())) if part.size
+                      else (0, 0))
+            widths.append(_width_of_bound(min(lo, -1), max(2 * hi, 1)))
+    return tuple(widths)
+
+
 def pack_rows_compact(batch: dict, max_fids: int):
     """Docs-minor row wire with per-field narrow dtypes.
 
@@ -234,16 +291,18 @@ def pack_rows_compact(batch: dict, max_fids: int):
     group, enough for widen_rows to rebuild the exact int32 layout."""
     rows, dims, d = pack_rows(batch, max_fids)
 
-    # split back into the ROW_FIELDS groups to classify independently
+    # split back into the ROW_FIELDS groups; widths come from the
+    # batch-stable policy (classify_row_groups) so the static jit key
+    # does not flap between batches of a stream
     i, a, le = dims[0], dims[1], dims[2]
     group_rows = (i, i, i, i, i, i, i, i, a * i, le, le, le, le, le)
+    widths = classify_row_groups(rows, dims, max_fids)
     parts8, parts16, parts32, meta = [], [], [], []
     off = 0
-    for r in group_rows:
+    for r, idx in zip(group_rows, widths):
         part = rows[off:off + r]
         off += r
-        idx, dt = _narrow_dtype(part)
-        (parts8, parts16, parts32)[idx].append(part.astype(dt))
+        (parts8, parts16, parts32)[idx].append(part.astype(_DTYPES[idx]))
         meta.append((idx, r))
     d_pad = rows.shape[1]
 

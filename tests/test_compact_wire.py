@@ -151,3 +151,58 @@ def test_field_sharded_virtual_docs_recombine_exactly():
     _, _, ref = apply_batch(docs)
     want = np.asarray(ref["hash"])[:n].astype(np.uint32)
     np.testing.assert_array_equal(got, want)
+
+
+def test_classification_stable_across_stream_batches():
+    """ADVICE r3 (pack.py narrowing): the dtype classification is part of
+    the jit static key, so two batches of the same declared shape whose
+    values differ only within the headroom quantum must classify
+    IDENTICALLY (no per-batch retrace), while a counter actually crossing
+    half a dtype boundary escalates."""
+    from automerge_tpu.engine.pack import classify_row_groups
+
+    batch, max_fids = _batch_of(_mixed_docs())
+    rows, dims, _ = pack_rows(batch, max_fids)
+    w1 = classify_row_groups(rows, dims, max_fids)
+
+    # same shape, different values (hashes differ, counters in headroom)
+    batch2, max_fids2 = _batch_of(_mixed_docs())
+    vh = np.asarray(batch2["value_hash"])
+    batch2["value_hash"] = np.roll(vh.reshape(-1), 3).reshape(vh.shape)
+    rows2, dims2, _ = pack_rows(batch2, max_fids2)
+    assert dims2 == dims and max_fids2 == max_fids
+    assert classify_row_groups(rows2, dims2, max_fids2) == w1
+
+    # hash groups are pinned to int32 regardless of observed values
+    from automerge_tpu.engine.pack import ROW_FIELDS, _HASH_GROUPS
+    for g in _HASH_GROUPS:
+        assert w1[g] == 2, ROW_FIELDS[g]
+
+    # a counter crossing half the int8 boundary escalates that group only
+    seq_g = ROW_FIELDS.index("seq")
+    i_ = dims[0]
+    rows3 = rows.copy()
+    off = seq_g * i_   # seq is the 5th of the i-row groups
+    rows3[off:off + i_][rows3[off:off + i_] > 0] += 70  # hi*2 > 127
+    w3 = classify_row_groups(rows3, dims, max_fids)
+    assert w3[seq_g] == 1
+    assert all(w3[g] == w1[g] for g in range(len(w1)) if g != seq_g)
+
+
+def test_compact_parity_after_stable_classification():
+    """The stable policy must keep the byte wire bit-exact: widened rows
+    equal the wide path, and hashes match the engine."""
+    from automerge_tpu.engine.batchdoc import apply_batch
+    from automerge_tpu.engine.pack import apply_rows_hash_bytes, \
+        pack_rows_bytes
+
+    doc_changes = _mixed_docs()
+    batch, max_fids = _batch_of(doc_changes)
+    if not rows_eligible(batch, max_fids):
+        pytest.skip("shape outside megakernel envelope")
+    wire, bmeta, dims, n = pack_rows_bytes(batch, max_fids)
+    got = np.asarray(apply_rows_hash_bytes(
+        jax.numpy.asarray(wire), bmeta, dims, True))[:n].astype(np.uint32)
+    _, _, ref = apply_batch(doc_changes)
+    want = np.asarray(ref["hash"])[:n].astype(np.uint32)
+    np.testing.assert_array_equal(got, want)
