@@ -344,6 +344,21 @@ def build_opset(cols) -> OpSet:
         ranges = [(grouped[bounds_l[g]], bounds_l[g], bounds_l[g + 1])
                   for g in range(len(bounds_l) - 1)]
         ranges.sort()  # fields in first-assignment order
+
+        # Dense per-change clock matrix for the vectorized domination test
+        # (built once, only when some field has >1 op): dominated_i iff a
+        # DIFFERENT change in the group causally knows op i —
+        # clock[ci_j, actor_i] >= seq_i. Replaces the O(g^2) Python double
+        # loop that dominated the LWW-storm build (many ops per field).
+        clock_mat = None
+        if any(hi - lo > 1 for (_j0, lo, hi) in ranges):
+            actor_code = {a: c for c, a in enumerate(actors)}
+            clock_mat = np.zeros((n_ch, len(actors)), np.int64)
+            for i2, d in enumerate(all_deps):
+                if d:
+                    for astr2, v2 in d.items():
+                        clock_mat[i2, actor_code[astr2]] = v2
+
         for (j0, lo, hi) in ranges:
             op0 = hist_ops[j0]
             obj = by_object[op0.obj]
@@ -362,22 +377,24 @@ def build_opset(cols) -> OpSet:
                 if op.action == "link":
                     inbound_adds.append((j0, op.value, op))
                 continue
-            # multi-op field: pairwise domination over the group
-            metas = []
-            for x in range(lo, hi):
-                j = grouped[x]
-                ci = op_change_l[j]
-                metas.append((j, ci, actors[ch_actor_l[ci]], ch_seq_l[ci]))
+            # multi-op field: vectorized pairwise domination over the group
+            g = hi - lo
+            idxs = grouped[lo:hi]
+            cis = np.fromiter((op_change_l[j] for j in idxs), np.int64, g)
+            cis_l = cis.tolist()
+            seqs = np.fromiter((ch_seq_l[ci] for ci in cis_l), np.int64, g)
+            acts = np.fromiter((ch_actor_l[ci] for ci in cis_l),
+                               np.int64, g)
+            vals = clock_mat[cis][:, acts]            # [j, i]
+            dom = ((vals >= seqs[None, :])
+                   & (cis[:, None] != cis[None, :])).any(axis=0)
+            actions = np.fromiter((op_action_l[j] for j in idxs),
+                                  np.int64, g)
+            keep = np.nonzero(~dom & (actions != i_del))[0].tolist()
             remaining = []
-            for (j, ci, astr, s) in metas:
-                dominated = False
-                for (_j2, ci2, _a2, _s2) in metas:
-                    if ci2 != ci and all_deps[ci2].get(astr, 0) >= s:
-                        dominated = True
-                        break
-                if dominated or op_action_l[j] == i_del:
-                    continue
-                op = _stamp(hist_ops[j], astr, s)
+            for x in keep:
+                j = idxs[x]
+                op = _stamp(hist_ops[j], actors[acts[x]], int(seqs[x]))
                 remaining.append(op)
                 if op.action == "link":
                     inbound_adds.append((j, op.value, op))
