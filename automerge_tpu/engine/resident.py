@@ -156,6 +156,12 @@ class ResidentDocSet:
         self.tables = [DocTables() for _ in range(n)]
         self.actors: list[str] = []
         self.actor_rank: dict[str, int] = {}
+        # running fleet-wide maxima of per-doc list/elem stats (values only
+        # grow, so the cached max is exact): replaces O(n_docs) generator
+        # scans on every streaming round's precheck/grow
+        self._lists_hi = 0
+        self._elems_hi = 0
+        self._changes_hi = 0
 
         # capacities (powers of two)
         self.cap_ops = 8
@@ -431,6 +437,8 @@ class ResidentDocSet:
             delta.clocks.append(self._clock_row(t, c.actor, c.seq, c.deps))
             change_idx = t.n_changes
             t.n_changes += 1
+            if t.n_changes > self._changes_hi:
+                self._changes_hi = t.n_changes
 
             arank = self.actor_rank[c.actor]
             for op in c.ops:
@@ -483,6 +491,10 @@ class ResidentDocSet:
         t.n_lists = len(t.list_rows)
         if t.elem_slots:
             t.max_elems = max(len(s) for s in t.elem_slots.values())
+        if t.n_lists > self._lists_hi:
+            self._lists_hi = t.n_lists
+        if t.max_elems > self._elems_hi:
+            self._elems_hi = t.max_elems
         return delta
 
     # ------------------------------------------------------------------
@@ -579,6 +591,8 @@ class ResidentDocSet:
                 seqs.append(p.seq)
                 cidxs.append(t.n_changes)
                 t.n_changes += 1
+                if t.n_changes > self._changes_hi:
+                    self._changes_hi = t.n_changes
         if not adm_doc:
             return None, adm_doc, cidxs
 
@@ -591,6 +605,9 @@ class ResidentDocSet:
             t = self.tables[i]
             t.n_lists = int(bd.stats[i, 0])
             t.max_elems = int(bd.stats[i, 1])
+        if len(bd.stats):
+            self._lists_hi = max(self._lists_hi, int(bd.stats[:, 0].max()))
+            self._elems_hi = max(self._elems_hi, int(bd.stats[:, 1].max()))
         return bd, adm_doc, cidxs
 
     def _build_delta_arrays_cols(self, cols_by_doc: dict):

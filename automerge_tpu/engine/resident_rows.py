@@ -264,7 +264,13 @@ class ResidentRowsDocSet(ResidentDocSet):
     def _mirror_stats(self, bd, docs) -> None:
         """Mirror the native encoder's per-doc list/elem stats into the
         host tables (shared by the batched and per-round encode paths)."""
-        for i in np.unique(docs):
+        touched = np.unique(docs)
+        if len(touched) and len(bd.stats):
+            sub = bd.stats[touched[touched < len(bd.stats)]]
+            if len(sub):
+                self._lists_hi = max(self._lists_hi, int(sub[:, 0].max()))
+                self._elems_hi = max(self._elems_hi, int(sub[:, 1].max()))
+        for i in touched:
             if i < len(bd.stats):
                 t = self.tables[i]
                 t.n_lists = int(bd.stats[i, 0])
@@ -743,12 +749,10 @@ class ResidentRowsDocSet(ResidentDocSet):
 
         cap_ops = max(self.cap_ops,
                       _pad_to(int(need_ops.max(initial=1))))
-        cur_elems = max((t.max_elems for t in self.tables), default=0)
         cap_elems = max(self.cap_elems, _pad_to(
-            cur_elems + max(n_elems.values(), default=0)))
-        cur_lists = max((t.n_lists for t in self.tables), default=0)
+            self._elems_hi + max(n_elems.values(), default=0)))
         cap_lists = max(self.cap_lists, _pad_to(
-            cur_lists + max(n_lists.values(), default=0), 1))
+            self._lists_hi + max(n_lists.values(), default=0), 1))
         from .pack import rows_dims_eligible
         if not rows_dims_eligible(cap_ops, self.cap_actors,
                                   cap_lists * cap_elems):
@@ -800,21 +804,18 @@ class ResidentRowsDocSet(ResidentDocSet):
         grow = {}
         if need_ops.max(initial=0) > self.cap_ops:
             grow["cap_ops"] = _pad_to(int(need_ops.max()))
-        need_lists = max((t.n_lists for t in self.tables), default=0)
-        need_elems = max((t.max_elems for t in self.tables), default=0)
-        if need_lists > self.cap_lists:
-            grow["cap_lists"] = _pad_to(need_lists, 1)
-        if need_elems > self.cap_elems:
-            grow["cap_elems"] = _pad_to(need_elems)
+        if self._lists_hi > self.cap_lists:
+            grow["cap_lists"] = _pad_to(self._lists_hi, 1)
+        if self._elems_hi > self.cap_elems:
+            grow["cap_elems"] = _pad_to(self._elems_hi)
         self._check_rows_budget(
             grow.get("cap_ops", self.cap_ops),
             grow.get("cap_lists", self.cap_lists)
             * grow.get("cap_elems", self.cap_elems))
         if grow:
             self._grow(**grow)
-        need_ch = int(max((t.n_changes for t in self.tables), default=0))
-        if need_ch > self.cap_changes:
-            self.cap_changes = _pad_to(need_ch)
+        if self._changes_hi > self.cap_changes:
+            self.cap_changes = _pad_to(self._changes_hi)
 
     def _cols_triplets(self, enc) -> np.ndarray:
         """Vectorized scatter-triplet assembly from one round's BatchDelta
@@ -1000,12 +1001,11 @@ class ResidentRowsDocSet(ResidentDocSet):
                 np.add.at(n_lists, op_doc, (acts == l1) | (acts == l2))
 
         cap_ops = max(self.cap_ops, _pad_to(int(need_ops.max(initial=1))))
-        cur_elems = max((t.max_elems for t in self.tables), default=0)
         cap_elems = max(self.cap_elems,
-                        _pad_to(cur_elems + int(n_elems.max(initial=0))))
-        cur_lists = max((t.n_lists for t in self.tables), default=0)
+                        _pad_to(self._elems_hi + int(n_elems.max(initial=0))))
         cap_lists = max(self.cap_lists,
-                        _pad_to(cur_lists + int(n_lists.max(initial=0)), 1))
+                        _pad_to(self._lists_hi + int(n_lists.max(initial=0)),
+                                1))
         from .pack import rows_dims_eligible
         if not rows_dims_eligible(cap_ops, self.cap_actors,
                                   cap_lists * cap_elems):
@@ -1196,6 +1196,8 @@ class ResidentRowsDocSet(ResidentDocSet):
             change_log[i].append(AdmittedRef(cols_of[r], j))
             cidx[pos] = t.n_changes
             t.n_changes += 1
+            if t.n_changes > self._changes_hi:
+                self._changes_hi = t.n_changes
             if t._stale_idx is None:
                 t._stale_idx = i
                 t.clock = self._StaleView(self, t, "clock")
@@ -1316,6 +1318,8 @@ class ResidentRowsDocSet(ResidentDocSet):
             change_log[i].append(AdmittedRef(cols, j))
             cidx_fast[pos] = t.n_changes
             t.n_changes += 1
+            if t.n_changes > self._changes_hi:
+                self._changes_hi = t.n_changes
             if t._stale_idx is None:
                 t._stale_idx = i
                 t.clock = self._StaleView(self, t, "clock")
@@ -1367,6 +1371,8 @@ class ResidentRowsDocSet(ResidentDocSet):
                     seqs.append(p.seq)
                     cidxs.append(t.n_changes)
                     t.n_changes += 1
+                    if t.n_changes > self._changes_hi:
+                        self._changes_hi = t.n_changes
             self._cache_dirty.add(i)
 
         n_adm = n_fast + len(adm_doc)
