@@ -145,3 +145,40 @@ def test_apply_host_bulk_engages_on_concurrent_log():
     assert snap.get("bulkload_fallback_keyerror", 0) == 0
     # positive signal: the bulk path really built (not interpretive)
     assert snap.get("host_bulk_built", 0) == 1, snap
+
+
+def test_causal_order_property_random_shuffles():
+    """Property: for ANY permutation of a complete change log, _causal_order
+    returns a valid causal order containing exactly the same changes; for
+    any log with a change removed, it returns None."""
+    import random as _random
+
+    from automerge_tpu.engine.dispatch import _causal_order
+
+    rng = _random.Random(123)
+    conc = _trace_concurrent(8)
+    for trial in range(25):
+        shuffled = list(conc)
+        rng.shuffle(shuffled)
+        ordered = _causal_order(shuffled)
+        assert ordered is not None
+        assert sorted((c.actor, c.seq) for c in ordered) \
+            == sorted((c.actor, c.seq) for c in conc)
+        clock = {}
+        for c in ordered:
+            assert c.seq == clock.get(c.actor, 0) + 1
+            assert all(clock.get(a, 0) >= s for a, s in c.deps.items())
+            clock[c.actor] = c.seq
+        # drop one random change: no causal order may exist for the rest
+        # of that actor's chain (and usually for cross-actor dependents)
+        k = rng.randrange(len(shuffled))
+        broken = shuffled[:k] + shuffled[k + 1:]
+        got = _causal_order(broken)
+        if got is not None:
+            # legal only if nothing depended on the dropped change and it
+            # was the tail of its actor chain
+            dropped = shuffled[k]
+            assert all(c.actor != dropped.actor or c.seq < dropped.seq
+                       for c in broken)
+            assert all(c.deps.get(dropped.actor, 0) < dropped.seq
+                       for c in broken)
