@@ -207,3 +207,37 @@ class TestUndoRedoRemoteInteraction:
         assert s1["trout"] == 2 and s1["salmon"] == 1
         s1 = am.redo(s1)
         assert s1["trout"] == 3 and s1["salmon"] == 1
+
+
+class TestUndoObjectCreation:
+    """Ports test.js 851-858 ('undo object creation by removing the link')
+    and 985-994 ('undo/redo object creation and linking')."""
+
+    def test_undo_object_creation_removes_link(self):
+        s = am.change(am.init(), lambda d: d.__setitem__(
+            "settings", {"background": "white", "text": "black"}))
+        assert s == {"settings": {"background": "white", "text": "black"}}
+        s = am.undo(s)
+        assert s == {}
+
+    def test_undo_redo_object_creation_and_linking(self):
+        s1 = am.change(am.init(), lambda d: d.__setitem__(
+            "settings", {"background": "white", "text": "black"}))
+        s2 = am.undo(s1)
+        assert s2 == {}
+        s2 = am.redo(s2)
+        assert s2 == {"settings": {"background": "white", "text": "black"}}
+
+    def test_undo_redo_link_deletion_interleaved_objects(self):
+        """test.js 996-1006: link deletion undo restores the OLD object
+        while unrelated links survive; redo re-deletes."""
+        s = am.change(am.init(), lambda d: d.__setitem__(
+            "fish", ["trout", "sea bass"]))
+        s = am.change(s, lambda d: d.__setitem__(
+            "birds", ["heron", "magpie"]))
+        s = am.change(s, lambda d: d.__delitem__("fish"))
+        s = am.undo(s)
+        assert s == {"fish": ["trout", "sea bass"],
+                     "birds": ["heron", "magpie"]}
+        s = am.redo(s)
+        assert s == {"birds": ["heron", "magpie"]}
