@@ -350,8 +350,14 @@ def build_opset(cols) -> OpSet:
         # DIFFERENT change in the group causally knows op i —
         # clock[ci_j, actor_i] >= seq_i. Replaces the O(g^2) Python double
         # loop that dominated the LWW-storm build (many ops per field).
+        # Below this group size the plain Python domination loop beats the
+        # numpy path's setup cost (tombstone/text logs: 2-3 ops per key);
+        # above it, one dense comparison wins (LWW storms: 40+ per key).
+        # ONE constant for both the branch and the clock_mat build gate —
+        # the numpy branch requires the matrix.
+        SMALL_GROUP = 8
         clock_mat = None
-        if any(hi - lo > 1 for (_j0, lo, hi) in ranges):
+        if any(hi - lo > SMALL_GROUP for (_j0, lo, hi) in ranges):
             actor_code = {a: c for c, a in enumerate(actors)}
             clock_mat = np.zeros((n_ch, len(actors)), np.int64)
             for i2, d in enumerate(all_deps):
@@ -377,27 +383,53 @@ def build_opset(cols) -> OpSet:
                 if op.action == "link":
                     inbound_adds.append((j0, op.value, op))
                 continue
-            # multi-op field: vectorized pairwise domination over the group
+            # multi-op field: pairwise domination over the group. Two
+            # regimes: small groups (the common tombstone/text shape, 2-3
+            # ops per element key) stay on the plain loop — numpy setup
+            # costs more than it saves there; big groups (LWW storms,
+            # 40+ concurrent sets per key) go through one dense numpy
+            # comparison against the per-change clock matrix.
             g = hi - lo
             idxs = grouped[lo:hi]
-            cis = np.fromiter((op_change_l[j] for j in idxs), np.int64, g)
-            cis_l = cis.tolist()
-            seqs = np.fromiter((ch_seq_l[ci] for ci in cis_l), np.int64, g)
-            acts = np.fromiter((ch_actor_l[ci] for ci in cis_l),
-                               np.int64, g)
-            vals = clock_mat[cis][:, acts]            # [j, i]
-            dom = ((vals >= seqs[None, :])
-                   & (cis[:, None] != cis[None, :])).any(axis=0)
-            actions = np.fromiter((op_action_l[j] for j in idxs),
-                                  np.int64, g)
-            keep = np.nonzero(~dom & (actions != i_del))[0].tolist()
             remaining = []
-            for x in keep:
-                j = idxs[x]
-                op = _stamp(hist_ops[j], actors[acts[x]], int(seqs[x]))
-                remaining.append(op)
-                if op.action == "link":
-                    inbound_adds.append((j, op.value, op))
+            if g <= SMALL_GROUP:
+                metas = []
+                for j in idxs:
+                    ci = op_change_l[j]
+                    metas.append((j, ci, actors[ch_actor_l[ci]],
+                                  ch_seq_l[ci]))
+                for (j, ci, astr, s) in metas:
+                    dominated = False
+                    for (_j2, ci2, _a2, _s2) in metas:
+                        if ci2 != ci and all_deps[ci2].get(astr, 0) >= s:
+                            dominated = True
+                            break
+                    if dominated or op_action_l[j] == i_del:
+                        continue
+                    op = _stamp(hist_ops[j], astr, s)
+                    remaining.append(op)
+                    if op.action == "link":
+                        inbound_adds.append((j, op.value, op))
+            else:
+                cis = np.fromiter((op_change_l[j] for j in idxs),
+                                  np.int64, g)
+                cis_l = cis.tolist()
+                seqs = np.fromiter((ch_seq_l[ci] for ci in cis_l),
+                                   np.int64, g)
+                acts = np.fromiter((ch_actor_l[ci] for ci in cis_l),
+                                   np.int64, g)
+                vals = clock_mat[cis][:, acts]            # [j, i]
+                dom = ((vals >= seqs[None, :])
+                       & (cis[:, None] != cis[None, :])).any(axis=0)
+                actions = np.fromiter((op_action_l[j] for j in idxs),
+                                      np.int64, g)
+                for x in np.nonzero(~dom & (actions != i_del))[0].tolist():
+                    j = idxs[x]
+                    op = _stamp(hist_ops[j], actors[acts[x]],
+                                int(seqs[x]))
+                    remaining.append(op)
+                    if op.action == "link":
+                        inbound_adds.append((j, op.value, op))
             remaining.sort(key=lambda o: o.actor or "", reverse=True)
             obj.fields[key_str] = tuple(remaining)
         # inbound links in application order (get_path reads the first)

@@ -118,7 +118,76 @@ def reconcile_rows_sharded(doc_changes, mesh: Mesh, interpret: bool | None = Non
     return np.asarray(hashes)[:len(doc_changes)], len(doc_changes)
 
 
+def reconcile_rows_sharded_bytes(doc_changes, mesh: Mesh,
+                                 interpret: bool | None = None):
+    """Mesh-sharded megakernel fed by the COMPACT BYTE WIRE: each dtype
+    group of `pack.pack_rows_bytes` is reshaped to expose the document
+    lane axis ([rows_dt, d_pad, itemsize] uint8), sharded on that axis,
+    and widened to the int32 row buffer INSIDE each shard's program — so
+    a pod ingests ~2.6x fewer wire bytes per chip than the wide path
+    (reconcile_rows_sharded) with bit-identical hashes. No cross-shard
+    communication, same as the wide variant. Returns
+    (hashes[n_docs] uint32, n_docs)."""
+    from ..engine.pack import pack_rows_compact
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n = mesh.devices.size
+    _encs, batch, max_fids = encode_padded_batch(doc_changes, mesh,
+                                                 multiple=128 * n)
+    (b8, b16, b32), meta, dims, _d = pack_rows_compact(batch, max_fids)
+    # expose the document lane axis per dtype group: [rows_dt, d_pad, k]
+    groups = tuple(
+        np.ascontiguousarray(b).view(np.uint8).reshape(b.shape[0],
+                                                       b.shape[1], k)
+        if b.shape[0] else np.zeros((0, b.shape[1], k), np.uint8)
+        for b, k in ((b8, 1), (b16, 2), (b32, 4)))
+    fn = _sharded_bytes_fn(mesh, meta, dims, interpret)
+    sh = NamedSharding(mesh, P(None, DOCS_AXIS, None))
+    hashes = fn(*(jax.device_put(g, sh) for g in groups))
+    return np.asarray(hashes)[:len(doc_changes)], len(doc_changes)
+
+
 _SHARDED_ROWS_CACHE: dict = {}
+
+
+def _sharded_bytes_fn(mesh: Mesh, meta: tuple, dims: tuple,
+                      interpret: bool):
+    key = ("bytes", id(mesh), meta, dims, interpret)
+    fn = _SHARDED_ROWS_CACHE.get(key)
+    if fn is not None:
+        return fn
+    import jax.numpy as jnp
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    from ..engine.pack import apply_rows_hash_compact
+
+    def body(g8, g16, g32):
+        b8 = (jax.lax.bitcast_convert_type(g8[..., 0], jnp.int8)
+              if g8.shape[0] else jnp.zeros((0, g8.shape[1]), jnp.int8))
+        b16 = (jax.lax.bitcast_convert_type(g16, jnp.int16)
+               if g16.shape[0] else jnp.zeros((0, g16.shape[1]), jnp.int16))
+        b32 = (jax.lax.bitcast_convert_type(g32, jnp.int32)
+               if g32.shape[0] else jnp.zeros((0, g32.shape[1]), jnp.int32))
+        # one shared widen+hash implementation with the single-device
+        # compact path (engine/pack.py) — no duplicated plumbing
+        return apply_rows_hash_compact.__wrapped__(b8, b16, b32, meta,
+                                                   dims, interpret)
+
+    spec = P(None, DOCS_AXIS, None)
+    try:
+        sm = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=P(DOCS_AXIS), check_vma=False)
+    except TypeError:
+        sm = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=P(DOCS_AXIS), check_rep=False)
+    fn = jax.jit(sm)
+    _SHARDED_ROWS_CACHE[key] = fn
+    return fn
 
 
 def _sharded_rows_fn(mesh: Mesh, dims: tuple, interpret: bool):
