@@ -1,0 +1,143 @@
+"""Hypothesis-driven link-chaos fuzz for the sync protocol: three nodes
+(an interpretive DocSet, and two engine-backed EngineDocSets — docs-major
+and rows) in a triangle of lossy links. Random edits interleave with
+random per-message drop/duplicate/reorder chaos; after a reconnect sweep
+(the protocol's documented recovery, test_connection.py:143-161) every
+node must converge to the same state and the engine nodes' hashes must
+match the oracle.
+
+The reference's connection tests script specific loss patterns
+(connection_test.js); hypothesis explores the pattern space and shrinks
+any divergence to a minimal edit/chaos schedule."""
+
+import pytest
+
+try:
+    from hypothesis import HealthCheck, given, settings
+    from hypothesis import strategies as st
+except ImportError:  # pragma: no cover
+    pytest.skip("hypothesis unavailable", allow_module_level=True)
+
+import numpy as np
+
+import automerge_tpu as am
+from automerge_tpu import Connection, DocSet
+from automerge_tpu.sync.service import EngineDocSet
+
+
+class ChaosLink:
+    def __init__(self, node_a, node_b, wire=None):
+        self.q_ab: list = []
+        self.q_ba: list = []
+        kw = {"wire": wire} if wire else {}
+        self.conn_a = Connection(node_a, self.q_ab.append, **kw)
+        self.conn_b = Connection(node_b, self.q_ba.append, **kw)
+
+    def open(self):
+        self.conn_a.open()
+        self.conn_b.open()
+
+    def close(self):
+        for c in (self.conn_a, self.conn_b):
+            try:
+                c.close()
+            except Exception:
+                pass
+
+    def chaos_step(self, action: int) -> None:
+        """One chaotic delivery: action selects queue and fate."""
+        q, dst = ((self.q_ab, self.conn_b) if action % 2 == 0
+                  else (self.q_ba, self.conn_a))
+        if not q:
+            return
+        fate = (action // 2) % 4
+        if fate == 0:                      # deliver in order
+            dst.receive_msg(q.pop(0))
+        elif fate == 1:                    # drop
+            q.pop(0)
+        elif fate == 2:                    # duplicate
+            msg = q.pop(0)
+            dst.receive_msg(msg)
+            dst.receive_msg(msg)
+        else:                              # reorder: deliver the LAST first
+            dst.receive_msg(q.pop())
+
+    def drain(self, max_rounds=200):
+        for _ in range(max_rounds):
+            if not self.q_ab and not self.q_ba:
+                return
+            while self.q_ab:
+                self.conn_b.receive_msg(self.q_ab.pop(0))
+            while self.q_ba:
+                self.conn_a.receive_msg(self.q_ba.pop(0))
+        raise AssertionError("did not quiesce")
+
+
+_step = st.tuples(
+    st.sampled_from(("edit_a", "edit_b", "edit_c", "chaos0", "chaos1",
+                     "chaos2")),
+    st.integers(min_value=0, max_value=23),
+)
+
+
+@settings(max_examples=25, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(st.lists(_step, min_size=1, max_size=25))
+def test_triangle_converges_after_chaos_and_reconnect(steps):
+    oracle_node = DocSet()
+    eng_major = EngineDocSet(backend="resident")
+    eng_rows = EngineDocSet(backend="rows")
+
+    oracle_node.set_doc("d", am.init("seed"))
+    eng_major.add_doc("d")
+    eng_rows.add_doc("d")
+
+    links = [ChaosLink(oracle_node, eng_major),
+             ChaosLink(eng_major, eng_rows, wire="columnar"),
+             ChaosLink(eng_rows, oracle_node)]
+    for ln in links:
+        ln.open()
+
+    n_edit = 0
+    for (kind, arg) in steps:
+        if kind == "edit_a":
+            d = oracle_node.get_doc("d")
+            oracle_node.set_doc("d", am.change(
+                d, lambda x, a=arg: x.__setitem__(f"k{a % 6}", a)))
+            n_edit += 1
+        elif kind == "edit_b":
+            d = oracle_node.get_doc("d")
+            oracle_node.set_doc("d", am.change(
+                d, lambda x, a=arg: x.__setitem__("xs", [a, a + 1])))
+            n_edit += 1
+        elif kind == "edit_c":
+            d = oracle_node.get_doc("d")
+            oracle_node.set_doc("d", am.change(
+                d, lambda x, a=arg: x.__setitem__(f"m{a % 2}",
+                                                  {"v": a})))
+            n_edit += 1
+        else:
+            links[int(kind[-1])].chaos_step(arg)
+
+    # recovery: drop every in-flight message, then reconnect fresh links
+    # (the protocol's documented recovery path) and let them quiesce
+    for ln in links:
+        ln.close()
+    links2 = [ChaosLink(oracle_node, eng_major),
+              ChaosLink(eng_major, eng_rows, wire="columnar"),
+              ChaosLink(eng_rows, oracle_node)]
+    for ln in links2:
+        ln.open()
+    for _ in range(6):
+        for ln in links2:
+            ln.drain()
+
+    want = oracle_node.get_doc("d")
+    want_state = dict(want)
+    # engine nodes converge to the oracle state
+    for eng in (eng_major, eng_rows):
+        got = eng.materialize("d")
+        assert got["data"] == want_state, (got, want_state)
+    # and to each other's hash, bit-exactly
+    assert np.uint32(eng_major.hashes()["d"]) \
+        == np.uint32(eng_rows.hashes()["d"])
