@@ -1,0 +1,96 @@
+"""Hypothesis fuzz for undo/redo semantics against a snapshot model.
+
+The model (ports the reference's contract, test.js 770-1080): undo reverts
+the doc's LOCAL top-level state to the snapshot taken before the most
+recent not-yet-undone local change; redo re-applies in LIFO order; a new
+local change clears the redo stack; remote changes to OTHER fields merge
+through undo/redo untouched. Each program also re-checks save/load
+round-tripping and engine-hash parity of the final doc, so the undo
+machinery's inverse ops stay inside the conformance envelope."""
+
+import pytest
+
+try:
+    from hypothesis import HealthCheck, given, settings
+    from hypothesis import strategies as st
+except ImportError:  # pragma: no cover
+    pytest.skip("hypothesis unavailable", allow_module_level=True)
+
+import numpy as np
+
+import automerge_tpu as am
+from automerge_tpu.engine.batchdoc import apply_batch
+
+_step = st.tuples(
+    st.sampled_from(("set", "del", "undo", "redo", "remote")),
+    st.integers(min_value=0, max_value=5),
+    st.integers(min_value=-50, max_value=50),
+)
+
+
+@settings(max_examples=40, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(st.lists(_step, min_size=1, max_size=30))
+def test_undo_redo_matches_snapshot_model(steps):
+    doc = am.init("L")
+    remote = am.merge(am.init("R"), doc)
+    remote_counter = 0
+
+    # model: stack of (pre-change local snapshot) for each undoable local
+    # change; redo stack of snapshots undone
+    undo_snaps: list[dict] = []
+    redo_snaps: list[dict] = []
+
+    def local_state():
+        # only the fields local changes touch (kN); remote uses rN keys
+        return {k: v for k, v in dict(doc).items() if k.startswith("k")}
+
+    for (kind, k, v) in steps:
+        if kind == "set":
+            pre = local_state()
+            new = am.change(doc, lambda d, k=k, v=v: d.__setitem__(
+                f"k{k}", v))
+            # writing the current value is a no-op change (test.js:94):
+            # nothing lands, nothing becomes undoable
+            if new is not doc:
+                undo_snaps.append(pre)
+                redo_snaps.clear()
+            doc = new
+        elif kind == "del":
+            key = f"k{k}"
+            if key in doc:
+                pre = local_state()
+                doc = am.change(doc, lambda d, key=key: d.__delitem__(key))
+                undo_snaps.append(pre)
+                redo_snaps.clear()
+        elif kind == "undo":
+            assert am.can_undo(doc) == bool(undo_snaps)
+            if undo_snaps:
+                redo_snaps.append(local_state())
+                doc = am.undo(doc)
+                want = undo_snaps.pop()
+                assert local_state() == want, (local_state(), want)
+        elif kind == "redo":
+            assert am.can_redo(doc) == bool(redo_snaps)
+            if redo_snaps:
+                pre = local_state()
+                doc = am.redo(doc)
+                want = redo_snaps.pop()
+                assert local_state() == want, (local_state(), want)
+                undo_snaps.append(pre)  # the redone change is undoable
+        elif kind == "remote":
+            remote = am.merge(remote, doc)
+            remote = am.change(remote, lambda d, c=remote_counter, v=v:
+                               d.__setitem__(f"r{c % 3}", v))
+            remote_counter += 1
+            doc = am.merge(doc, remote)
+            # remote edits must not disturb the undo model's view
+    # end-state conformance: save/load, engine hash parity
+    loaded = am.load(am.save(doc))
+    assert am.equals(loaded, doc)
+    changes = doc._doc.opset.get_missing_changes({})
+    _, _, out = apply_batch([changes])
+    _, _, out2 = apply_batch(
+        [loaded._doc.opset.get_missing_changes({})])
+    assert int(np.asarray(out["hash"])[0]) == int(
+        np.asarray(out2["hash"])[0])
