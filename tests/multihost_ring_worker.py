@@ -1,0 +1,146 @@
+"""Worker for the 4-process multi-host test (not a pytest module).
+
+Generalizes multihost_worker.py to N processes: host 0 is the sync hub
+(TcpSyncServer), hosts 1..N-1 connect as clients; the hub's DocSet relays
+admissions between spokes (Connection forwarding, the reference's
+multi-peer DocSet posture). After DCN convergence every process joins ONE
+global jax.distributed mesh (8 virtual CPU devices total) for a single
+SPMD reconcile with per-shard oracle parity and a cross-host clock union.
+
+Usage: python tests/multihost_ring_worker.py <pid> <nprocs> <coord_port>
+       <sync_port>
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+pid = int(sys.argv[1])
+nprocs = int(sys.argv[2])
+coord_port = sys.argv[3]
+sync_port = int(sys.argv[4])
+per_host = 8 // nprocs
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={per_host}").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from automerge_tpu.parallel.multihost import (global_mesh,  # noqa: E402
+                                              init_multihost,
+                                              reconcile_global)
+
+init_multihost(f"127.0.0.1:{coord_port}", num_processes=nprocs,
+               process_id=pid)
+assert jax.device_count() == 8, jax.device_count()
+assert jax.local_device_count() == per_host
+
+import automerge_tpu as am  # noqa: E402
+from automerge_tpu.sync.docset import DocSet  # noqa: E402
+from automerge_tpu.sync.tcp import (TcpSyncClient, TcpSyncServer,  # noqa: E402
+                                    sync_lock)
+
+N = 8
+ACTOR = f"host{pid}"
+ds = DocSet()
+for i in range(N):
+    if i % nprocs == pid:  # each host authors its residue class
+        d = am.change(am.init(ACTOR), lambda x, i=i: am.assign(
+            x, {"n": i, "xs": [i, i + 1], "owner": ACTOR}))
+        ds.set_doc(f"doc{i}", d)
+
+# --- phase 1: hub-and-spoke DCN sync ------------------------------------
+if pid == 0:
+    link = TcpSyncServer(ds, port=sync_port).start()
+else:
+    link = None
+    for _ in range(200):
+        try:
+            link = TcpSyncClient(ds, "127.0.0.1", sync_port).start()
+            break
+        except OSError:
+            time.sleep(0.1)
+    assert link is not None, "could not reach the hub"
+
+deadline = time.time() + 90
+while time.time() < deadline:
+    docs = [ds.get_doc(f"doc{i}") for i in range(N)]
+    if all(d is not None and "owner" in d for d in docs):
+        break
+    time.sleep(0.05)
+else:
+    missing = [i for i in range(N) if ds.get_doc(f"doc{i}") is None]
+    raise AssertionError(f"[p{pid}] spoke sync did not converge: {missing}")
+
+# every host contributes one concurrent edit to the shared doc0
+with sync_lock(ds):
+    doc0 = ds.get_doc("doc0")
+    if doc0._doc.actor_id == ACTOR:
+        ds.set_doc("doc0", am.change(
+            doc0, lambda x: x.__setitem__("winner", ACTOR)))
+    else:
+        mine = am.change(am.merge(am.init(ACTOR), doc0),
+                         lambda x: x.__setitem__("winner", ACTOR))
+        ds.set_doc("doc0", am.merge(ds.get_doc("doc0"), mine))
+
+deadline = time.time() + 90
+while time.time() < deadline:
+    clock = ds.get_doc("doc0")._doc.opset.clock
+    if all(f"host{h}" in clock for h in range(nprocs)):
+        break
+    time.sleep(0.05)
+else:
+    raise AssertionError(
+        f"[p{pid}] concurrent-edit sync did not converge: "
+        f"{ds.get_doc('doc0')._doc.opset.clock}")
+assert ds.get_doc("doc0")["winner"] in {f"host{h}" for h in range(nprocs)}
+
+# --- phase 2: one global mesh across all processes ----------------------
+mesh = global_mesh()
+with sync_lock(ds):
+    doc_changes = [ds.get_doc(f"doc{i}")._doc.opset.get_missing_changes({})
+                   for i in range(N)]
+lo, hi, local_hashes = reconcile_global(doc_changes, mesh)
+
+from automerge_tpu.engine.batchdoc import apply_batch  # noqa: E402
+
+_, _, ref_out = apply_batch(doc_changes)
+ref = np.asarray(ref_out["hash"]).astype(np.uint32)
+want = ref[lo:min(hi, N)]
+got = local_hashes[:len(want)]
+assert (got == want).all(), f"[p{pid}] shard hash mismatch"
+
+# --- phase 3: cross-host clock union ------------------------------------
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from automerge_tpu.parallel.collective import global_clock_union  # noqa: E402
+from automerge_tpu.parallel.mesh import DOCS_AXIS  # noqa: E402
+
+actors = sorted({c.actor for chs in doc_changes for c in chs})
+rank = {a: k for k, a in enumerate(actors)}
+clocks = np.zeros((N, len(actors)), np.int32)
+for i in range(N):
+    for a, s in ds.get_doc(f"doc{i}")._doc.opset.clock.items():
+        clocks[i, rank[a]] = s
+sh = NamedSharding(mesh, P(DOCS_AXIS))
+arr = jax.make_array_from_process_local_data(
+    sh, np.ascontiguousarray(clocks[lo:hi]), global_shape=clocks.shape)
+union = np.asarray(global_clock_union(arr, mesh))
+# the union must contain EVERY host's seqs even though each host only fed
+# its own shard — the reduction really crossed all process boundaries
+want_union = clocks.max(axis=0)
+assert (union == want_union).all(), f"[p{pid}] union {union} != {want_union}"
+assert all(union[rank[f"host{h}"]] > 0 for h in range(nprocs))
+
+if link is not None:
+    link.close()
+print(f"MULTIHOST4-OK p{pid} winner={ds.get_doc('doc0')['winner']} "
+      f"union={union.tolist()}", flush=True)

@@ -77,3 +77,53 @@ def test_two_process_rows_backend_columnar_sync():
     (EngineDocSet backend="rows") on both hosts."""
     _run_workers("multihost_resident_worker.py", "MULTIHOST-RESIDENT-OK",
                  extra_env={"AMTPU_MH_BACKEND": "rows"})
+
+
+def test_four_process_hub_sync_and_global_mesh():
+    """Four OS processes (2 virtual devices each): hub-and-spoke TCP sync
+    with Connection forwarding relaying every spoke's changes, then ONE
+    global 8-device jax.distributed mesh for the SPMD reconcile and a
+    clock union that must contain all four hosts' seqs."""
+    worker = os.path.join(os.path.dirname(__file__),
+                          "multihost_ring_worker.py")
+    coord, sync = _free_port(), _free_port()
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+
+    nprocs = 4
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(pid), str(nprocs), str(coord),
+         str(sync)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for pid in range(nprocs)]
+    outs = [""] * nprocs
+    deadline = 300
+    import time
+    t0 = time.time()
+    try:
+        for k, p in enumerate(procs):
+            left = max(1.0, deadline - (time.time() - t0))
+            outs[k], _ = p.communicate(timeout=left)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        for k, p in enumerate(procs):
+            try:
+                out, _ = p.communicate(timeout=10)
+                outs[k] = outs[k] or out or ""
+            except Exception:
+                pass
+        pytest.fail("4-process workers timed out:\n"
+                    + "\n---\n".join(o[-2000:] for o in outs))
+
+    winners = set()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        tail = "\n".join(out.splitlines()[-25:])
+        assert p.returncode == 0, f"worker {pid} failed:\n{tail}"
+        assert f"MULTIHOST4-OK p{pid}" in out, f"worker {pid}:\n{tail}"
+        for line in out.splitlines():
+            if line.startswith(f"MULTIHOST4-OK p{pid}"):
+                winners.add(line.split("winner=")[1].split()[0])
+    # every host agreed on the same LWW winner for the contested field
+    assert len(winners) == 1, winners
