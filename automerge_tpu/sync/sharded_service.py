@@ -1,0 +1,113 @@
+"""ShardedEngineDocSet: one sync-node surface over K engine shards.
+
+The rows engine bounds its per-instance working set by the megakernel's
+VMEM envelope and rejects batches that would blow it with the advice
+"shard this DocSet across more rows instances" (resident_rows.py budget
+prechecks). This module productizes that advice: documents are
+partitioned across K independent `EngineDocSet` shards by a stable hash
+of the doc id, every Connection-facing read/write routes to the owning
+shard, and `batch()` coalesces a burst into at most one device dispatch
+PER SHARD — on a multi-chip host each shard's dispatch can bind to its
+own device, making this the single-process analog of the mesh-sharded
+DocSet (parallel/mesh.py) for the streaming service posture.
+
+Duck-typing contract: same surface Connection consumes from EngineDocSet
+(doc_ids, get_doc, add_doc, apply_changes, apply_columns,
+register_handler/unregister_handler), plus the engine reads
+(hashes, materialize, clock_of, missing_changes, flush, batch).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import zlib
+from typing import Callable
+
+from .service import EngineDocSet
+
+
+class ShardedEngineDocSet:
+    def __init__(self, n_shards: int = 2, doc_ids: list[str] | None = None,
+                 backend: str = "rows"):
+        if n_shards < 1:
+            raise ValueError("n_shards must be >= 1")
+        self.n_shards = n_shards
+        self.shards = [EngineDocSet(backend=backend)
+                       for _ in range(n_shards)]
+        for d in doc_ids or []:
+            self.add_doc(d)
+
+    # -- routing ------------------------------------------------------------
+
+    def shard_of(self, doc_id: str) -> EngineDocSet:
+        """Stable assignment: crc32 of the id mod K (deterministic across
+        processes and restarts; no coordination state to persist)."""
+        return self.shards[zlib.crc32(doc_id.encode()) % self.n_shards]
+
+    # -- registry surface ----------------------------------------------------
+
+    @property
+    def doc_ids(self) -> list[str]:
+        return [d for s in self.shards for d in s.doc_ids]
+
+    def get_doc(self, doc_id: str):
+        return self.shard_of(doc_id).get_doc(doc_id)
+
+    def add_doc(self, doc_id: str):
+        return self.shard_of(doc_id).add_doc(doc_id)
+
+    def register_handler(self, handler: Callable) -> None:
+        for s in self.shards:
+            s.register_handler(handler)
+
+    def unregister_handler(self, handler: Callable) -> None:
+        for s in self.shards:
+            s.unregister_handler(handler)
+
+    # -- ingress -------------------------------------------------------------
+
+    def apply_changes(self, doc_id: str, changes):
+        return self.shard_of(doc_id).apply_changes(doc_id, changes)
+
+    def apply_columns(self, doc_id: str, cols):
+        return self.shard_of(doc_id).apply_columns(doc_id, cols)
+
+    def flush(self) -> None:
+        """Flush every shard even if one raises (shards are independent;
+        batch() has the same semantics via ExitStack): the first error
+        propagates after all shards have drained."""
+        first: BaseException | None = None
+        for s in self.shards:
+            try:
+                s.flush()
+            except BaseException as e:
+                first = first or e
+        if first is not None:
+            raise first
+
+    def batch(self):
+        """Coalesce a burst into at most ONE dispatch per shard."""
+        @contextlib.contextmanager
+        def _cm():
+            with contextlib.ExitStack() as stack:
+                for s in self.shards:
+                    stack.enter_context(s.batch())
+                yield self
+        return _cm()
+
+    # -- protocol / engine reads ---------------------------------------------
+
+    def clock_of(self, doc_id: str):
+        return self.shard_of(doc_id).clock_of(doc_id)
+
+    def missing_changes(self, doc_id: str, clock):
+        return self.shard_of(doc_id).missing_changes(doc_id, clock)
+
+    def hashes(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for s in self.shards:
+            out.update(s.hashes())
+        return out
+
+    def materialize(self, doc_id: str):
+        return self.shard_of(doc_id).materialize(doc_id)
