@@ -80,13 +80,10 @@ _step = st.tuples(
 )
 
 
-@settings(max_examples=25, deadline=None,
-          suppress_health_check=[HealthCheck.too_slow])
-@given(st.lists(_step, min_size=1, max_size=25))
-def test_triangle_converges_after_chaos_and_reconnect(steps):
+def _run_triangle(steps, third_node):
     oracle_node = DocSet()
     eng_major = EngineDocSet(backend="resident")
-    eng_rows = EngineDocSet(backend="rows")
+    eng_rows = third_node
 
     oracle_node.set_doc("d", am.init("seed"))
     eng_major.add_doc("d")
@@ -141,3 +138,18 @@ def test_triangle_converges_after_chaos_and_reconnect(steps):
     # and to each other's hash, bit-exactly
     assert np.uint32(eng_major.hashes()["d"]) \
         == np.uint32(eng_rows.hashes()["d"])
+
+
+@settings(max_examples=25, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(st.lists(_step, min_size=1, max_size=25))
+def test_triangle_converges_after_chaos_and_reconnect(steps):
+    _run_triangle(steps, EngineDocSet(backend="rows"))
+
+
+@settings(max_examples=15, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(st.lists(_step, min_size=1, max_size=25))
+def test_triangle_with_sharded_node_converges(steps):
+    from automerge_tpu.sync.sharded_service import ShardedEngineDocSet
+    _run_triangle(steps, ShardedEngineDocSet(n_shards=2))
