@@ -85,7 +85,11 @@ class EngineDocSet:
         if backend not in ("resident", "rows"):
             raise ValueError(f"unknown backend {backend!r}")
         if backend == "rows" and live_views:
-            raise ValueError("live_views requires backend='resident'")
+            raise ValueError(
+                "live_views requires backend='resident' (device-side diff "
+                "emission lives in the docs-major engine); rows-backend "
+                "consumers get the same per-doc view/diff surface from "
+                "engine.diffs.PerOpDiffStream + MirrorDoc")
         self.backend = backend
         if backend == "rows":
             from ..engine.resident_rows import ResidentRowsDocSet
