@@ -48,7 +48,12 @@ N = 8
 ACTOR = f"host{pid}"
 # AMTPU_MH_BACKEND=rows runs the same protocol over the docs-minor
 # streaming engine (EngineDocSet backend="rows")
-engine = EngineDocSet(backend=os.environ.get("AMTPU_MH_BACKEND", "resident"))
+_backend = os.environ.get("AMTPU_MH_BACKEND", "resident")
+if _backend == "sharded":
+    from automerge_tpu.sync.sharded_service import ShardedEngineDocSet
+    engine = ShardedEngineDocSet(n_shards=2)
+else:
+    engine = EngineDocSet(backend=_backend)
 for i in range(N):
     if i % 2 == pid:  # each host authors half the fleet
         d = am.change(am.init(ACTOR), lambda x, i=i: am.assign(

@@ -127,3 +127,10 @@ def test_four_process_hub_sync_and_global_mesh():
                 winners.add(line.split("winner=")[1].split()[0])
     # every host agreed on the same LWW winner for the contested field
     assert len(winners) == 1, winners
+
+
+def test_two_process_sharded_service_columnar_sync():
+    """The sharded service node (K engine shards behind one sync surface)
+    syncing binary columnar frames over TCP between two OS processes."""
+    _run_workers("multihost_resident_worker.py", "MULTIHOST-RESIDENT-OK",
+                 extra_env={"AMTPU_MH_BACKEND": "sharded"})
