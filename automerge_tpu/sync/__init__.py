@@ -1,5 +1,8 @@
 from .docset import DocSet
 from .watchable import WatchableDoc
 from .connection import Connection
+from .service import EngineDocSet
+from .sharded_service import ShardedEngineDocSet
 
-__all__ = ["DocSet", "WatchableDoc", "Connection"]
+__all__ = ["DocSet", "WatchableDoc", "Connection", "EngineDocSet",
+           "ShardedEngineDocSet"]
