@@ -69,3 +69,31 @@ def test_sharded_node_converges_with_plain_node_over_connection():
     assert np.uint32(sharded.hashes()["d"]) == want
     assert np.uint32(plain.hashes()["d"]) == want
     assert sharded.materialize("d") == plain.materialize("d")
+
+
+def test_poisoned_shard_is_isolated():
+    """A poisoned shard (unrecoverable mid-admission failure) must fail
+    loudly on ITS docs while the other shards keep serving theirs; the
+    fleet-wide hashes() read surfaces the poison rather than silently
+    dropping the shard."""
+    import pytest
+
+    e = ShardedEngineDocSet(n_shards=2)
+    ids = [f"d{i}" for i in range(8)]
+    chs = {did: _mk(i) for i, did in enumerate(ids)}
+    for did in ids:
+        e.apply_changes(did, chs[did])
+    sick = e.shards[0]
+    healthy = e.shards[1]
+    sick_doc = next(d for d in ids if e.shard_of(d) is sick)
+    ok_doc = next(d for d in ids if e.shard_of(d) is healthy)
+
+    sick._resident._poison(RuntimeError("injected"))
+    # healthy shard unaffected
+    assert e.materialize(ok_doc)["data"]["n"] == int(ok_doc[1:])
+    assert np.uint32(healthy.hashes()[ok_doc]) == oracle_hash(chs[ok_doc])
+    # sick shard's docs fail loudly, as does the fleet-wide read
+    with pytest.raises(RuntimeError, match="no longer reflects"):
+        e.shard_of(sick_doc).hashes()
+    with pytest.raises(RuntimeError, match="no longer reflects"):
+        e.hashes()
