@@ -119,3 +119,27 @@ def test_rows_document_axis_growth():
                                            value=999),))
     e.apply_changes("d0", [ch])
     assert e.materialize("d0")["data"]["n"] == 999
+
+
+def test_many_actors_grow_clock_bands_with_parity():
+    """20 actors accrete onto one doc through the rows service: each new
+    actor triggers rank remap and eventually actor-capacity growth (the
+    clock_op band is actors-major, so cap_actors doubling re-layouts the
+    row buffer). Hash parity with the oracle must hold throughout."""
+    e = EngineDocSet(backend="rows")
+    e.add_doc("d")
+    base = am.change(am.init("actor00"), lambda d: am.assign(
+        d, {"n": 0, "xs": [1]}))
+    e.apply_changes("d", base._doc.opset.get_missing_changes({}))
+    merged = base
+    for k in range(1, 20):
+        prev_clock = dict(merged._doc.opset.clock)
+        mine = am.change(am.merge(am.init(f"actor{k:02d}"), merged),
+                         lambda d, k=k: d.__setitem__(f"f{k % 5}", k))
+        delta = mine._doc.opset.get_missing_changes(prev_clock)
+        e.apply_changes("d", delta)
+        merged = mine
+    want = oracle_hash(merged._doc.opset.get_missing_changes({}))
+    assert np.uint32(e.hashes()["d"]) == want
+    assert e._resident.cap_actors >= 20
+    assert e.materialize("d")["data"]["n"] == 0
