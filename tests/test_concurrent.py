@@ -258,3 +258,30 @@ class TestChangesAPI:
         wire = json.dumps(changes)
         target = am.apply_changes(am.init(), json.loads(wire))
         assert target == {"doc": {"title": "hello", "tags": ["x", "y"]}}
+
+
+class TestInsertionActorOrder:
+    """test.js 735-770: concurrent head-insertions resolve the same way
+    regardless of which side has the greater actor ID, and insertion
+    order stays consistent with causality."""
+
+    def test_insertion_by_greater_and_lesser_actor_id(self):
+        for first, second in (("A", "B"), ("B", "A")):
+            s1 = am.change(am.init(first),
+                           lambda d: d.__setitem__("list", ["two"]))
+            s2 = am.merge(am.init(second), s1)
+            s2 = am.change(s2, lambda d: d["list"].insert_at(0, "one"))
+            merged = am.merge(s1, s2)
+            assert list(merged["list"]) == ["one", "two"], (first, second)
+
+    def test_insertion_order_consistent_with_causality(self):
+        s1 = am.change(am.init("A"), lambda d: d.__setitem__(
+            "list", ["four"]))
+        s2 = am.merge(am.init("B"), s1)
+        s2 = am.change(s2, lambda d: d["list"].insert_at(0, "three"))
+        s1 = am.merge(s1, s2)
+        s1 = am.change(s1, lambda d: d["list"].insert_at(0, "two"))
+        s2 = am.merge(s2, s1)
+        s2 = am.change(s2, lambda d: d["list"].insert_at(0, "one"))
+        merged = am.merge(s1, s2)
+        assert list(merged["list"]) == ["one", "two", "three", "four"]
