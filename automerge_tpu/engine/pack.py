@@ -227,6 +227,13 @@ def _narrow_dtype(part: np.ndarray):
 
 _DTYPES = (np.int8, np.int16, np.int32)
 # ROW_FIELDS positions of the content-hash groups: never narrowable.
+# fields whose width is declared from a capacity bound with NO data
+# inspection (classify_row_groups keys its cap_hi dict from this set) —
+# the only ones where a narrow astype could silently wrap, so the only
+# ones pack_rows_compact range-checks
+_CAP_FIELDS = frozenset((
+    "op_mask", "action", "fid", "actor", "ins_mask", "ins_fid", "ins_pos"))
+_CAP_GROUPS = frozenset(ROW_FIELDS.index(f) for f in _CAP_FIELDS)
 _HASH_GROUPS = frozenset((ROW_FIELDS.index("fid_hash"),
                           ROW_FIELDS.index("value_hash"),
                           ROW_FIELDS.index("elem_objhash")))
@@ -256,15 +263,17 @@ def classify_row_groups(rows, dims: tuple, max_fids: int) -> tuple:
       so a streaming deployment retraces O(log) times over its lifetime
       instead of whenever a value grazes a boundary."""
     i, a, le = dims[0], dims[1], dims[2]
-    cap_hi = {
-        ROW_FIELDS.index("op_mask"): 1,
-        ROW_FIELDS.index("action"): 32,       # enum, ~10 actions
-        ROW_FIELDS.index("fid"): max(max_fids, 1),
-        ROW_FIELDS.index("actor"): max(a, 1),
-        ROW_FIELDS.index("ins_mask"): 1,
-        ROW_FIELDS.index("ins_fid"): max(max_fids, 1),
-        ROW_FIELDS.index("ins_pos"): max(le, 1),
+    cap_bound = {
+        "op_mask": 1,
+        "action": 32,       # enum, ~10 actions
+        "fid": max(max_fids, 1),
+        "actor": max(a, 1),
+        "ins_mask": 1,
+        "ins_fid": max(max_fids, 1),
+        "ins_pos": max(le, 1),
     }
+    assert set(cap_bound) == _CAP_FIELDS   # checker and classifier agree
+    cap_hi = {ROW_FIELDS.index(f): v for f, v in cap_bound.items()}
     group_rows = (i, i, i, i, i, i, i, i, a * i, le, le, le, le, le)
     widths = []
     off = 0
@@ -299,9 +308,23 @@ def pack_rows_compact(batch: dict, max_fids: int):
     widths = classify_row_groups(rows, dims, max_fids)
     parts8, parts16, parts32, meta = [], [], [], []
     off = 0
-    for r, idx in zip(group_rows, widths):
+    for g, (r, idx) in enumerate(zip(group_rows, widths)):
         part = rows[off:off + r]
         off += r
+        if idx < 2 and part.size and g in _CAP_GROUPS:
+            # a narrow astype silently wraps out-of-range values into
+            # corrupt (but hashable) rows — fail loudly if a declared
+            # capacity bound (ADVICE r4, pack.py:276) is ever violated.
+            # Observed-max groups cannot wrap (their width came from this
+            # same array with 2x headroom), so only capacity-derived
+            # groups are scanned.
+            info = np.iinfo(_DTYPES[idx])
+            lo, hi = int(part.min()), int(part.max())
+            if lo < info.min or hi > info.max:
+                raise ValueError(
+                    f"row group {g} [{lo}, {hi}] exceeds its declared "
+                    f"{_DTYPES[idx].__name__} capacity — layout invariant "
+                    f"violated (classify_row_groups)")
         (parts8, parts16, parts32)[idx].append(part.astype(_DTYPES[idx]))
         meta.append((idx, r))
     d_pad = rows.shape[1]

@@ -43,9 +43,20 @@ class DeviceDispatchError(RuntimeError):
     the tunneled TPU). Host truth — change_log, per-doc clocks, and the
     rows_host mirror (kept current by _cols_triplets BEFORE dispatch) — is
     fully consistent; only the device buffer is suspect, and the engine has
-    marked itself dirty so the next dispatch re-uploads the mirror. Callers
-    must NOT replay the ingress: the clock dedup would drop it while the log
-    already records it as admitted."""
+    marked itself dirty so the next dispatch re-uploads the mirror.
+
+    ``admission_complete`` tells the caller whether the whole batch made it
+    into host truth. True (dispatch guard): every change in the batch was
+    admitted, queued, or dropped as a duplicate — nothing to retry. False
+    (mid-admission rebuild): the unprocessed suffix of the batch is in
+    neither the rebuilt log nor the queue — the caller should replay the
+    batch; the (actor, seq) admission dedup drops the already-admitted
+    prefix idempotently, so the retry admits exactly the missing
+    remainder."""
+
+    def __init__(self, msg: str, *, admission_complete: bool = False):
+        super().__init__(msg)
+        self.admission_complete = admission_complete
 
 
 class ResidentRowsDocSet(ResidentDocSet):
@@ -550,18 +561,20 @@ class ResidentRowsDocSet(ResidentDocSet):
             self._dirty = True
             self._hash_handle = None
             metrics.bump("rows_dispatch_failed")
-            raise DeviceDispatchError(str(e)) from e
+            raise DeviceDispatchError(str(e), admission_complete=True) from e
 
     @contextlib.contextmanager
     def _admission_guard(self):
         """Wrap the admission + mirror-scatter region. A failure midway
         (encoder error, grow/copy MemoryError, the defensive budget check)
-        can leave change_log/clocks ahead of the rows_host mirror — a state
-        no retry can fix incrementally, because the clock dedup would drop
-        the replay. If anything was admitted, rebuild row state from the
-        authoritative log and report the batch as admitted (typed error);
-        if nothing was admitted, the original error propagates and the
-        caller may safely retry the ingress."""
+        can leave change_log/clocks ahead of the rows_host mirror AND an
+        unprocessed suffix of the batch in neither log nor queue. If
+        anything was admitted, rebuild row state from the authoritative
+        log and raise the typed error with admission_complete=False: the
+        caller should replay the whole batch — the (actor, seq) dedup
+        drops the already-admitted prefix idempotently, so the retry
+        admits exactly the lost remainder. If nothing was admitted, the
+        original error propagates and the caller may safely retry."""
         log_lens = [len(log) for log in self.change_log]
         try:
             yield
@@ -577,7 +590,8 @@ class ResidentRowsDocSet(ResidentDocSet):
                     raise
                 metrics.bump("rows_rebuilt_from_log")
                 self._rebuild_from_log()
-                raise DeviceDispatchError(str(e)) from e
+                raise DeviceDispatchError(
+                    str(e), admission_complete=False) from e
             raise
 
     def _poison(self, cause) -> None:

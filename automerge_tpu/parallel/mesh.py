@@ -153,7 +153,11 @@ _SHARDED_ROWS_CACHE: dict = {}
 
 def _sharded_bytes_fn(mesh: Mesh, meta: tuple, dims: tuple,
                       interpret: bool):
-    key = ("bytes", id(mesh), meta, dims, interpret)
+    # the Mesh itself is the cache key (ADVICE r4, mesh.py:156): its
+    # __eq__/__hash__ compare axis names/shape and the actual Device
+    # objects, so a new Mesh over a restarted backend can never alias a
+    # cached fn bound to dead devices the way id(mesh) could
+    key = ("bytes", mesh, meta, dims, interpret)
     fn = _SHARDED_ROWS_CACHE.get(key)
     if fn is not None:
         return fn
@@ -193,7 +197,7 @@ def _sharded_bytes_fn(mesh: Mesh, meta: tuple, dims: tuple,
 def _sharded_rows_fn(mesh: Mesh, dims: tuple, interpret: bool):
     """Jitted shard_map'd megakernel, cached per (mesh, dims, interpret) so
     repeated reconciles do not retrace/recompile."""
-    key = (id(mesh), dims, interpret)
+    key = (mesh, dims, interpret)
     fn = _SHARDED_ROWS_CACHE.get(key)
     if fn is not None:
         return fn

@@ -246,18 +246,19 @@ class EngineDocSet:
         pre = {d: len(rset.change_log[rset.doc_index[d]]) for d in pending}
         try:
             rset.apply_round_frames([round_from_parts(pending)])
-        except DeviceDispatchError:
+        except DeviceDispatchError as e:
             # The admitted part of the flush is durable on the host
-            # (change_log, clocks and the row mirror are consistent — a
-            # dispatch failure, or a mid-admission failure recovered by
-            # rebuild-from-log). Replaying an ADMITTED doc would silently
-            # diverge: the clock dedup drops it while the log records it.
-            # But a partial-admission rebuild also lands here, so restore
-            # any docs whose log verifiably did not advance — their
-            # changes never admitted and a later flush must retry them.
-            self._pending = {
-                d: cols for d, cols in pending.items()
-                if len(rset.change_log[rset.doc_index[d]]) == pre[d]}
+            # (change_log, clocks, queue and the row mirror are consistent).
+            # admission_complete=True (pure dispatch failure): every change
+            # in the round reached host truth — admitted, causally queued,
+            # or dropped as a duplicate — so nothing needs retrying.
+            # admission_complete=False (mid-admission rebuild-from-log):
+            # the unprocessed suffix of the round is in neither the rebuilt
+            # log nor the queue, so restore EVERY doc of the round — the
+            # engine's (actor, seq) dedup drops the already-admitted prefix
+            # idempotently and the retry admits exactly the remainder.
+            if not getattr(e, "admission_complete", False):
+                self._pending = dict(pending)
         except Exception:
             # Pre-admission failure (budget precheck, malformed frame, …).
             # Restore ONLY the docs whose changes verifiably did not admit

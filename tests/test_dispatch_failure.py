@@ -1,15 +1,19 @@
-"""Dispatch-failure recovery on the rows sync service (ADVICE r3 medium).
+"""Dispatch-failure recovery on the rows sync service (ADVICE r3 medium,
+ADVICE r4 medium).
 
 A device dispatch can fail AFTER host admission succeeded (plausible on the
 tunneled TPU). The engine keeps rows_host as an exact pre-dispatch mirror, so
 the correct recovery is: keep the admission (change_log / clocks / mirror are
-consistent), drop the device buffer, and rebuild it lazily — NOT re-queue the
-ingress, which the clock dedup would then drop as duplicates while the log
-records it as admitted (silent divergence).
+consistent), drop the device buffer, and rebuild it lazily. The typed error's
+``admission_complete`` flag tells the service whether anything from the round
+could have been lost: a pure dispatch failure (True) retries nothing, while a
+mid-admission rebuild (False) restores EVERY doc of the round — the engine's
+(actor, seq) dedup drops the already-admitted prefix idempotently, so the
+retry admits exactly the missing remainder and no ingress is ever silently
+lost (ADVICE r4 medium, service.py:260).
 
-Pre-admission failures (budget precheck, malformed frames) must instead
-restore exactly the docs whose changes did not admit, so a later flush can
-retry them.
+Pre-admission failures (budget precheck, malformed frames) restore exactly
+the docs whose changes did not admit, so a later flush can retry them.
 """
 
 import numpy as np
@@ -138,7 +142,12 @@ def test_midadmission_failure_rebuilds_from_log():
     e.apply_changes("d1", chs1)   # DeviceDispatchError swallowed by service
     rset = e._resident            # rebuild replaced engine internals
 
-    # admitted in the (rebuilt) log, not re-queued, and row state converges
+    # admitted in the (rebuilt) log; the round returns to pending because a
+    # mid-admission rebuild cannot prove the whole round reached the log
+    # (admission_complete=False) — the retry is a pure duplicate-drop
+    assert "d1" in e._pending
+    assert len(rset.change_log[rset.doc_index["d1"]]) == len(chs1)
+    e.flush()
     assert e._pending == {}
     assert len(rset.change_log[rset.doc_index["d1"]]) == len(chs1)
     h = e.hashes()
@@ -151,10 +160,16 @@ def test_midadmission_failure_rebuilds_from_log():
     assert "_cols_triplets" not in rset.__dict__
 
 
-def test_partial_admission_restores_only_unadmitted_docs():
-    """A DeviceDispatchError can cover a PARTIAL admission (mid-admission
-    rebuild): docs whose log did not advance must return to pending so a
-    later flush retries them, while admitted docs must not be replayed."""
+def test_partial_admission_restores_whole_round_and_dedups():
+    """A mid-admission rebuild (admission_complete=False) can leave an
+    arbitrary suffix of the round unprocessed — neither logged nor queued.
+    The service must restore EVERY doc of the round (ADVICE r4 medium); on
+    retry the already-admitted prefix duplicate-drops against the real
+    clocks and only the lost remainder admits — no silent loss, no
+    double-apply."""
+    from automerge_tpu.native.wire import changes_to_columns
+    from automerge_tpu.sync.frames import round_from_parts
+
     e = EngineDocSet(backend="rows")
     rset = e._resident
     if rset._native is None:
@@ -166,8 +181,10 @@ def test_partial_admission_restores_only_unadmitted_docs():
     real = rset.apply_round_frames
 
     def partial(frames, interpret=None):
-        rset.change_log[rset.doc_index["a"]].extend(chs_a)  # A admitted
-        raise DeviceDispatchError("failed after admitting a, before b")
+        # really admit doc a (log + clocks + mirror), then fail before b
+        real([round_from_parts({"a": [changes_to_columns(chs_a)]})])
+        raise DeviceDispatchError("failed after admitting a, before b",
+                                  admission_complete=False)
 
     rset.apply_round_frames = partial
     with e.batch():
@@ -175,11 +192,42 @@ def test_partial_admission_restores_only_unadmitted_docs():
         e.apply_changes("b", chs_b)
     rset.apply_round_frames = real
 
-    assert "a" not in e._pending          # admitted: must not replay
-    assert "b" in e._pending              # never admitted: must retry
+    # the whole round returns to pending: b's changes were lost mid-round,
+    # a's replay is a safe duplicate-drop
+    assert "a" in e._pending and "b" in e._pending
+    assert len(rset.change_log[rset.doc_index["a"]]) == len(chs_a)
+    assert len(rset.change_log[rset.doc_index["b"]]) == 0
     e.flush()
     assert e._pending == {}
+    assert len(rset.change_log[rset.doc_index["a"]]) == len(chs_a)
+    assert len(rset.change_log[rset.doc_index["b"]]) == len(chs_b)
+    assert np.uint32(e.hashes()["a"]) == oracle_hash(chs_a)
     assert np.uint32(e.hashes()["b"]) == oracle_hash(chs_b)
+
+
+def test_pure_dispatch_failure_retries_nothing():
+    """admission_complete=True: the whole round reached host truth, so the
+    service must NOT re-queue it (the retry would be pure wasted encode
+    work on every tunnel hiccup)."""
+    e = EngineDocSet(backend="rows")
+    rset = e._resident
+    if rset._native is None:
+        pytest.skip("python-encoder fallback has no dispatch stage")
+    real = rset.apply_round_frames
+
+    def dispatch_fail(frames, interpret=None):
+        real(frames)   # full admission + mirror succeed
+        raise DeviceDispatchError("tunnel dropped at dispatch",
+                                  admission_complete=True)
+
+    rset.apply_round_frames = dispatch_fail
+    chs = make_doc(4)
+    e.apply_changes("d4", chs)
+    rset.apply_round_frames = real
+
+    assert e._pending == {}
+    assert len(rset.change_log[rset.doc_index["d4"]]) == len(chs)
+    assert np.uint32(e.hashes()["d4"]) == oracle_hash(chs)
 
 
 def test_poisoned_when_rebuild_is_impossible():
