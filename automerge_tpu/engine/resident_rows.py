@@ -59,6 +59,29 @@ class DeviceDispatchError(RuntimeError):
         self.admission_complete = admission_complete
 
 
+class RowsBudgetError(RuntimeError):
+    """The batch would grow the resident rows state past the megakernel's
+    VMEM budget. Recoverable: the instance is untouched — compact the
+    long-lived docs (ResidentRowsDocSet.compact, engine/compaction.py) to
+    reclaim dominated/tombstoned slots and retry, or shard the DocSet. The
+    sync service does the compact-and-retry automatically."""
+
+
+class CompactionAnchorError(RuntimeError):
+    """An ingress inserts after an element that compaction reclaimed. The
+    clock floor guarantees every known peer saw that element's tombstone, so
+    a conforming frontend can never emit this anchor (it only anchors at
+    elements visible in its own state); the sender is either below the
+    compaction horizon (needs a full resync) or nonconforming. Raised
+    BEFORE admission — the node is untouched. The rejection is
+    deterministic: the sync service drops the offending doc's round
+    (`doc_id` below) instead of re-queueing it."""
+
+    def __init__(self, msg: str, *, doc_id: str | None = None):
+        super().__init__(msg)
+        self.doc_id = doc_id
+
+
 class ResidentRowsDocSet(ResidentDocSet):
     """Resident DocSet whose device state IS the megakernel row buffer."""
 
@@ -77,6 +100,27 @@ class ResidentRowsDocSet(ResidentDocSet):
             {} for _ in self.doc_ids]
         # per-doc: list_row -> owning-object content hash
         self.list_hash: list[dict[int, int]] = [{} for _ in self.doc_ids]
+        # per-doc: list_row -> object interning index (compaction uses it
+        # to address the encoder's per-object element-slot maps)
+        self.list_obj: list[dict[int, int]] = [{} for _ in self.doc_ids]
+        # NOTE on ins_log semantics: each entry is (slot, elem_counter,
+        # actor_rank, parent); `parent` is the ENTRY INDEX of the anchor
+        # within the same list's entry list (not its slot). Before any
+        # compaction the two coincide (slots assign densely in arrival
+        # order); after compaction, ghost entries (slot == -1) keep their
+        # RGA ordering key in this host tree while freeing their device
+        # band slot, so entry indices are the only stable parent reference.
+        # ins_idx maps slot -> entry index per list for appends.
+        self.ins_idx: list[dict[int, dict[int, int]]] = [
+            {} for _ in self.doc_ids]
+        # eids whose element was compacted away (ghost or fully dropped):
+        # a conforming peer can never anchor an insert at one (the clock
+        # floor guarantees every peer saw the tombstone), so an ingress
+        # that does is rejected pre-admission (CompactionAnchorError).
+        self.ghost_eids: list[set] = [set() for _ in self.doc_ids]
+        # last compaction floor per doc_id (rebuild-from-log re-compacts
+        # with these so a rebuilt long-lived doc fits the budget again)
+        self.compaction_floors: dict[str, dict[str, int]] = {}
         # per-doc admitted change log (for materialization/debugging)
         self.change_log: list[list] = [[] for _ in self.doc_ids]
         if actors:
@@ -147,6 +191,9 @@ class ResidentRowsDocSet(ResidentDocSet):
             self.tables.append(DocTables())
             self.ins_log.append({})
             self.list_hash.append({})
+            self.list_obj.append({})
+            self.ins_idx.append({})
+            self.ghost_eids.append(set())
             self.change_log.append([])
         n = len(self.doc_ids)
         if n > self.cap_docs:
@@ -409,6 +456,13 @@ class ResidentRowsDocSet(ResidentDocSet):
             for op in c.ops:
                 if op.action == "ins":
                     n_elems[i] = n_elems.get(i, 0) + 1
+                    if op.key in self.ghost_eids[i]:
+                        raise CompactionAnchorError(
+                            f"insert anchored at compacted element "
+                            f"{op.key!r} in doc {self.doc_ids[i]!r}; the "
+                            f"sender is below the compaction horizon — "
+                            f"full resync required",
+                            doc_id=self.doc_ids[i])
                 elif op.action in ("makeList", "makeText"):
                     n_lists[i] = n_lists.get(i, 0) + 1
 
@@ -459,16 +513,21 @@ class ResidentRowsDocSet(ResidentDocSet):
         cap_ops = self.cap_ops if cap_ops is None else cap_ops
         le = self.cap_lists * self.cap_elems if le is None else le
         if not rows_dims_eligible(cap_ops, self.cap_actors, le):
-            raise RuntimeError(
+            raise RowsBudgetError(
                 f"this batch would grow the resident rows state past the "
                 f"megakernel VMEM budget (ops={cap_ops}, "
-                f"actors={self.cap_actors}, elem slots={le}); shard this "
-                f"DocSet across more rows instances or use the docs-major "
-                f"ResidentDocSet")
+                f"actors={self.cap_actors}, elem slots={le}); compact the "
+                f"long-lived docs (ResidentRowsDocSet.compact) or shard "
+                f"this DocSet across more rows instances")
 
     def _linearized_pos_rows(self, doc_idx: int, lrow: int):
         """Fresh RGA positions for one touched list from its ins log:
-        (ip-band row indices, positions), both int64 arrays."""
+        (ip-band row indices, positions), both int64 arrays. Ghost entries
+        (compacted-away tombstones, slot == -1) participate in the
+        linearization — they are the ordering basis for their retained
+        descendants — but ship no row; positions are rank-compressed over
+        the slotted entries so they stay dense in [0, cap_elems) (the
+        XLA visible_ranks path scatters by position)."""
         from ..native.linearize import linearize_host
         entries = self.ins_log[doc_idx][lrow]
         n = len(entries)
@@ -476,9 +535,18 @@ class ResidentRowsDocSet(ResidentDocSet):
         arank = np.fromiter((a for (_, _, a, _) in entries), np.int32, n)
         parent = np.fromiter((p for (_, _, _, p) in entries), np.int32, n)
         slots = np.fromiter((s for (s, _, _, _) in entries), np.int64, n)
-        pos = linearize_host(np.ones(n, dtype=bool), elem, arank, parent)
+        pos = np.asarray(
+            linearize_host(np.ones(n, dtype=bool), elem, arank, parent),
+            np.int64)
+        slotted = slots >= 0
+        if not slotted.all():
+            k = int(slotted.sum())
+            order = np.argsort(pos[slotted], kind="stable")
+            dense = np.empty(k, np.int64)
+            dense[order] = np.arange(k)
+            pos, slots = dense, slots[slotted]
         rows = self._bases()["ip"] + lrow * self.cap_elems + slots
-        return rows, np.asarray(pos, np.int64)
+        return rows, pos
 
     def _round_triplets(self, changes_by_doc) -> np.ndarray:
         """Encode one round into (P, 3) int32 scatter triplets
@@ -514,10 +582,15 @@ class ResidentRowsDocSet(ResidentDocSet):
                     put(b["co"] + int(a) * I + s, i, row[a])
             for (lrow, oi, objhash) in delta.new_lists:
                 self.list_hash[i][lrow] = objhash
+                self.list_obj[i][lrow] = oi
             touched_lists = set()
             for (lrow, slot, elem, arank, parent_slot, fid) in delta.ins:
-                self.ins_log[i].setdefault(lrow, []).append(
-                    (slot, elem, arank, parent_slot))
+                entries = self.ins_log[i].setdefault(lrow, [])
+                s2i = self.ins_idx[i].setdefault(lrow, {})
+                parent = (s2i.get(parent_slot, parent_slot)
+                          if parent_slot >= 0 else -1)
+                s2i[slot] = len(entries)
+                entries.append((slot, elem, arank, parent))
                 le = lrow * E + slot
                 put(b["im"] + le, i, 1)
                 put(b["if"] + le, i, fid)
@@ -634,7 +707,13 @@ class ResidentRowsDocSet(ResidentDocSet):
         fresh._rebuilding = True
         try:
             if round_:
-                fresh.apply_rounds([round_])
+                try:
+                    fresh.apply_rounds([round_])
+                except RowsBudgetError:
+                    # a compacted long-lived doc's full log exceeds the
+                    # budget by design — replay in chunks, re-compacting
+                    # with the stored floors between them
+                    self._replay_chunked(fresh, round_)
         except DeviceDispatchError:
             pass
         except Exception as e:
@@ -643,6 +722,47 @@ class ResidentRowsDocSet(ResidentDocSet):
         fresh._rebuilding = False
         self.__dict__.clear()
         self.__dict__.update(fresh.__dict__)
+
+    def _replay_chunked(self, fresh: "ResidentRowsDocSet", round_: dict,
+                        chunk: int = 256) -> None:
+        """Budget-safe rebuild replay: admit the log in per-doc chunks,
+        compacting to the last-known floors between chunks so the rebuilt
+        row state converges to the same compacted footprint the original
+        instance carried. Anchors referenced by the not-yet-replayed tail
+        are pinned — the log legitimately inserts after elements whose
+        tombstones are below the stored floor (they were ghosted only
+        AFTER those inserts admitted in the original instance)."""
+        from ..core.ids import HEAD
+
+        pos = {d: 0 for d in round_}
+        while True:
+            part = {d: chs[pos[d]:pos[d] + chunk]
+                    for d, chs in round_.items() if pos[d] < len(chs)}
+            if not part:
+                return
+            try:
+                fresh.apply_rounds([part])
+            except RowsBudgetError:
+                # a stored-empty floor ({}) means "nothing reclaimable"
+                # (peer-vetoed) and must be honored as-is — only docs with
+                # NO stored floor fall back to their own replayed clock
+                floors = {d: (self.compaction_floors[d]
+                              if d in self.compaction_floors
+                              else dict(
+                                  fresh.tables[fresh.doc_index[d]].clock))
+                          for d in fresh.doc_ids}
+                pins: dict[str, set] = {}
+                for d, chs in round_.items():
+                    tail = chs[pos[d]:]
+                    p = {op.key for c in tail for op in c.ops
+                         if op.action == "ins" and op.key
+                         and op.key != HEAD}
+                    if p:
+                        pins[d] = p
+                fresh.compact(floors, pins)
+                fresh.apply_rounds([part])
+            for d, chs in part.items():
+                pos[d] += len(chs)
 
     # ------------------------------------------------------------------
     # device path
@@ -730,6 +850,24 @@ class ResidentRowsDocSet(ResidentDocSet):
     # ------------------------------------------------------------------
     # native columnar ingress
 
+    def _check_ghost_anchors_cols(self, i: int, cols, op_lo: int,
+                                  op_hi: int) -> None:
+        """Reject ins ops anchored at compacted-away elements BEFORE
+        admission (see CompactionAnchorError)."""
+        ghosts = self.ghost_eids[i]
+        if not ghosts:
+            return
+        from ..storage import _ACTION_IDX
+        acts = np.asarray(cols.op_action[op_lo:op_hi])
+        for j in np.nonzero(acts == _ACTION_IDX["ins"])[0].tolist():
+            k = int(cols.op_key[op_lo + j])
+            if k >= 0 and cols.keys[k] in ghosts:
+                raise CompactionAnchorError(
+                    f"insert anchored at compacted element "
+                    f"{cols.keys[k]!r} in doc {self.doc_ids[i]!r}; the "
+                    f"sender is below the compaction horizon — full "
+                    f"resync required", doc_id=self.doc_ids[i])
+
     def _precheck_rows_budget_cols(self, rounds) -> None:
         """Upper-bound VMEM-budget check from the submitted columns plus the
         causal queues, BEFORE any admission runs (the cols analog of
@@ -751,6 +889,7 @@ class ResidentRowsDocSet(ResidentDocSet):
             n_elems[i] = n_elems.get(i, 0) + int((acts == ins_idx).sum())
             n_lists[i] = n_lists.get(i, 0) + int(
                 np.isin(acts, list_idxs).sum())
+            self._check_ghost_anchors_cols(i, cols, o0, o1)
 
         for i, t in enumerate(self.tables):
             for p in t.queue:  # native instances queue (cols, j) payloads
@@ -770,12 +909,13 @@ class ResidentRowsDocSet(ResidentDocSet):
         from .pack import rows_dims_eligible
         if not rows_dims_eligible(cap_ops, self.cap_actors,
                                   cap_lists * cap_elems):
-            raise RuntimeError(
+            raise RowsBudgetError(
                 f"this batch could grow the resident rows state past the "
                 f"megakernel VMEM budget (ops<={cap_ops}, "
                 f"actors={self.cap_actors}, elem slots<="
-                f"{cap_lists * cap_elems}); shard this DocSet across more "
-                f"rows instances or use the docs-major ResidentDocSet")
+                f"{cap_lists * cap_elems}); compact the long-lived docs "
+                f"(ResidentRowsDocSet.compact) or shard this DocSet across "
+                f"more rows instances")
 
     def _native_encode_round(self, cols_by_doc):
         """Causal admission (Python, per change) + ONE native batch encode
@@ -871,8 +1011,9 @@ class ResidentRowsDocSet(ResidentDocSet):
         ids, cnts = np.unique(enc["adm_doc"], return_counts=True)
         self.change_count[ids] += cnts
 
-        for (d, lrow, _oi, objhash) in bd.newlist_rows:
+        for (d, lrow, oi, objhash) in bd.newlist_rows:
             self.list_hash[int(d)][int(lrow)] = int(objhash)
+            self.list_obj[int(d)][int(lrow)] = int(oi)
 
         ins = bd.ins_rows
         if len(ins):
@@ -880,8 +1021,12 @@ class ResidentRowsDocSet(ResidentDocSet):
             ir, idd, iv = [], [], []
             for (d, lrow, slot_, elem, arank, parent_slot, fid) in ins:
                 d, lrow, slot_ = int(d), int(lrow), int(slot_)
-                self.ins_log[d].setdefault(lrow, []).append(
-                    (slot_, int(elem), int(arank), int(parent_slot)))
+                entries = self.ins_log[d].setdefault(lrow, [])
+                s2i = self.ins_idx[d].setdefault(lrow, {})
+                parent = (s2i.get(int(parent_slot), int(parent_slot))
+                          if parent_slot >= 0 else -1)
+                s2i[slot_] = len(entries)
+                entries.append((slot_, int(elem), int(arank), parent))
                 le = lrow * E + slot_
                 ir += [b["im"] + le, b["if"] + le, b["io"] + le]
                 idd += [d, d, d]
@@ -982,7 +1127,16 @@ class ResidentRowsDocSet(ResidentDocSet):
     def _precheck_round_frames(self, rounds) -> None:
         """Vectorized VMEM-budget precheck for round frames (the analog of
         _precheck_rows_budget_cols, one numpy pass per round instead of
-        per-change slicing)."""
+        per-change slicing), plus the ghost-anchor reject for compacted
+        docs."""
+        for rc in rounds:
+            if any(self.ghost_eids[self.doc_index[d]] for d in rc.doc_ids):
+                off = np.asarray(rc.change_off, np.int64)
+                op_off = np.asarray(rc.cols.op_off, np.int64)
+                for k, d in enumerate(rc.doc_ids):
+                    self._check_ghost_anchors_cols(
+                        self.doc_index[d], rc.cols,
+                        int(op_off[off[k]]), int(op_off[off[k + 1]]))
         from ..storage import _ACTION_IDX
         ins_idx = _ACTION_IDX["ins"]
         l1, l2 = _ACTION_IDX["makeList"], _ACTION_IDX["makeText"]
@@ -1023,12 +1177,13 @@ class ResidentRowsDocSet(ResidentDocSet):
         from .pack import rows_dims_eligible
         if not rows_dims_eligible(cap_ops, self.cap_actors,
                                   cap_lists * cap_elems):
-            raise RuntimeError(
+            raise RowsBudgetError(
                 f"this batch could grow the resident rows state past the "
                 f"megakernel VMEM budget (ops<={cap_ops}, "
                 f"actors={self.cap_actors}, elem slots<="
-                f"{cap_lists * cap_elems}); shard this DocSet across more "
-                f"rows instances or use the docs-major ResidentDocSet")
+                f"{cap_lists * cap_elems}); compact the long-lived docs "
+                f"(ResidentRowsDocSet.compact) or shard this DocSet across "
+                f"more rows instances")
 
     def _refresh_admission_cache(self) -> None:
         """Rebuild the dense clock/frontier cache rows for stale docs. The
@@ -1489,6 +1644,17 @@ class ResidentRowsDocSet(ResidentDocSet):
                                         interpret)
                 self._hash_handle = h
             return np.asarray(h)[:len(self.doc_ids)]
+
+    def compact(self, floors: dict[str, dict[str, int]],
+                pins: dict[str, set] | None = None) -> dict[str, dict]:
+        """Causally-stable compaction (engine/compaction.py): reclaim
+        dominated op slots and below-floor tombstoned element slots per doc,
+        in place, preserving convergence hashes exactly. `floors` maps
+        doc_id -> the known-peer clock floor for that doc; `pins` maps
+        doc_id -> anchor element ids of known-but-unadmitted changes that
+        must keep their slots. Returns per-doc reclaim stats."""
+        from .compaction import compact as _compact
+        return _compact(self, floors, pins)
 
     def materialize(self, doc_id: str):
         """Snapshot one document by replaying its admitted change log

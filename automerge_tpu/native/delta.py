@@ -42,6 +42,9 @@ def _lib():
             [_PTR] * 6 + [ctypes.c_int32, ctypes.c_char_p, ctypes.c_int64]
         lib.amtpu_denc_sizes.argtypes = [_PTR, ctypes.POINTER(ctypes.c_int64)]
         lib.amtpu_denc_stats.argtypes = [_PTR, ctypes.POINTER(ctypes.c_int64)]
+        lib.amtpu_denc_reset_elem_slots.argtypes = [
+            _PTR, ctypes.c_int32, _PTR, _PTR, ctypes.c_char_p, _PTR,
+            ctypes.c_int32, ctypes.c_int32]
         lib.amtpu_denc_copy.argtypes = [_PTR] + [_PTR] * 17
         lib._denc_ready = True
         return lib
@@ -149,6 +152,23 @@ class NativeDeltaEncoder:
             len(adm_idx), errbuf, len(errbuf))
         if rc != 0:
             raise ValueError(f"native delta encode: {errbuf.value.decode()}")
+
+    def reset_elem_slots(self, doc: int, objs, slots, eids,
+                         max_elems: int) -> None:
+        """Replace doc's element-slot maps with the compacted view
+        (engine/compaction.py): the C++ side resolves insert anchors and
+        assigns the next slot from these maps, so they must mirror the
+        renumbered host tables exactly."""
+        lib = self._cl
+        objs = np.ascontiguousarray(objs, np.int32)
+        slots = np.ascontiguousarray(slots, np.int32)
+        blob = "".join(eids).encode()
+        off = np.zeros(len(eids) + 1, np.int32)
+        if eids:
+            off[1:] = np.cumsum([len(e.encode()) for e in eids])
+        lib.amtpu_denc_reset_elem_slots(
+            self._handle, doc, _ptr(objs), _ptr(slots),
+            ctypes.c_char_p(blob), _ptr(off), len(eids), max_elems)
 
     def finish(self) -> BatchDelta:
         """Collect the round's accumulated rows + table additions."""

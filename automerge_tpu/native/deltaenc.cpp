@@ -510,6 +510,28 @@ void amtpu_denc_sizes(void* h, int64_t* out) {
   out[8] = b;
 }
 
+// Replace one document's element-slot maps with the compacted view
+// (engine/compaction.py): clear every list's eid->slot map, re-add the
+// retained entries with their renumbered slots, reset max_elems. The
+// next-slot rule (slot = elem_slots[obj].size()) and insert-anchor
+// resolution then continue seamlessly from the compacted numbering.
+void amtpu_denc_reset_elem_slots(void* h, int32_t doc,
+                                 const int32_t* obj_idx,
+                                 const int32_t* slots,
+                                 const char* eid_blob,
+                                 const int32_t* eid_off, int32_t n,
+                                 int32_t max_elems) {
+  auto* e = static_cast<Encoder*>(h);
+  if (doc < 0 || doc >= static_cast<int32_t>(e->docs.size())) return;
+  DocState& t = e->docs[doc];
+  for (auto& kv : t.elem_slots) kv.second.clear();
+  for (int32_t k = 0; k < n; k++) {
+    std::string eid(eid_blob + eid_off[k], eid_blob + eid_off[k + 1]);
+    t.elem_slots[obj_idx[k]].emplace(std::move(eid), slots[k]);
+  }
+  t.max_elems = max_elems;
+}
+
 // Per-doc capacity stats into out[n_docs*3]: (n_lists, max_elems, n_fields).
 void amtpu_denc_stats(void* h, int64_t* out) {
   auto* e = static_cast<Encoder*>(h);

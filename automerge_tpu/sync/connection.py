@@ -46,6 +46,11 @@ class Connection:
         self._wire = wire
         self._their_clock: dict[str, dict[str, int]] = {}
         self._our_clock: dict[str, dict[str, int]] = {}
+        # engine-backed DocSets track each peer's advertised clock as the
+        # compaction floor (engine/compaction.py); this object is the
+        # registry key, released again in close()
+        self._floor_sink = (doc_set
+                            if hasattr(doc_set, "note_peer_clock") else None)
 
     # -- lifecycle (connection.js:49-56) ------------------------------------
 
@@ -56,6 +61,8 @@ class Connection:
 
     def close(self) -> None:
         self._doc_set.unregister_handler(self.doc_changed)
+        if self._floor_sink is not None:
+            self._floor_sink.forget_peer(self)
 
     # -- sending (connection.js:58-79) --------------------------------------
 
@@ -116,6 +123,8 @@ class Connection:
         if msg.get("clock") is not None:
             self._their_clock = self._clock_union(self._their_clock, doc_id,
                                                   msg["clock"])
+            if self._floor_sink is not None:
+                self._floor_sink.note_peer_clock(self, doc_id, msg["clock"])
         if msg.get("frame") is not None:
             from .frames import decode_frame
             from ..utils import metrics

@@ -28,7 +28,7 @@ from typing import Callable
 
 from ..core.change import Change
 from ..engine.resident import ResidentDocSet
-from ..engine.resident_rows import DeviceDispatchError
+from ..engine.resident_rows import CompactionAnchorError, DeviceDispatchError
 
 
 class _HandleOpSet:
@@ -121,6 +121,66 @@ class EngineDocSet:
         # apply_changes without deadlocking).
         self._notify_queue: list[tuple[str, list]] = []
         self._notify_lock = threading.RLock()
+        # known-peer clock registry (Connection.note_peer_clock): feeds the
+        # compaction floor — per doc, per actor, the min across every
+        # registered peer's advertised clock. With no registered peers the
+        # floor is the doc's own clock (standalone nodes compact freely).
+        self._peer_clocks: dict[object, dict[str, dict[str, int]]] = {}
+        self._peer_seen: dict[object, float] = {}
+        # a peer whose transport died without close() must not pin the
+        # floor forever: entries silently expire from the floor after this
+        # many seconds without a message (they re-register on next msg)
+        self.peer_floor_ttl: float = 900.0
+
+    # -- peer registry / compaction floor -----------------------------------
+
+    def note_peer_clock(self, peer, doc_id: str,
+                        clock: dict[str, int]) -> None:
+        """Record a peer's advertised clock for a doc (Connection calls
+        this on every received message). Clocks only grow, so keep the
+        per-actor max of what the peer has claimed."""
+        import time
+        with self._lock:
+            self._peer_seen[peer] = time.monotonic()
+            docs = self._peer_clocks.setdefault(peer, {})
+            cur = docs.setdefault(doc_id, {})
+            for a, s in (clock or {}).items():
+                if s > cur.get(a, 0):
+                    cur[a] = int(s)
+
+    def forget_peer(self, peer) -> None:
+        """Drop a peer from the compaction-floor registry (Connection
+        close). The floor then stops being held down by a departed peer."""
+        with self._lock:
+            self._peer_clocks.pop(peer, None)
+            self._peer_seen.pop(peer, None)
+
+    def _compaction_floor_locked(self, doc_id: str) -> dict[str, int]:
+        """Reclaim floor for one doc: the engine's causal-stability floor
+        (every actor's next change provably covers everything below it —
+        engine/compaction.causal_floor), further lowered by each
+        registered peer's advertised clock (a known-stale replica may be
+        forked by a future actor, so nothing it hasn't acknowledged is
+        reclaimed), and vetoed entirely when a peer advertises an actor we
+        have no changes from (that actor's in-flight changes carry clocks
+        we cannot bound)."""
+        import time
+
+        from ..engine.compaction import causal_floor
+
+        rset = self._resident
+        i = rset.doc_index[doc_id]
+        floor = causal_floor(rset, i)
+        own = dict(rset.tables[i].clock)   # StaleView reads materialize
+        horizon = time.monotonic() - self.peer_floor_ttl
+        for key, pc in self._peer_clocks.items():
+            if self._peer_seen.get(key, 0.0) < horizon:
+                continue   # transport died without close(): expired
+            peer = pc.get(doc_id, {})
+            if any(a not in own for a in peer):
+                return {}
+            floor = {a: min(s, peer.get(a, 0)) for a, s in floor.items()}
+        return {a: s for a, s in floor.items() if s > 0}
 
     # -- registry surface (doc_set.js:5-38) ---------------------------------
 
@@ -222,6 +282,14 @@ class EngineDocSet:
         try:
             with self._lock:
                 self.add_doc(doc_id)
+                rset = self._resident
+                i = rset.doc_index[doc_id]
+                if rset.ghost_eids[i]:
+                    # reject a ghost-anchored ingress HERE, before it
+                    # coalesces: only the offending sender's call errors,
+                    # never a round shared with innocent peers
+                    rset._check_ghost_anchors_cols(
+                        i, cols, 0, len(cols.op_action))
                 self._pending.setdefault(doc_id, []).append(cols)
                 if not self._batch_depth:
                     self._flush_locked()
@@ -245,7 +313,7 @@ class EngineDocSet:
         rset = self._resident
         pre = {d: len(rset.change_log[rset.doc_index[d]]) for d in pending}
         try:
-            rset.apply_round_frames([round_from_parts(pending)])
+            self._apply_with_compaction(rset, pending)
         except DeviceDispatchError as e:
             # The admitted part of the flush is durable on the host
             # (change_log, clocks, queue and the row mirror are consistent).
@@ -259,6 +327,16 @@ class EngineDocSet:
             # idempotently and the retry admits exactly the remainder.
             if not getattr(e, "admission_complete", False):
                 self._pending = dict(pending)
+        except CompactionAnchorError as e:
+            # Deterministic pre-admission rejection: the offending doc's
+            # round anchors at a compacted element and can never admit —
+            # drop it (the sender needs a full resync) instead of wedging
+            # every later flush on the same retry; restore the rest.
+            self._pending = {
+                d: cols for d, cols in pending.items()
+                if d != e.doc_id
+                and len(rset.change_log[rset.doc_index[d]]) == pre[d]}
+            raise
         except Exception:
             # Pre-admission failure (budget precheck, malformed frame, …).
             # Restore ONLY the docs whose changes verifiably did not admit
@@ -276,6 +354,53 @@ class EngineDocSet:
         admitted = [d for d in pending
                     if len(rset.change_log[rset.doc_index[d]]) > pre[d]]
         self._admit_notify.extend(admitted)
+
+    def _apply_with_compaction(self, rset, pending: dict) -> None:
+        """Apply one coalesced round; on VMEM-budget pressure, compact
+        every doc to its known-peer clock floor (engine/compaction.py) and
+        retry once. RowsBudgetError is raised BEFORE admission, so the
+        retry re-submits the identical round against the reclaimed state —
+        this is what lets a single long-lived document outlive the
+        pre-compaction budget instead of hitting a hard admission wall."""
+        from ..engine.resident_rows import RowsBudgetError
+        from .frames import round_from_parts
+
+        round_ = round_from_parts(pending)
+        try:
+            rset.apply_round_frames([round_])
+        except RowsBudgetError:
+            floors = {d: self._compaction_floor_locked(d)
+                      for d in rset.doc_ids}
+            stats = rset.compact(floors, self._pending_anchor_pins(pending))
+            if not any(s["ops_after"] < s["ops_before"]
+                       or s["elems_after"] < s["elems_before"]
+                       for s in stats.values()):
+                raise   # nothing reclaimable: the batch genuinely oversized
+            rset.apply_round_frames([round_])
+
+    @staticmethod
+    def _pending_anchor_pins(pending: dict) -> dict[str, set]:
+        """Anchor element ids the coalesced pending round inserts after:
+        compaction must not reclaim these — the round was generated before
+        its sender could have seen any tombstone-covering floor, so the
+        floor argument does not apply to it (it is already in flight)."""
+        import numpy as np
+
+        from ..core.ids import HEAD
+        from ..storage import _ACTION_IDX
+
+        pins: dict[str, set] = {}
+        for d, parts in pending.items():
+            p: set = set()
+            for cols in parts:
+                acts = np.asarray(cols.op_action)
+                for j in np.nonzero(acts == _ACTION_IDX["ins"])[0].tolist():
+                    k = int(cols.op_key[j])
+                    if k >= 0 and cols.keys[k] != HEAD:
+                        p.add(cols.keys[k])
+            if p:
+                pins[d] = p
+        return pins
 
     def flush(self) -> None:
         """Apply any coalesced ingress now (rows backend; no-op otherwise)."""

@@ -160,12 +160,17 @@ class _Peer:
         self._thread.start()
 
     def _read_loop(self) -> None:
-        while not self.closed.is_set():
-            msg = recv_frame(self.sock)
-            if msg is None:
-                break
-            self.connection.receive_msg(msg)
-        self.close()
+        try:
+            while not self.closed.is_set():
+                msg = recv_frame(self.sock)
+                if msg is None:
+                    break
+                self.connection.receive_msg(msg)
+        finally:
+            # always release the Connection (and its compaction-floor
+            # registry entry) — a receive_msg exception must not leave a
+            # dead peer's clock pinning the floor forever
+            self.close()
 
     def close(self) -> None:
         if not self.closed.is_set():
