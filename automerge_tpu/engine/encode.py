@@ -390,6 +390,13 @@ def stack_docs(encodings: list[DocEncoding]) -> dict[str, np.ndarray]:
         "ins_pos": np.stack([pad2(e.ins_pos, max_lists, max_elems, -1) for e in encodings]),
         "list_obj": np.stack([pad1(e.list_obj, max_lists, -1) for e in encodings]),
         "list_obj_hash": np.stack([pad1(e.list_obj_hash, max_lists, -1) for e in encodings]),
+        # rank -> actor CONTENT hash, per doc's own rank basis: the state
+        # hash mixes this (never the rank) so replicas holding different
+        # doc subsets — hence different global actor tables — still hash
+        # identical visible states identically (kernels.state_hash)
+        "actor_hash": np.stack([pad1(np.asarray(
+            [content_hash(a) for a in (e.actors or [])], dtype=np.int32),
+            n_actors, 0) for e in encodings]),
     }
     batch["max_fids"] = max_fids
     return batch

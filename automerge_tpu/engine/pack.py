@@ -24,7 +24,7 @@ import jax.numpy as jnp
 FIELDS = ("op_mask", "action", "fid", "actor", "seq", "change_idx", "value",
           "fid_hash", "value_hash", "clock", "ins_mask", "ins_elem",
           "ins_actor", "ins_parent", "ins_fid", "ins_pos", "list_obj",
-          "list_obj_hash")
+          "list_obj_hash", "actor_hash")
 
 
 def pack_batch(batch: dict) -> tuple[np.ndarray, tuple]:
@@ -86,7 +86,7 @@ def apply_packed(flat, meta: tuple, max_fids: int, host_order: bool = True):
 # (a static iota pattern).
 ROW_FIELDS = ("op_mask", "action", "fid", "actor", "seq", "change_idx",
               "fid_hash", "value_hash", "clock_op", "ins_mask", "ins_fid",
-              "ins_pos", "elem_objhash", "elem_list")
+              "ins_pos", "elem_objhash", "elem_list", "actor_hash")
 
 # VMEM bounds for the blocked megakernel. Neither the change count C nor the
 # field count F appears: clock_op replaces per-change clocks and fid equality
@@ -106,20 +106,23 @@ ROWS_VMEM_BUDGET = 22528   # rows-equivalents: ~11MB of VMEM working set
 def rows_count(i: int, a: int, le: int) -> int:
     """Input-buffer row count of the docs-minor layout (the wire size is
     rows_count * d_pad * 4 bytes)."""
-    return 8 * i + a * i + 5 * le
+    return 8 * i + a * i + 5 * le + a
 
 
 def row_bases(i: int, a: int, le: int) -> dict:
     """Row offsets of each ROW_FIELDS group in the docs-minor buffer — the
     ONE definition of the layout, shared by the kernel builders
-    (pallas_kernels) and the resident rows mirror (resident_rows._bases)."""
+    (pallas_kernels) and the resident rows mirror (resident_rows._bases).
+    The trailing "ah" band is the rank -> actor CONTENT hash table the
+    state hash mixes (kernels.state_hash: rank-basis independence)."""
     co = 8 * i
     return {
         "om": 0, "ac": i, "fid": 2 * i, "act": 3 * i, "seq": 4 * i,
         "chg": 5 * i, "fh": 6 * i, "vh": 7 * i, "co": co,
         "im": co + a * i, "if": co + a * i + le, "ip": co + a * i + 2 * le,
         "io": co + a * i + 3 * le, "il": co + a * i + 4 * le,
-        "rows": co + a * i + 5 * le,
+        "ah": co + a * i + 5 * le,
+        "rows": co + a * i + 5 * le + a,
     }
 
 
@@ -189,6 +192,7 @@ def pack_rows(batch: dict, max_fids: int) -> tuple[np.ndarray, tuple, int]:
         rowify(clock_op_am), rowify(batch["ins_mask"]),
         rowify(batch["ins_fid"], -1), rowify(batch["ins_pos"]),
         rowify(elem_objhash, -1), rowify(elem_list, -1),
+        rowify(batch["actor_hash"]),
     ]
     rows = np.concatenate(parts, axis=0)
     dims = (i, a, l * e, int(A_SET), int(A_DEL))
@@ -234,7 +238,8 @@ _DTYPES = (np.int8, np.int16, np.int32)
 _CAP_FIELDS = frozenset((
     "op_mask", "action", "fid", "actor", "ins_mask", "ins_fid", "ins_pos"))
 _CAP_GROUPS = frozenset(ROW_FIELDS.index(f) for f in _CAP_FIELDS)
-_HASH_GROUPS = frozenset((ROW_FIELDS.index("fid_hash"),
+_HASH_GROUPS = frozenset((ROW_FIELDS.index("actor_hash"),
+                          ROW_FIELDS.index("fid_hash"),
                           ROW_FIELDS.index("value_hash"),
                           ROW_FIELDS.index("elem_objhash")))
 
@@ -274,7 +279,8 @@ def classify_row_groups(rows, dims: tuple, max_fids: int) -> tuple:
     }
     assert set(cap_bound) == _CAP_FIELDS   # checker and classifier agree
     cap_hi = {ROW_FIELDS.index(f): v for f, v in cap_bound.items()}
-    group_rows = (i, i, i, i, i, i, i, i, a * i, le, le, le, le, le)
+    group_rows = (i, i, i, i, i, i, i, i, a * i,
+                  le, le, le, le, le, a)
     widths = []
     off = 0
     for g, r in enumerate(group_rows):
@@ -304,7 +310,8 @@ def pack_rows_compact(batch: dict, max_fids: int):
     # batch-stable policy (classify_row_groups) so the static jit key
     # does not flap between batches of a stream
     i, a, le = dims[0], dims[1], dims[2]
-    group_rows = (i, i, i, i, i, i, i, i, a * i, le, le, le, le, le)
+    group_rows = (i, i, i, i, i, i, i, i, a * i,
+                  le, le, le, le, le, a)
     widths = classify_row_groups(rows, dims, max_fids)
     parts8, parts16, parts32, meta = [], [], [], []
     off = 0
@@ -495,6 +502,7 @@ def shard_batch_by_fields(batch: dict, max_fids: int, target_ops: int = 512):
         out[name] = arr
     clock = np.asarray(batch["clock"])
     out["clock"] = clock[owner]
+    out["actor_hash"] = np.asarray(batch["actor_hash"])[owner]
     for name in ("ins_mask", "ins_elem", "ins_actor", "ins_parent",
                  "ins_fid", "ins_pos", "list_obj", "list_obj_hash"):
         src = np.asarray(batch[name])

@@ -122,6 +122,7 @@ def _make_reconcile_kernel(I, A, LE, a_set, a_del):
         b["fh"], b["vh"])
     r_co, r_imask, r_ifid = b["co"], b["im"], b["if"]
     r_ipos, r_iobj, r_ilist = b["ip"], b["io"], b["il"]
+    r_ah = b["ah"]
 
     def kernel(x_ref, o_ref, *scratch):
         # Mosaic lowers dynamic block addressing only through refs, so every
@@ -235,7 +236,15 @@ def _make_reconcile_kernel(I, A, LE, a_set, a_del):
             key1 = jnp.full_like(fh, -7)
             key2 = fh
 
-        contrib = _mix4_i32(key1, key2, actor, vh)
+        # per-op actor CONTENT hash from the ah band (rank-basis
+        # independence, kernels.state_hash): fori over A of rank selects
+        def ah_fold(a, acc):
+            row = x_ref[pl.ds(r_ah + a, 1), :]
+            return acc + jnp.where(actor == a, row, 0)
+
+        ah_op = jax.lax.fori_loop(0, A, ah_fold,
+                                  jnp.zeros_like(actor))
+        contrib = _mix4_i32(key1, key2, ah_op, vh)
         o_ref[:] = jnp.sum(jnp.where(candidate, contrib, 0), axis=0,
                            keepdims=True)
 
@@ -259,6 +268,7 @@ def _make_reconcile_kernel_xl(I, A, LE, a_set, a_del, BI=32, BJ=32, BE=8):
         b["fh"], b["vh"])
     r_co, r_imask, r_ifid = b["co"], b["im"], b["if"]
     r_ipos, r_iobj, r_ilist = b["ip"], b["io"], b["il"]
+    r_ah = b["ah"]
 
     def kernel(x_ref, o_ref, dom_ref, *scratch):
         d = x_ref.shape[1]
@@ -412,7 +422,15 @@ def _make_reconcile_kernel_xl(I, A, LE, a_set, a_del, BI=32, BJ=32, BE=8):
             else:
                 key1 = jnp.full_like(fh_b, -7)
                 key2 = fh_b
-            contrib = _mix4_i32(key1, key2, act_b, vh_b)
+
+            # actor CONTENT hash lookup (rank-basis independence)
+            def ah_fold(a, ah_acc):
+                row = x_ref[pl.ds(r_ah + a, 1), :]
+                return ah_acc + jnp.where(act_b == a, row, 0)
+
+            ah_b = jax.lax.fori_loop(0, A, ah_fold,
+                                     jnp.zeros_like(act_b))
+            contrib = _mix4_i32(key1, key2, ah_b, vh_b)
             return acc + jnp.sum(jnp.where(cnd, contrib, 0), axis=0,
                                  keepdims=True)
 

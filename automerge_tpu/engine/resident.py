@@ -743,7 +743,28 @@ class ResidentDocSet:
         flat, meta = self._build_delta_arrays(changes_by_doc)
         return self._apply_flat(flat, meta, diffs)
 
+    def _ensure_actor_hash_state(self):
+        """Keep state["actor_hash"] current: [cap_docs, cap_actors] actor
+        CONTENT hashes in the current rank basis (kernels.state_hash mixes
+        these, never ranks, so hashes are independent of the instance's
+        global actor set). Rebuilt only when the actor table or the
+        capacities it is shaped by change; between rebuilds the array
+        rides the state pytree through the donating apply jits (the
+        returned copy is the live one — a side cache would hand back a
+        donated/deleted buffer)."""
+        key = (len(self.actors), self.cap_actors, self.cap_docs)
+        if self.state.get("actor_hash") is not None \
+                and getattr(self, "_actor_hash_key", None) == key:
+            return
+        vals = np.zeros(self.cap_actors, np.int32)
+        for r, a in enumerate(self.actors):
+            vals[r] = content_hash(a)
+        self.state["actor_hash"] = jnp.asarray(np.broadcast_to(
+            vals, (self.cap_docs, self.cap_actors)))
+        self._actor_hash_key = key
+
     def _apply_flat(self, flat, meta, diffs: bool):
+        self._ensure_actor_hash_state()
         if not diffs:
             self.state, out = _scatter_and_apply(self.state, flat, meta,
                                                  max_fids=self.cap_fids)
@@ -821,6 +842,7 @@ class ResidentDocSet:
     def reconcile(self):
         """Run the reconcile kernel over resident state; returns per-doc
         uint32 hashes (numpy, aligned with doc_ids)."""
+        self._ensure_actor_hash_state()
         self._out = apply_doc(self.state, self.cap_fids)
         return np.asarray(self._out["hash"])[:len(self.doc_ids)]
 

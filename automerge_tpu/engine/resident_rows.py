@@ -28,7 +28,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .encode import _pad_to
+from .encode import _pad_to, content_hash
 from .resident import ResidentDocSet
 from .pallas_kernels import reconcile_rows_hash
 from ..utils import metrics
@@ -65,6 +65,16 @@ class RowsBudgetError(RuntimeError):
     long-lived docs (ResidentRowsDocSet.compact, engine/compaction.py) to
     reclaim dominated/tombstoned slots and retry, or shard the DocSet. The
     sync service does the compact-and-retry automatically."""
+
+
+def _budget_error(cap_ops: int, actors: int,
+                  elem_slots: int) -> RowsBudgetError:
+    return RowsBudgetError(
+        f"this batch could grow the resident rows state past the "
+        f"megakernel VMEM budget (ops<={cap_ops}, actors={actors}, "
+        f"elem slots<={elem_slots}); compact the long-lived docs "
+        f"(ResidentRowsDocSet.compact) or shard this DocSet across "
+        f"more rows instances")
 
 
 class CompactionAnchorError(RuntimeError):
@@ -121,6 +131,18 @@ class ResidentRowsDocSet(ResidentDocSet):
         # last compaction floor per doc_id (rebuild-from-log re-compacts
         # with these so a rebuilt long-lived doc fits the budget again)
         self.compaction_floors: dict[str, dict[str, int]] = {}
+        # Pin every upload/dispatch of this instance to one jax device
+        # (None = backend default). A ShardedEngineDocSet assigns its
+        # shards round-robin over jax.devices() so K shards drive K chips
+        # from one process (sync/sharded_service.py).
+        self.device = None
+        # True = apply_round_frames skips the device dispatch: the host
+        # mirror is the complete post-round truth, so upload + reconcile
+        # defer to the next hash read. The right posture on backends with
+        # no link to amortize (CPU): per-flush reconcile would do O(state)
+        # work per round where admission is O(changes). On TPU the async
+        # pipelined dispatch is strictly better — leave False there.
+        self.lazy_dispatch = False
         # per-doc admitted change log (for materialization/debugging)
         self.change_log: list[list] = [[] for _ in self.doc_ids]
         if actors:
@@ -170,6 +192,19 @@ class ResidentRowsDocSet(ResidentDocSet):
         self.rows_host[b["il"]:b["il"] + le] = np.repeat(
             np.arange(self.cap_lists, dtype=np.int32),
             self.cap_elems)[:, None]
+        self._refill_actor_hash_band()
+
+    def _refill_actor_hash_band(self) -> None:
+        """Rewrite the ah band (rank -> actor CONTENT hash, broadcast per
+        doc column) from the current actor table. Called after alloc, any
+        re-layout, and every registration/remap — the state hash mixes
+        these values, never ranks, so per-doc hashes stay independent of
+        the instance's global actor set (kernels.state_hash)."""
+        b = self._bases()
+        vals = np.zeros(self.cap_actors, np.int32)
+        for r, a in enumerate(self.actors):
+            vals[r] = content_hash(a)
+        self.rows_host[b["ah"]:b["ah"] + self.cap_actors] = vals[:, None]
 
     # the docs-major device state of the base class is never built
     def _alloc(self):
@@ -220,6 +255,7 @@ class ResidentRowsDocSet(ResidentDocSet):
                 self.cap_elems)[:, None]
             self.rows_host = grown
             self.n_pad = new_pad
+            self._refill_actor_hash_band()
             self.rows_dev = None
             self._dirty = True
         # admission cache: fresh lanes are valid empty docs (zero clock,
@@ -260,7 +296,9 @@ class ResidentRowsDocSet(ResidentDocSet):
             src = old[old_b[g]:old_b[g] + L0 * E0].reshape(L0, E0, -1)
             new[b[g]:b[g] + self.cap_lists * self.cap_elems] \
                 .reshape(self.cap_lists, self.cap_elems, -1)[:L0, :E0] = src
-        # il is static (re-filled by _alloc_rows for the new strides)
+        # il is static (re-filled by _alloc_rows for the new strides); the
+        # ah band is likewise re-filled from the actor table
+        self._refill_actor_hash_band()
         self._dirty = True
 
     # _register_actors/_register_actors_cols are inherited from the base
@@ -410,6 +448,8 @@ class ResidentRowsDocSet(ResidentDocSet):
         if len(self.actors) > self.cap_actors:
             self._grow(cap_actors=_pad_to(len(self.actors), 2))
         if not old_actors or not getattr(self, "_rows_ready", False):
+            if getattr(self, "_rows_ready", False):
+                self._refill_actor_hash_band()   # first registration
             return
         b = self._bases()
         I, A = self.cap_ops, self.cap_actors
@@ -430,6 +470,7 @@ class ResidentRowsDocSet(ResidentDocSet):
             for lrow, entries in log.items():
                 log[lrow] = [(s, e, int(perm[a]) if a < len(perm) else a, p)
                              for (s, e, a, p) in entries]
+        self._refill_actor_hash_band()
         self._dirty = True
 
     # ------------------------------------------------------------------
@@ -513,12 +554,7 @@ class ResidentRowsDocSet(ResidentDocSet):
         cap_ops = self.cap_ops if cap_ops is None else cap_ops
         le = self.cap_lists * self.cap_elems if le is None else le
         if not rows_dims_eligible(cap_ops, self.cap_actors, le):
-            raise RowsBudgetError(
-                f"this batch would grow the resident rows state past the "
-                f"megakernel VMEM budget (ops={cap_ops}, "
-                f"actors={self.cap_actors}, elem slots={le}); compact the "
-                f"long-lived docs (ResidentRowsDocSet.compact) or shard "
-                f"this DocSet across more rows instances")
+            raise _budget_error(cap_ops, self.cap_actors, le)
 
     def _linearized_pos_rows(self, doc_idx: int, lrow: int):
         """Fresh RGA positions for one touched list from its ins log:
@@ -832,6 +868,12 @@ class ResidentRowsDocSet(ResidentDocSet):
             with self._dispatch_guard():
                 return self._dispatch_rounds(trip_list, pre_rows, interpret)
 
+    def _to_dev(self, arr):
+        """Upload pinned to this instance's device (None = default)."""
+        if self.device is not None:
+            return jax.device_put(arr, self.device)
+        return jnp.asarray(arr)
+
     def _dispatch_rounds(self, trip_list, pre_rows, interpret):
         p = _pad_to(max((len(t) for t in trip_list), default=1), 8)
         oob = self._bases()["rows"]  # out-of-range row => dropped by scatter
@@ -840,10 +882,10 @@ class ResidentRowsDocSet(ResidentDocSet):
             stacked[k, :len(t)] = t
             stacked[k, len(t):, 0] = oob
         if pre_rows is not None:
-            self.rows_dev = jnp.asarray(pre_rows)
+            self.rows_dev = self._to_dev(pre_rows)
             self._dirty = False
         self.rows_dev, hashes = _scan_rounds(
-            self.rows_dev, jnp.asarray(stacked), self.dims(), interpret)
+            self.rows_dev, self._to_dev(stacked), self.dims(), interpret)
         self._hash_handle = hashes[-1]
         return np.asarray(hashes)[:, :len(self.doc_ids)]
 
@@ -909,13 +951,8 @@ class ResidentRowsDocSet(ResidentDocSet):
         from .pack import rows_dims_eligible
         if not rows_dims_eligible(cap_ops, self.cap_actors,
                                   cap_lists * cap_elems):
-            raise RowsBudgetError(
-                f"this batch could grow the resident rows state past the "
-                f"megakernel VMEM budget (ops<={cap_ops}, "
-                f"actors={self.cap_actors}, elem slots<="
-                f"{cap_lists * cap_elems}); compact the long-lived docs "
-                f"(ResidentRowsDocSet.compact) or shard this DocSet across "
-                f"more rows instances")
+            raise _budget_error(cap_ops, self.cap_actors,
+                                cap_lists * cap_elems)
 
     def _native_encode_round(self, cols_by_doc):
         """Causal admission (Python, per change) + ONE native batch encode
@@ -1109,8 +1146,9 @@ class ResidentRowsDocSet(ResidentDocSet):
                         metrics.bump("rows_rounds_fallback", len(rounds))
                     encoded = [self._encode_round_frame(rc) for rc in rounds]
                 self._grow_for_rounds(encoded)
-                pre_rows = self.rows_host.copy() \
-                    if self._dirty or self.rows_dev is None else None
+                need_pre = (not self.lazy_dispatch
+                            and (self._dirty or self.rows_dev is None))
+                pre_rows = self.rows_host.copy() if need_pre else None
                 trip_list = [self._cols_triplets(e) for e in encoded]
                 with self._dispatch_guard():
                     return self._dispatch_final(trip_list, pre_rows,
@@ -1177,13 +1215,8 @@ class ResidentRowsDocSet(ResidentDocSet):
         from .pack import rows_dims_eligible
         if not rows_dims_eligible(cap_ops, self.cap_actors,
                                   cap_lists * cap_elems):
-            raise RowsBudgetError(
-                f"this batch could grow the resident rows state past the "
-                f"megakernel VMEM budget (ops<={cap_ops}, "
-                f"actors={self.cap_actors}, elem slots<="
-                f"{cap_lists * cap_elems}); compact the long-lived docs "
-                f"(ResidentRowsDocSet.compact) or shard this DocSet across "
-                f"more rows instances")
+            raise _budget_error(cap_ops, self.cap_actors,
+                                cap_lists * cap_elems)
 
     def _refresh_admission_cache(self) -> None:
         """Rebuild the dense clock/frontier cache rows for stale docs. The
@@ -1598,7 +1631,15 @@ class ResidentRowsDocSet(ResidentDocSet):
         triplets are merged in order with last-wins dedup (rounds only
         overwrite each other on re-linearized position rows), so the scan
         over rounds collapses into a single gather-free scatter. Returns
-        the device hash array without reading it back."""
+        the device hash array without reading it back (None under
+        lazy_dispatch — the next hashes() read reconciles)."""
+        if self.lazy_dispatch:
+            # _cols_triplets already committed the round to the host
+            # mirror; defer upload + reconcile to the next hash read
+            self.rows_dev = None
+            self._dirty = True
+            self._hash_handle = None
+            return None
         parts = [t for t in trip_list if len(t)]
         if parts:
             trips = np.concatenate(parts)
@@ -1615,10 +1656,10 @@ class ResidentRowsDocSet(ResidentDocSet):
         padded[:len(trips)] = trips
         padded[len(trips):, 0] = oob
         if pre_rows is not None:
-            self.rows_dev = jnp.asarray(pre_rows)
+            self.rows_dev = self._to_dev(pre_rows)
             self._dirty = False
         self.rows_dev, h = _apply_final(
-            self.rows_dev, jnp.asarray(padded), self.dims(), interpret)
+            self.rows_dev, self._to_dev(padded), self.dims(), interpret)
         self._hash_handle = h  # polling hashes() between deltas is free
         return h
 
@@ -1635,7 +1676,7 @@ class ResidentRowsDocSet(ResidentDocSet):
         # the buffer, mark dirty, and let the next call re-upload + retry.
         with self._dispatch_guard():
             if self.rows_dev is None or self._dirty:
-                self.rows_dev = jnp.asarray(self.rows_host)
+                self.rows_dev = self._to_dev(self.rows_host)
                 self._dirty = False
                 self._hash_handle = None
             h = getattr(self, "_hash_handle", None)

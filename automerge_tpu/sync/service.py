@@ -65,7 +65,8 @@ class DocHandle:
 
 class EngineDocSet:
     def __init__(self, doc_ids: list[str] | None = None,
-                 live_views: bool = False, backend: str = "resident"):
+                 live_views: bool = False, backend: str = "resident",
+                 device=None):
         """live_views=True turns the node into a view server: every ingress
         runs the fused apply+reconcile with device-side diff emission
         (engine/diffs.py), per-doc MirrorDoc views are maintained
@@ -94,8 +95,14 @@ class EngineDocSet:
         if backend == "rows":
             from ..engine.resident_rows import ResidentRowsDocSet
             self._resident = ResidentRowsDocSet(list(doc_ids or []))
+            if device is not None:
+                # pin every upload/dispatch of this node to one jax device
+                # (ShardedEngineDocSet assigns shards round-robin)
+                self._resident.device = device
         else:
             self._resident = ResidentDocSet(list(doc_ids or []))
+            if device is not None:
+                raise ValueError("device pinning requires backend='rows'")
         self._pending: dict[str, list] = {}   # rows backend: coalesced round
         self._batch_depth = 0
         self._admit_notify: list[str] = []    # docs awaiting handler gossip
@@ -127,6 +134,7 @@ class EngineDocSet:
         # floor is the doc's own clock (standalone nodes compact freely).
         self._peer_clocks: dict[object, dict[str, dict[str, int]]] = {}
         self._peer_seen: dict[object, float] = {}
+        self._peer_first: dict[object, float] = {}
         # a peer whose transport died without close() must not pin the
         # floor forever: entries silently expire from the floor after this
         # many seconds without a message (they re-register on next msg)
@@ -141,7 +149,9 @@ class EngineDocSet:
         per-actor max of what the peer has claimed."""
         import time
         with self._lock:
-            self._peer_seen[peer] = time.monotonic()
+            now = time.monotonic()
+            self._peer_seen[peer] = now
+            self._peer_first.setdefault(peer, now)
             docs = self._peer_clocks.setdefault(peer, {})
             cur = docs.setdefault(doc_id, {})
             for a, s in (clock or {}).items():
@@ -154,6 +164,7 @@ class EngineDocSet:
         with self._lock:
             self._peer_clocks.pop(peer, None)
             self._peer_seen.pop(peer, None)
+            self._peer_first.pop(peer, None)
 
     def _compaction_floor_locked(self, doc_id: str) -> dict[str, int]:
         """Reclaim floor for one doc: the engine's causal-stability floor
@@ -173,10 +184,29 @@ class EngineDocSet:
         floor = causal_floor(rset, i)
         own = dict(rset.tables[i].clock)   # StaleView reads materialize
         horizon = time.monotonic() - self.peer_floor_ttl
+        stale = [k for k in self._peer_clocks
+                 if self._peer_seen.get(k, 0.0) < horizon]
+        for k in stale:
+            # transport died without close(): drop the entry so neither
+            # the floor nor memory is pinned by dead connections
+            self._peer_clocks.pop(k, None)
+            self._peer_seen.pop(k, None)
+            self._peer_first.pop(k, None)
+        grace = time.monotonic() - 30.0
         for key, pc in self._peer_clocks.items():
-            if self._peer_seen.get(key, 0.0) < horizon:
-                continue   # transport died without close(): expired
-            peer = pc.get(doc_id, {})
+            peer = pc.get(doc_id)
+            if peer is None:
+                # The peer has never advertised this doc. Steady state:
+                # it does not sync it, so it holds no in-flight changes
+                # for it and should not hold its floor down (a peer
+                # syncing doc X alone must not disable doc Y's reclaim
+                # forever). Handshake race: Connection.open() advertises
+                # the peer's docs one message at a time, so a freshly
+                # registered peer may simply not have REACHED this doc
+                # yet — within the grace window it pins everything.
+                if self._peer_first.get(key, 0.0) > grace:
+                    return {}
+                continue
             if any(a not in own for a in peer):
                 return {}
             floor = {a: min(s, peer.get(a, 0)) for a, s in floor.items()}
@@ -364,6 +394,16 @@ class EngineDocSet:
         pre-compaction budget instead of hitting a hard admission wall."""
         from ..engine.resident_rows import RowsBudgetError
         from .frames import round_from_parts
+
+        if not getattr(self, "_lazy_resolved", False):
+            # CPU-backend services defer the reconcile to hash reads
+            # (admission is O(changes); a per-flush reconcile is O(state));
+            # any backend with a real link (tpu AND gpu) keeps the async
+            # pipelined dispatch. Resolved lazily so constructing a
+            # service never touches the backend before first ingress.
+            import jax
+            rset.lazy_dispatch = jax.default_backend() == "cpu"
+            self._lazy_resolved = True
 
         round_ = round_from_parts(pending)
         try:

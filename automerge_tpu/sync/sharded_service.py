@@ -28,12 +28,19 @@ from .service import EngineDocSet
 
 class ShardedEngineDocSet:
     def __init__(self, n_shards: int = 2, doc_ids: list[str] | None = None,
-                 backend: str = "rows"):
+                 backend: str = "rows", devices=None):
+        """devices: optional list of jax devices; shards bind round-robin
+        so K shards drive K chips from one process (each shard's uploads
+        and dispatches are pinned via the engine's `device` attribute —
+        engine/resident_rows._to_dev). None = backend default device."""
         if n_shards < 1:
             raise ValueError("n_shards must be >= 1")
         self.n_shards = n_shards
-        self.shards = [EngineDocSet(backend=backend)
-                       for _ in range(n_shards)]
+        self.shards = [
+            EngineDocSet(backend=backend,
+                         device=(devices[k % len(devices)]
+                                 if devices else None))
+            for k in range(n_shards)]
         for d in doc_ids or []:
             self.add_doc(d)
 

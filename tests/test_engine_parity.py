@@ -255,8 +255,10 @@ class TestDensePathParity:
             import numpy as np
             return {k: np.asarray(v) for k, v in out.items()}
 
+        monkeypatch.setattr(kernels, "FORCE_DENSE", True)
         monkeypatch.setattr(kernels, "DENSE_BUDGET", 1 << 60)
         dense = run()
+        monkeypatch.setattr(kernels, "FORCE_DENSE", False)
         monkeypatch.setattr(kernels, "DENSE_BUDGET", -1)
         kernels.apply_doc.clear_cache()
         segment = run()

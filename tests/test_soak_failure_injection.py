@@ -64,6 +64,11 @@ def test_soak_random_dispatch_failures_converge(seed):
     rset = e._resident
     if rset._native is None:
         pytest.skip("python-encoder fallback has no dispatch stage")
+    # this soak targets the DISPATCH failure taxonomy (the TPU posture:
+    # eager per-flush dispatch + cached hash handles); pin lazy off so the
+    # CPU service default doesn't bypass the machinery under test
+    rset.lazy_dispatch = False
+    e._lazy_resolved = True
     for did in finals:
         e.add_doc(did)
 
