@@ -247,8 +247,13 @@ class PerOpDiffStream:
         if doc_id != self.doc_id:
             return
         with self._fold_lock:
+            # drain=False: this handler runs inside the docset's admission
+            # gossip; a draining read here would re-enter the handler chain
+            # on this thread and self-deadlock on the (non-reentrant) fold
+            # lock. The docset's outer drain loop delivers anything a
+            # read-triggered flush admits.
             changes = self._docset.missing_changes(
-                self.doc_id, dict(self._opset.clock))
+                self.doc_id, dict(self._opset.clock), drain=False)
             if not changes:
                 return
             self._opset, diffs = self._opset.add_changes(changes)

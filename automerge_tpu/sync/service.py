@@ -555,10 +555,17 @@ class EngineDocSet:
         self._drain_admitted()  # a read-triggered flush may have admitted
         return out
 
-    def missing_changes(self, doc_id: str, clock: dict[str, int]) -> list[Change]:
+    def missing_changes(self, doc_id: str, clock: dict[str, int],
+                        drain: bool = True) -> list[Change]:
         """Per-actor suffixes newer than `clock` (op_set.js:299-306). Log
         entries may be lazy frame refs; they materialize here, only for the
-        changes a lagging peer actually needs."""
+        changes a lagging peer actually needs.
+
+        drain=False skips the read-triggered notification drain: a caller
+        running INSIDE an admission-gossip handler (PerOpDiffStream's fold,
+        which holds a non-reentrant lock) must not re-enter the handler
+        chain from its own read — the outer drain loop delivers whatever
+        this read's flush admitted."""
         try:
             with self._lock:
                 self._maybe_flush_locked()
@@ -577,9 +584,11 @@ class EngineDocSet:
                         out.extend(c if isinstance(c, Change) else c.change()
                                    for c in changes if c.seq > have)
         except BaseException:
-            self._drain_admitted_shielded()
+            if drain:
+                self._drain_admitted_shielded()
             raise
-        self._drain_admitted()
+        if drain:
+            self._drain_admitted()
         return out
 
     # -- engine reads ---------------------------------------------------------

@@ -107,8 +107,9 @@ class ShardedEngineDocSet:
     def clock_of(self, doc_id: str):
         return self.shard_of(doc_id).clock_of(doc_id)
 
-    def missing_changes(self, doc_id: str, clock):
-        return self.shard_of(doc_id).missing_changes(doc_id, clock)
+    def missing_changes(self, doc_id: str, clock, drain: bool = True):
+        return self.shard_of(doc_id).missing_changes(doc_id, clock,
+                                                     drain=drain)
 
     def hashes(self) -> dict[str, int]:
         out: dict[str, int] = {}

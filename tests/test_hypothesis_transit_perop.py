@@ -1,0 +1,113 @@
+"""Hypothesis fuzz for two previously-dark corners (VERDICT r4 #8):
+
+1. Transit save/load round-trips under RANDOM conflict states. The
+   reference pins that conflicts survive its transit save format
+   (test/test.js:1107-1116, one hand-built case); here random multi-replica
+   programs produce arbitrary nested/concurrent states and the law is that
+   a transit round trip preserves document equality, the conflict table,
+   and the engine state hash.
+
+2. PerOpDiffStream under CONCURRENT admission gossip. The stream's fold
+   lock (engine/diffs.py) serializes pull-apply-emit across transport
+   threads; the law is that when several threads ingest interleaved
+   changes into one rows-backend node, the stream's shadow opset ends at
+   the node's exact state, every admitted change is folded exactly once,
+   and the emitted record batches never interleave mid-fold.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+try:
+    from hypothesis import HealthCheck, given, settings
+    from hypothesis import strategies as st
+except ImportError:  # pragma: no cover
+    pytest.skip("hypothesis unavailable", allow_module_level=True)
+
+import automerge_tpu as am
+from automerge_tpu.engine.batchdoc import apply_batch, oracle_state
+
+from tests.test_hypothesis_conformance import _instr, _run_program
+
+
+def _hash_of(doc):
+    changes = doc._doc.opset.get_missing_changes({})
+    _, _, out = apply_batch([changes])
+    return int(np.asarray(out["hash"])[0])
+
+
+@settings(max_examples=25, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(st.lists(_instr, min_size=1, max_size=18))
+def test_transit_roundtrip_preserves_random_conflict_states(instrs):
+    merged = _run_program(instrs)
+    blob = am.save_transit(merged)
+    loaded = am.load_transit(blob)
+
+    assert am.equals(merged, loaded)
+    assert oracle_state(loaded) == oracle_state(merged)   # incl. conflicts
+    assert dict(loaded._doc.opset.clock) == dict(merged._doc.opset.clock)
+    assert _hash_of(loaded) == _hash_of(merged)
+    # a second round trip is a fixpoint
+    assert am.save_transit(loaded) == blob
+
+
+@settings(max_examples=8, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(st.integers(min_value=2, max_value=4),
+       st.integers(min_value=2, max_value=6),
+       st.randoms(use_true_random=False))
+def test_perop_stream_under_concurrent_admission_gossip(n_threads,
+                                                        n_changes, rnd):
+    from automerge_tpu.engine.diffs import PerOpDiffStream
+    from automerge_tpu.sync.service import EngineDocSet
+
+    node = EngineDocSet(backend="rows")
+    node.add_doc("doc")
+
+    batches: list[list] = []
+    in_fold = threading.Event()
+    overlapped = []
+
+    def on_records(recs):
+        # the fold lock must serialize callbacks: two emissions may never
+        # be in flight at once
+        if in_fold.is_set():
+            overlapped.append(True)
+        in_fold.set()
+        batches.append(list(recs))
+        in_fold.clear()
+
+    stream = PerOpDiffStream(node, "doc", on_records)
+
+    # per-thread actor keeps seqs dense per actor regardless of scheduling
+    def writer(t):
+        d = am.init(f"W{t}")
+        for k in range(n_changes):
+            d = am.change(d, lambda x, t=t, k=k: x.__setitem__(
+                f"f{t}", k * 10 + t))
+            chs = d._doc.opset.get_missing_changes({})
+            node.apply_changes("doc", [chs[-1]])
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    order = list(range(n_threads))
+    rnd.shuffle(order)
+    for t in order:
+        threads[t].start()
+    for t in threads:
+        t.join()
+    node.flush()
+
+    assert not overlapped, "diff emissions interleaved mid-fold"
+    # the shadow opset converged to the node's exact clock and state
+    assert dict(stream.opset.clock) == node.clock_of("doc")
+    view = node.materialize("doc")["data"]
+    for t in range(n_threads):
+        assert view[f"f{t}"] == (n_changes - 1) * 10 + t
+    # exactly-once: the stream folded every admitted change once
+    folded = sum(c for c in stream.opset.clock.values())
+    assert folded == n_threads * n_changes
+    stream.close()
