@@ -71,24 +71,20 @@ def try_bulk_build(cols) -> OpSet | None:
     # The build allocates hundreds of thousands of long-lived records; the
     # cyclic GC's generational scans over that growing heap cost ~35% of the
     # build at 64K changes. Nothing here creates cycles — pause it.
-    import gc
-    was_enabled = gc.isenabled()
-    if was_enabled:
-        gc.disable()
-    try:
-        return build_opset(cols)
-    except BulkUnsupported:
-        return None
-    except KeyError:
-        # structural reference the fast path didn't expect (e.g. op on an
-        # object created by a queued change): interpretive path handles it.
-        # Counted so an unexpected fallback (a fast-path bug demoted to a
-        # perf regression) is observable rather than silent.
-        metrics.bump("bulkload_fallback_keyerror")
-        return None
-    finally:
-        if was_enabled:
-            gc.enable()
+    from ..utils.gcpause import gc_paused
+    with gc_paused():
+        try:
+            return build_opset(cols)
+        except BulkUnsupported:
+            return None
+        except KeyError:
+            # structural reference the fast path didn't expect (e.g. op on
+            # an object created by a queued change): interpretive path
+            # handles it. Counted so an unexpected fallback (a fast-path
+            # bug demoted to a perf regression) is observable rather than
+            # silent.
+            metrics.bump("bulkload_fallback_keyerror")
+            return None
 
 
 _CANON_RE = None

@@ -1124,11 +1124,8 @@ class ResidentRowsDocSet(ResidentDocSet):
         # bursts (admitted refs, delta rows) trigger generational GC scans
         # over the whole service heap — measured at ~2/3 of the ingress cost
         # on a 2K-doc node (same pathology core/bulkload.py documents).
-        import gc
-        was_enabled = gc.isenabled()
-        if was_enabled:
-            gc.disable()
-        try:
+        from ..utils.gcpause import gc_paused
+        with gc_paused():
             for rc in rounds:
                 self._register_round_actors(rc)
             self._precheck_round_frames(rounds)
@@ -1153,9 +1150,6 @@ class ResidentRowsDocSet(ResidentDocSet):
                 with self._dispatch_guard():
                     return self._dispatch_final(trip_list, pre_rows,
                                                 interpret)
-        finally:
-            if was_enabled:
-                gc.enable()
 
     def _register_round_actors(self, rc) -> None:
         cols = rc.cols
