@@ -125,12 +125,26 @@ def plan_for(doc_changes: list, passes: int = 1) -> Plan:
             p *= 2
         return p
 
-    ops_pad = _pad(max((sum(len(c.ops) for c in chs)
-                        for chs in doc_changes), default=1))
-    ins_pad = _pad(max((sum(1 for c in chs for o in c.ops
-                            if o.action == "ins") for chs in doc_changes),
-                       default=1))
-    actors = {c.actor for chs in doc_changes for c in chs}
+    # one fused pass per doc (this runs per ROUTED job — on a millisecond
+    # single-doc apply the router's own scan is a measurable tax)
+    max_ops = 1
+    max_ins = 1
+    actors: set = set()
+    for chs in doc_changes:
+        doc_ops = 0
+        doc_ins = 0
+        for c in chs:
+            doc_ops += len(c.ops)
+            for o in c.ops:
+                if o.action == "ins":
+                    doc_ins += 1
+            actors.add(c.actor)
+        if doc_ops > max_ops:
+            max_ops = doc_ops
+        if doc_ins > max_ins:
+            max_ins = doc_ins
+    ops_pad = _pad(max_ops)
+    ins_pad = _pad(max_ins)
     d_pad = ((len(doc_changes) + 127) // 128) * 128  # pack.py's lane pad
     wire_bytes = (rows_count(ops_pad, max(len(actors), 1), ins_pad)
                   * d_pad * 4)
