@@ -28,6 +28,7 @@ Correspondence with the reference semantics:
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -359,7 +360,13 @@ def apply_doc(batch, max_fids: int, host_order: bool = False):
     # gather path to cheap native scatters and the dense blowup only burns
     # cycles (measured 160x slower on the 256-doc nested-JSON batch on
     # XLA-CPU), so dense is TPU-only.
+    # AMTPU_DISABLE_DENSE is the operational kill switch: the dense path
+    # is the one engine formulation no hardware run has exercised yet
+    # (built during the r4-r5 tunnel outage), so bench retries a failed
+    # TPU config once with it disabled to isolate the fault.
     if (FORCE_DENSE or jax.default_backend() == "tpu") \
+            and os.environ.get("AMTPU_DISABLE_DENSE", "").lower() \
+            not in ("1", "true", "yes") \
             and _dense_cost(batch, max_fids) <= DENSE_BUDGET:
         return apply_doc_dense(batch, max_fids, elem_pos_all)
 

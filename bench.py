@@ -581,6 +581,15 @@ def run_engine(doc_changes, repeat=None):
     from automerge_tpu.engine.pallas_kernels import (HAVE_PALLAS,
                                                      reconcile_rows_hash)
 
+    _eng_t0 = time.perf_counter()
+
+    def emark(msg):
+        # run_config's marks bracket whole phases; these localize a hang
+        # INSIDE the engine phase (encode / compile+warmup / timed region),
+        # which is where the r5 TPU attempt silently died.
+        print(f"#     engine {msg} t+{time.perf_counter()-_eng_t0:.1f}s",
+              file=sys.stderr, flush=True)
+
     t0 = time.perf_counter()
     all_actors = sorted({c.actor for changes in doc_changes for c in changes})
     encodings = [encode_doc(changes, all_actors) for changes in doc_changes]
@@ -669,6 +678,7 @@ def run_engine(doc_changes, repeat=None):
     else:
         wire, dispatch = build_packed_dispatch()
     encode_time = time.perf_counter() - t0
+    emark(f"encode done (rows={use_rows}, wire={wire.nbytes}B)")
 
     # Per-pass payloads are DISTINCT (VERDICT r3 weak #5): pass k>0 gets the
     # value_hash column cyclically permuted, so every pass ships different
@@ -702,9 +712,11 @@ def run_engine(doc_changes, repeat=None):
     # For the rows path the warmup also cross-checks the compact wire's
     # device-side widen against the wide int32 path — bit-identical hashes
     # or we fall back (guards byte-order/bitcast surprises on new backends).
+    emark("warmup start (first compile of the dispatch program)")
     try:
         if use_rows:
             got = np.asarray(dispatch(ship(stacked)))
+            emark("rows warmup dispatch done; wide-path cross-check")
             rows_wide, dims_w, _n = pack_rows(batch, max_fids)
             want = np.asarray(apply_rows_hash(
                 jnp.asarray(rows_wide), dims_w, n_docs))
@@ -745,10 +757,13 @@ def run_engine(doc_changes, repeat=None):
             kernel_info["per_doc_dims"] = {
                 "ops": int(i_), "actors": int(a_), "elems": int(l_ * e_),
                 "fids": int(max_fids), "rows": rows_count(i_, a_, l_ * e_)}
+        emark(f"rows path fell back to packed XLA "
+              f"({kernel_info['rows_kernel_fallback_error'][:80]})")
         wire, dispatch = build_packed_dispatch()
         buffers = [_vary_pass(k) for k in range(repeat)]
         np.asarray(dispatch([jnp.asarray(b) for b in buffers]))
 
+    emark("warmup done; timed region start")
     # Timed: ship every pass's bytes, barrier on the transfers, run ONE
     # dispatch covering every pass, drain all hashes in one readback.
     t0 = time.perf_counter()
@@ -781,6 +796,7 @@ def run_engine(doc_changes, repeat=None):
         "split_barrier": "block_until_ready (approximate on tunnel)",
     }
 
+    emark("timed region done; device-resident region start")
     # Device-resident reconcile throughput: inputs already on device, one
     # dispatch + one readback for all passes (what a resident DocSet service
     # pays per reconcile once uploads are amortized). block_until_ready is
@@ -1358,8 +1374,8 @@ def _compact_record(rec: dict) -> dict:
     if rs is not None:
         out["resident_speedup"] = rs
     if rec.get("attempts"):
-        out["attempts"] = [f"{'cpu' if a.get('force_cpu') else 'dflt'}:"
-                           f"{a.get('rc')}" for a in rec["attempts"]]
+        out["attempts"] = [f"{a.get('attempt')}:{a.get('rc')}"
+                           for a in rec["attempts"]]
     if rec.get("errors"):
         out["errors"] = len(rec["errors"])
     out["detail"] = "BENCH_DETAIL.json"
@@ -1370,6 +1386,13 @@ def worker_main(args):
     """Run the measurements. Streams one `RESULT {json}` line per finished
     config and a `FINAL {json}` line at the end, all flushed immediately so
     the parent keeps partial results if a later config hangs or dies."""
+    # Forensics for tunnel hangs: a periodic Python-stack dump to stderr
+    # shows which call sat inside the C layer when the parent's budget
+    # killed this worker (the r5 TPU attempt died with no evidence of
+    # WHERE config 2 wedged — never again).
+    import faulthandler
+    faulthandler.dump_traceback_later(180, repeat=True, exit=False,
+                                      file=sys.stderr)
     import jax
     if args.force_cpu:
         # The axon TPU plugin overrides the JAX_PLATFORMS env var in this
@@ -1382,6 +1405,19 @@ def worker_main(args):
         jax.config.update("jax_platforms", "cpu")
         backend = jax.default_backend()
     print(f"BACKEND {backend}", flush=True)
+    if args.canary:
+        # Minimal end-to-end device proof: one tiny jit + one readback.
+        # The parent uses this to decide whether the tunnel is worth
+        # per-config TPU attempts at all (a hung canary costs its small
+        # budget; a hung config-5 transfer used to cost the whole run).
+        import jax.numpy as jnp
+        import numpy as _np
+        x = jnp.arange(1024, dtype=jnp.int32)
+        got = int(_np.asarray(jax.jit(lambda v: (v * 3 + 1).sum())(x)))
+        assert got == 3 * (1023 * 1024 // 2) + 1024, got
+        print("CANARY ok", flush=True)
+        print("FINAL done", flush=True)
+        sys.exit(0)
     _load_package()
 
     rc = 0
@@ -1416,30 +1452,53 @@ def worker_main(args):
     sys.exit(rc)
 
 
-def _run_worker(cmd: list[str], budget: float):
+def _run_worker(cmd: list[str], budget: float, label: str = "w",
+                env: dict | None = None):
     """Run one worker attempt with BOTH a wall-clock budget and an early
     hang detector: a worker that has not printed its BACKEND line within
     AMTPU_BENCH_INIT_TIMEOUT seconds is stuck in device-backend init (the
     tunnel hangs rather than raising when its upstream is down — observed
     for hours at a stretch) and is killed immediately so the CPU fallback
-    gets the budget instead. Returns (stdout, stderr, rc)."""
+    gets the budget instead. Returns (stdout, stderr, rc).
+
+    Worker stderr is streamed LIVE to the parent's stderr (prefixed) and
+    appended to BENCH_WORKERS.log next to this file — the r5 TPU attempt
+    produced a config error plus a 16-minute silent hang and the evidence
+    died with the killed pipes; now it persists as it happens."""
     import threading
 
     init_timeout = float(os.environ.get("AMTPU_BENCH_INIT_TIMEOUT", "240"))
+    log_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_WORKERS.log")
+    try:
+        log_f = open(log_path, "a", buffering=1)
+    except OSError:
+        log_f = None
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                            stderr=subprocess.PIPE, text=True)
+                            stderr=subprocess.PIPE, text=True, env=env)
     out_lines: list[str] = []
     err_chunks: list[str] = []
     saw_backend = threading.Event()
+
+    def log_line(tag, line):
+        if log_f is not None:
+            try:
+                log_f.write(f"[{tag}] {line}")
+            except OSError:
+                pass
 
     def read_out():
         for line in proc.stdout:
             out_lines.append(line)
             if line.startswith("BACKEND "):
                 saw_backend.set()
+            log_line(f"{label} out", line)
 
     def read_err():
-        err_chunks.append(proc.stderr.read() or "")
+        for line in proc.stderr:
+            err_chunks.append(line)
+            print(f"[{label}] {line}", end="", file=sys.stderr, flush=True)
+            log_line(label, line)
 
     t_out = threading.Thread(target=read_out, daemon=True)
     t_err = threading.Thread(target=read_err, daemon=True)
@@ -1472,6 +1531,11 @@ def _run_worker(cmd: list[str], budget: float):
             pass
     t_out.join(timeout=10)
     t_err.join(timeout=10)
+    if log_f is not None:
+        try:
+            log_f.close()
+        except OSError:
+            pass
     return "".join(out_lines), "".join(err_chunks), rc
 
 
@@ -1487,41 +1551,31 @@ def parent_main(args, passthrough: list[str]):
     attempts: list[dict] = []
     backend_used = None
 
-    plan = ((1, False), (2, False), (3, True))
-    for attempt, force_cpu in plan:
-        done_cfgs = set(results_by_cfg)
-        want = {args.config} if args.config else set(CONFIGS)
-        if want <= done_cfgs:
-            break
-        remaining = deadline - time.time()
-        if remaining < 20:
-            break
-        # Short on time: spend what's left on the reliable CPU attempt
-        # rather than burning it on a possibly-hanging TPU tunnel.
-        if remaining < 240 and not force_cpu:
-            continue
-        # A backend-init hang recurs (the tunnel stays down for hours when
-        # its upstream dies): don't pay for a second TPU attempt.
-        if not force_cpu and any(a["rc"] == "backend-init-hang"
-                                 for a in attempts):
-            continue
-        attempts_left = len(plan) - attempt + 1
-        budget = (max(20, int(remaining)) if force_cpu
-                  else max(60, int(remaining / attempts_left)))
-        cmd = [sys.executable, os.path.abspath(__file__), "--worker",
-               *passthrough,
-               "--skip", ",".join(str(c) for c in sorted(done_cfgs))]
-        if force_cpu:
-            cmd.append("--force-cpu")
+    want = [args.config] if args.config else list(CONFIGS)
+    docs_args = ["--docs", str(args.docs)] if args.docs else []
+    script = os.path.abspath(__file__)
+    try:  # fresh worker log per run (appended within the run)
+        open(os.path.join(os.path.dirname(script),
+                          "BENCH_WORKERS.log"), "w").close()
+    except OSError:
+        pass
+
+    def attempt_worker(label, cmd, budget, force_cpu, extra_env=None,
+                       config=None):
+        """Spawn one worker, harvest its protocol lines, log the attempt.
+        Returns (rc, saw_final, canary_ok)."""
+        nonlocal backend_used
         t0 = time.time()
         backend = None
-        finished = False
+        finished = canary_ok = False
+        env = None
+        if extra_env:
+            env = dict(os.environ, **extra_env)
         try:
-            out, err, rc = _run_worker(cmd, budget)
+            proc_cmd = list(cmd)
+            out, err, rc = _run_worker(proc_cmd, budget, label, env)
         except Exception as e:  # spawn failure itself
             out, err, rc = "", repr(e), "spawn-error"
-        for line in err.splitlines()[-40:]:
-            print(f"[worker {attempt}] {line}", file=sys.stderr)
         for line in out.splitlines():
             if line.startswith("RESULT "):
                 try:
@@ -1538,13 +1592,85 @@ def parent_main(args, passthrough: list[str]):
             elif line.startswith("BACKEND "):
                 backend = line.split(None, 1)[1].strip()
                 backend_used = backend_used or backend
+            elif line.startswith("CANARY ok"):
+                canary_ok = True
             elif line.startswith("FINAL "):
                 finished = True
-        attempts.append({"attempt": attempt, "force_cpu": force_cpu,
-                         "rc": rc, "backend": backend,
-                         "elapsed_s": round(time.time() - t0, 1)})
-        if finished and rc == 0:
-            break
+        rec = {"attempt": label, "force_cpu": force_cpu, "rc": rc,
+               "backend": backend,
+               "elapsed_s": round(time.time() - t0, 1)}
+        if config is not None:
+            rec["config"] = config
+        if extra_env:
+            rec["env"] = extra_env
+        attempts.append(rec)
+        return rc, finished, canary_ok
+
+    # Phase 1 — TPU canary: prove backend init + one tiny dispatch +
+    # readback before spending real budget on the tunnel. A wedged tunnel
+    # (r4/r5: PJRT_Client_Create retries a dead relay forever) costs only
+    # this small probe.
+    tpu_ok = False
+    if not args.force_cpu:
+        remaining = deadline - time.time()
+        if remaining >= 120:
+            budget = min(300.0, max(90.0, remaining / 6))
+            rc, _fin, canary_ok = attempt_worker(
+                "canary", [sys.executable, script, "--worker", "--canary"],
+                budget, False)
+            # A clean CPU fallback during init also prints CANARY ok —
+            # per-config TPU workers only make sense on the real backend.
+            tpu_ok = canary_ok and attempts[-1].get("backend") == "tpu"
+
+    # Phase 2 — one TPU worker PER CONFIG, each with its own budget slice:
+    # a single config that hangs (remote-compile wedge, killed transfer)
+    # forfeits its slice, not the whole TPU pass (r5: config 2 silently ate
+    # 16 minutes and every config after it). Budget weights reflect the
+    # heavier transfer/compile load of the big-batch configs.
+    cpu_reserve = 700.0 if len(want) > 1 else 150.0
+    weights = {1: 1.0, 2: 1.4, 3: 1.0, 4: 1.0, 5: 3.0, 6: 1.4, 7: 1.4,
+               8: 3.0}
+    if tpu_ok:
+        for cfg in want:
+            if cfg in results_by_cfg:
+                continue
+            # Init-hangs recur for hours once the tunnel dies: stop
+            # feeding it configs after the first one.
+            if any(a["rc"] == "backend-init-hang" for a in attempts):
+                break
+            todo = [c for c in want if c not in results_by_cfg]
+            remaining = deadline - time.time() - cpu_reserve
+            if remaining < 90:
+                break
+            budget = max(90.0, remaining * weights.get(cfg, 1.0)
+                         / sum(weights.get(c, 1.0) for c in todo))
+            cmd = [sys.executable, script, "--worker", *docs_args,
+                   "--config", str(cfg)]
+            rc, _fin, _c = attempt_worker(f"tpu-c{cfg}", cmd, budget, False,
+                                          config=cfg)
+            if cfg not in results_by_cfg and rc != "backend-init-hang":
+                # The config errored (worker exited rc!=0 with an ERROR
+                # line) or hung until its budget ("timeout"). Retry once
+                # with the TPU-only dense kernel disabled — the one engine
+                # path no hardware run before r5 ever exercised, and a
+                # candidate for both failure shapes.
+                remaining = deadline - time.time() - cpu_reserve
+                if remaining > 90:
+                    attempt_worker(f"tpu-c{cfg}-nodense", cmd,
+                                   max(90.0, min(budget, remaining)), False,
+                                   extra_env={"AMTPU_DISABLE_DENSE": "1"},
+                                   config=cfg)
+
+    # Phase 3 — CPU sweep of whatever is missing.
+    missing = [c for c in want if c not in results_by_cfg]
+    remaining = deadline - time.time()
+    if missing and remaining >= 20:
+        cmd = [sys.executable, script, "--worker", *docs_args,
+               "--skip", ",".join(str(c) for c in sorted(results_by_cfg)),
+               "--force-cpu"]
+        if args.config:
+            cmd += ["--config", str(args.config)]
+        attempt_worker("cpu", cmd, max(20.0, remaining), True)
 
     rec = _final_record(results_by_cfg, backend_used, attempts)
     # Only report errors for configs that never produced a result (a retry
@@ -1576,6 +1702,8 @@ def main():
     ap.add_argument("--all", action="store_true",
                     help="(default behavior; kept for compatibility)")
     ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--canary", action="store_true",
+                    help="(worker) init backend, run one tiny jit, exit")
     ap.add_argument("--force-cpu", action="store_true")
     ap.add_argument("--skip", type=lambda s: {int(x) for x in s.split(",") if x},
                     default=set())
