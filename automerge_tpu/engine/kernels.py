@@ -335,6 +335,14 @@ DENSE_BUDGET = 32 * 1024 * 1024
 # below would otherwise make CPU-side dense-vs-segment parity tests
 # silently compare the segment kernel against itself).
 FORCE_DENSE = False
+# Operational kill switch for the dense path, read ONCE at import (the
+# gate below runs inside a jit trace, so a later env flip would only
+# affect not-yet-traced shapes — process-start-only is the honest
+# contract). bench.py's TPU workers disable dense by default and use a
+# dense-enabled retry to isolate faults, until the path is proven on
+# hardware.
+DISABLE_DENSE = os.environ.get("AMTPU_DISABLE_DENSE", "").lower() \
+    in ("1", "true", "yes")
 
 
 @partial(jax.jit, static_argnames=("max_fids", "host_order"))
@@ -360,13 +368,8 @@ def apply_doc(batch, max_fids: int, host_order: bool = False):
     # gather path to cheap native scatters and the dense blowup only burns
     # cycles (measured 160x slower on the 256-doc nested-JSON batch on
     # XLA-CPU), so dense is TPU-only.
-    # AMTPU_DISABLE_DENSE is the operational kill switch: the dense path
-    # is the one engine formulation no hardware run has exercised yet
-    # (built during the r4-r5 tunnel outage), so bench retries a failed
-    # TPU config once with it disabled to isolate the fault.
     if (FORCE_DENSE or jax.default_backend() == "tpu") \
-            and os.environ.get("AMTPU_DISABLE_DENSE", "").lower() \
-            not in ("1", "true", "yes") \
+            and not DISABLE_DENSE \
             and _dense_cost(batch, max_fids) <= DENSE_BUDGET:
         return apply_doc_dense(batch, max_fids, elem_pos_all)
 

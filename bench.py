@@ -1378,6 +1378,8 @@ def _compact_record(rec: dict) -> dict:
                            for a in rec["attempts"]]
     if rec.get("errors"):
         out["errors"] = len(rec["errors"])
+    if any(v.get("dense_disabled") for v in rec.get("configs", {}).values()):
+        out["dense_disabled"] = True
     out["detail"] = "BENCH_DETAIL.json"
     return out
 
@@ -1428,6 +1430,10 @@ def worker_main(args):
         try:
             r = run_config(cfg, n_docs=args.docs)
             r["backend"] = backend
+            from automerge_tpu.engine import kernels as _k
+            if _k.DISABLE_DENSE:
+                # the record must say which engine formulation it measured
+                r["dense_disabled"] = True
         except Exception as e:
             rc = 1
             print(f"ERROR {json.dumps({'config': cfg, 'error': repr(e)[:400]})}",
@@ -1646,19 +1652,28 @@ def parent_main(args, passthrough: list[str]):
                          / sum(weights.get(c, 1.0) for c in todo))
             cmd = [sys.executable, script, "--worker", *docs_args,
                    "--config", str(cfg)]
-            rc, _fin, _c = attempt_worker(f"tpu-c{cfg}", cmd, budget, False,
-                                          config=cfg)
+            # Default workers run with the dense one-hot kernel DISABLED:
+            # it is the one engine formulation no hardware run has ever
+            # exercised, and the r5 failure pattern (config 1 errored,
+            # config 2 and every new client after it wedged) is consistent
+            # with its compile poisoning the remote session. The record
+            # must not gamble; dense gets hand-validated on hardware and
+            # re-enabled here once proven.
+            rc, _fin, _c = attempt_worker(
+                f"tpu-c{cfg}", cmd, budget, False,
+                extra_env={"AMTPU_DISABLE_DENSE": "1"}, config=cfg)
             if cfg not in results_by_cfg and rc != "backend-init-hang":
-                # The config errored (worker exited rc!=0 with an ERROR
-                # line) or hung until its budget ("timeout"). Retry once
-                # with the TPU-only dense kernel disabled — the one engine
-                # path no hardware run before r5 ever exercised, and a
-                # candidate for both failure shapes.
+                # Failed even without dense: retry once with the full
+                # default path (dense enabled) to isolate which
+                # formulation is at fault.
                 remaining = deadline - time.time() - cpu_reserve
                 if remaining > 90:
-                    attempt_worker(f"tpu-c{cfg}-nodense", cmd,
+                    # Explicit "0" (not inherit): an operator-level
+                    # AMTPU_DISABLE_DENSE=1 in the parent env must not
+                    # silently turn this into a second no-dense run.
+                    attempt_worker(f"tpu-c{cfg}-dense", cmd,
                                    max(90.0, min(budget, remaining)), False,
-                                   extra_env={"AMTPU_DISABLE_DENSE": "1"},
+                                   extra_env={"AMTPU_DISABLE_DENSE": "0"},
                                    config=cfg)
 
     # Phase 3 — CPU sweep of whatever is missing.
