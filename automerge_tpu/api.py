@@ -57,7 +57,7 @@ def load_immutable(data: str, actor_id: str | None = None):
     changes = payload.get("changes", payload) if isinstance(payload, dict) else payload
     return apply_changes_to_doc(doc, doc._doc.opset,
                                 [coerce_change(c) for c in changes],
-                                incremental=False)
+                                incremental=False, emit_diffs=False)
 
 
 # ---------------------------------------------------------------------------
@@ -281,7 +281,7 @@ def load_transit(data: str | bytes, actor_id: str | None = None) -> RootMap:
     doc = init(actor_id)
     return apply_changes_to_doc(doc, doc._doc.opset,
                                 changes_from_transit(data),
-                                incremental=False)
+                                incremental=False, emit_diffs=False)
 
 
 def load(data: str, actor_id: str | None = None) -> RootMap:
@@ -308,9 +308,10 @@ def load(data: str, actor_id: str | None = None) -> RootMap:
     else:
         changes = payload  # bare list of changes
     doc = init(actor_id)
+    # no-diff load: diffs have no consumer on a from-scratch replay
     return apply_changes_to_doc(doc, doc._doc.opset,
                                 [coerce_change(c) for c in changes],
-                                incremental=False)
+                                incremental=False, emit_diffs=False)
 
 
 # ---------------------------------------------------------------------------
@@ -360,7 +361,8 @@ class HistoryEntry:
     def snapshot(self) -> RootMap:
         doc = init(self._actor_id)
         changes = [self._opset.history[i] for i in range(self._index + 1)]
-        return apply_changes_to_doc(doc, doc._doc.opset, changes, incremental=False)
+        return apply_changes_to_doc(doc, doc._doc.opset, changes,
+                                    incremental=False, emit_diffs=False)
 
 
 def get_history(doc) -> list[HistoryEntry]:

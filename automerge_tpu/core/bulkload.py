@@ -437,8 +437,8 @@ def build_opset(cols) -> OpSet:
 
     # ------------------------------------------------------------------
     # 7. list order: one native RGA linearization per sequence object,
-    # then a bulk ElemList build of the visible elements.
-    from ..native.linearize import linearize_host
+    # then a bulk ElemList build of the visible elements (shared with the
+    # no-diff interpretive load: opset.rebuild_elem_ids).
 
     # seal the plain-dict sequence state back into CowDicts (wrap, no copy)
     from ..utils.persist import CowDict
@@ -448,36 +448,12 @@ def build_opset(cols) -> OpSet:
             obj.following = CowDict(obj.following)
             obj.insertion = CowDict(obj.insertion)
 
+    from .opset import rebuild_elem_ids
+
     actor_rank = {a: r for r, a in enumerate(sorted(set(actors)))}
     for obj in by_object.values():
-        if not obj.is_sequence:
-            continue
-        ins_ops = list(obj.insertion.values())
-        n = len(ins_ops)
-        if n == 0:
-            continue
-        slot_of = {f"{op.actor}:{op.elem}": s
-                   for s, op in enumerate(ins_ops)}
-        elem = np.fromiter((op.elem for op in ins_ops), np.int32, n)
-        arank = np.fromiter((actor_rank[op.actor] for op in ins_ops),
-                            np.int32, n)
-        parent = np.fromiter(
-            ((-1 if op.key == HEAD else slot_of[op.key])
-             for op in ins_ops), np.int32, n)
-        pos = linearize_host(np.ones(n, bool), elem, arank, parent)
-        keys_v, values_v = [], []
-        fields_get = obj.fields.get
-        for s in np.argsort(pos, kind="stable").tolist():
-            op = ins_ops[s]
-            eid = f"{op.actor}:{op.elem}"
-            fops = fields_get(eid)
-            if not fops:
-                continue
-            first = fops[0]
-            keys_v.append(eid)
-            values_v.append(Link(first.value) if first.action == "link"
-                            else first.value)
-        obj.elem_ids = ElemList(keys_v, values_v)
+        if obj.is_sequence:
+            rebuild_elem_ids(obj, actor_rank)
 
     # ------------------------------------------------------------------
     # 8. states / clock / frontier / history

@@ -103,7 +103,7 @@ class Builder:
     """Copy-on-write working state for applying a batch of changes."""
 
     __slots__ = ("states", "by_object", "clock", "deps", "queue", "history",
-                 "_touched", "_elem_copied")
+                 "_touched", "_elem_copied", "_deferred_seqs")
 
     def __init__(self, opset: "OpSet"):
         self.states: dict[str, AList] = dict(opset.states)
@@ -114,6 +114,10 @@ class Builder:
         self.history: AList = opset.history
         self._touched: set[str] = set()
         self._elem_copied: set[str] = set()
+        # sequence objects whose elem_ids maintenance was deferred by a
+        # no-diff apply (add_changes(emit_diffs=False)); rebuilt once at
+        # the end of the batch
+        self._deferred_seqs: set[str] = set()
 
     def obj(self, object_id: str) -> ObjState:
         """Object state for mutation (copied on first touch in this batch)."""
@@ -399,7 +403,7 @@ def update_map_key(b: Builder, object_id: str, key: str) -> list[dict]:
     return [edit]
 
 
-def apply_assign(b: Builder, op: Op) -> list[dict]:
+def apply_assign(b: Builder, op: Op, emit: bool = True) -> list[dict]:
     object_id = op.obj
     if object_id not in b.by_object:
         raise ValueError(f"Modification of unknown object {object_id}")
@@ -428,23 +432,83 @@ def apply_assign(b: Builder, op: Op) -> list[dict]:
     remaining.sort(key=lambda o: o.actor or "", reverse=True)
     obj.fields[op.key] = tuple(remaining)
 
+    if not emit:
+        # No-diff mode (from-scratch loads): edit records have no consumer
+        # and elem_ids maintenance — the per-op O(sqrt n) index work — is
+        # deferred to one rebuild_elem_ids pass at end of batch. The
+        # reference cannot skip this (its frontends are diff-driven,
+        # op_set.js:105-129); ours materializes from state.
+        if obj.is_sequence:
+            b._deferred_seqs.add(object_id)
+        return _NO_DIFFS
     if obj.is_sequence:
         return update_list_element(b, object_id, op.key)
     return update_map_key(b, object_id, op.key)
 
 
-def apply_op(b: Builder, op: Op) -> list[dict]:
+# immutable empty sentinel: returned (never mutated) by the no-diff
+# apply paths so emit=False costs zero allocations per op
+_NO_DIFFS: tuple = ()
+
+
+def rebuild_elem_ids(obj: "ObjState", actor_rank: dict | None = None) -> None:
+    """Rebuild a sequence object's visible-element index from its insertion
+    tree in one pass: native RGA linearization over every insertion (the
+    same algorithm the incremental path applies per-op), then a bulk
+    ElemList build of the visible elements (those with surviving field
+    ops), winner value first. Shared by the bulk loader (core/bulkload.py
+    step 7) and the no-diff interpretive load (add_changes(emit_diffs=
+    False)); O(n) total instead of O(ops * sqrt n) incremental upkeep."""
+    import numpy as np
+
+    from ..native.linearize import linearize_host
+
+    ins_ops = list(obj.insertion.values())
+    n = len(ins_ops)
+    if n == 0:
+        obj.elem_ids = ElemList()
+        return
+    if actor_rank is None:
+        # ranks need only be order-isomorphic to the actor strings for
+        # sibling comparisons within this object
+        actor_rank = {a: r for r, a in enumerate(
+            sorted({op.actor for op in ins_ops}))}
+    slot_of = {f"{op.actor}:{op.elem}": s for s, op in enumerate(ins_ops)}
+    elem = np.fromiter((op.elem for op in ins_ops), np.int32, n)
+    arank = np.fromiter((actor_rank[op.actor] for op in ins_ops),
+                        np.int32, n)
+    parent = np.fromiter(
+        ((-1 if op.key == HEAD else slot_of[op.key]) for op in ins_ops),
+        np.int32, n)
+    pos = linearize_host(np.ones(n, bool), elem, arank, parent)
+    keys_v, values_v = [], []
+    fields_get = obj.fields.get
+    for s in np.argsort(pos, kind="stable").tolist():
+        op = ins_ops[s]
+        eid = f"{op.actor}:{op.elem}"
+        fops = fields_get(eid)
+        if not fops:
+            continue
+        first = fops[0]
+        keys_v.append(eid)
+        values_v.append(Link(first.value) if first.action == "link"
+                        else first.value)
+    obj.elem_ids = ElemList(keys_v, values_v)
+
+
+def apply_op(b: Builder, op: Op, emit: bool = True) -> list[dict]:
     action = op.action
     if action in ("makeMap", "makeList", "makeText"):
-        return apply_make(b, op)
+        made = apply_make(b, op)
+        return made if emit else _NO_DIFFS
     if action == "ins":
         return apply_insert(b, op)
     if action in ("set", "del", "link"):
-        return apply_assign(b, op)
+        return apply_assign(b, op, emit)
     raise ValueError(f"Unknown operation type {action}")
 
 
-def apply_change(b: Builder, change: Change) -> list[dict]:
+def apply_change(b: Builder, change: Change, emit: bool = True) -> list[dict]:
     """Apply one causally-ready change (op_set.js:224-252)."""
     actor, seq = change.actor, change.seq
     prior = b.states.get(actor, EMPTY_ALIST)
@@ -460,7 +524,9 @@ def apply_change(b: Builder, change: Change) -> list[dict]:
 
     diffs: list[dict] = []
     for op in change.ops:
-        diffs.extend(apply_op(b, op.stamped(actor, seq)))
+        d = apply_op(b, op.stamped(actor, seq), emit)
+        if d:
+            diffs.extend(d)
 
     b.deps = {a: s for a, s in b.deps.items() if s > all_deps.get(a, 0)}
     b.deps[actor] = seq
@@ -472,7 +538,7 @@ def apply_change(b: Builder, change: Change) -> list[dict]:
     return diffs
 
 
-def apply_queued_ops(b: Builder) -> list[dict]:
+def apply_queued_ops(b: Builder, emit: bool = True) -> list[dict]:
     """Fixpoint drain of the causal queue (op_set.js:254-270)."""
     diffs: list[dict] = []
     while True:
@@ -480,7 +546,7 @@ def apply_queued_ops(b: Builder) -> list[dict]:
         progressed = False
         for change in b.queue:
             if causally_ready(b, change):
-                diffs.extend(apply_change(b, change))
+                diffs.extend(apply_change(b, change, emit))
                 progressed = True
             else:
                 leftover.append(change)
@@ -569,13 +635,28 @@ class OpSet:
     def add_change(self, change: Change) -> tuple["OpSet", list[dict]]:
         return self.add_changes([change])
 
-    def add_changes(self, changes) -> tuple["OpSet", list[dict]]:
-        """Queue + causally apply a batch of changes (op_set.js:294-297)."""
+    def add_changes(self, changes,
+                    emit_diffs: bool = True) -> tuple["OpSet", list[dict]]:
+        """Queue + causally apply a batch of changes (op_set.js:294-297).
+
+        emit_diffs=False is the from-scratch-load fast path: no edit
+        records are produced (returns an empty diff list) and sequence
+        index maintenance is deferred to ONE rebuild per touched list at
+        the end of the batch. State is bit-identical to the emitting path
+        — pinned by tests/test_nodiff_apply.py."""
         b = self.thaw()
         diffs: list[dict] = []
         for change in changes:
             b.queue.append(change)
-            diffs.extend(apply_queued_ops(b))
+            d = apply_queued_ops(b, emit_diffs)
+            if d:
+                diffs.extend(d)
+        if b._deferred_seqs:
+            for oid in b._deferred_seqs:
+                obj = b.by_object.get(oid)
+                if obj is not None:
+                    rebuild_elem_ids(obj)
+            b._deferred_seqs.clear()
         return self.freeze(b), diffs
 
     # -- change-graph queries (op_set.js:299-330) ---------------------------

@@ -243,8 +243,11 @@ def apply_host(changes, actor_id: str = "engine"):
                 metrics.bump("host_bulk_built")
                 return materialize_root(actor_id, opset)
     doc = init(actor_id)
+    # no-diff apply: a from-scratch load has no diff consumer, so the
+    # per-op edit records and O(sqrt n) sequence-index upkeep are skipped
+    # and elem_ids rebuilds once per list (opset.add_changes docstring)
     return apply_changes_to_doc(doc, doc._doc.opset, list(changes),
-                                incremental=False)
+                                incremental=False, emit_diffs=False)
 
 
 def apply_batch_adaptive(doc_changes: list, passes: int = 1):

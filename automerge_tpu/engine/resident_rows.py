@@ -1705,7 +1705,7 @@ class ResidentRowsDocSet(ResidentDocSet):
         changes = [c.change() if isinstance(c, AdmittedRef) else c
                    for c in self.change_log[i]]
         doc = apply_changes_to_doc(doc, doc._doc.opset, changes,
-                                   incremental=False)
+                                   incremental=False, emit_diffs=False)
         from .batchdoc import oracle_state
         return oracle_state(doc)
 

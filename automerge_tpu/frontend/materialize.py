@@ -131,11 +131,20 @@ def update_cache(opset: OpSet, diffs: list[dict], old_cache: dict) -> dict:
     return cache
 
 
-def apply_changes_to_doc(doc, opset: OpSet, changes, incremental: bool):
+def apply_changes_to_doc(doc, opset: OpSet, changes, incremental: bool,
+                         emit_diffs: bool = True):
     """The frontend's change-ingestion entry point (freeze_api.js:245-267):
     run changes through the CRDT core, then refresh the materialization.
-    Dispatches on the document's frontend style (auto_api.js:34-38)."""
-    new_opset, diffs = opset.add_changes(changes)
+    Dispatches on the document's frontend style (auto_api.js:34-38).
+
+    emit_diffs=False (valid only with incremental=False, where the diff
+    stream has no consumer) takes the opset's no-diff fast path — the
+    bench oracle deliberately keeps emit_diffs=True, because the
+    reference's applyChanges cannot skip diff emission (its frontends
+    are diff-driven, op_set.js:105-129)."""
+    if not emit_diffs and incremental:
+        raise ValueError("emit_diffs=False requires incremental=False")
+    new_opset, diffs = opset.add_changes(changes, emit_diffs=emit_diffs)
     if getattr(doc._doc, "frontend", "frozen") == "immutable":
         # The immutable-view frontend re-instantiates from the opset (the
         # reference's ImmutableAPI likewise refreshes rather than patches,

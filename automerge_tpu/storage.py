@@ -168,4 +168,5 @@ def load_binary(data: bytes, actor_id: str | None = None):
     from .frontend.materialize import apply_changes_to_doc
     doc = api.init(actor_id)
     return apply_changes_to_doc(doc, doc._doc.opset,
-                                changes_from_binary(data), incremental=False)
+                                changes_from_binary(data),
+                                incremental=False, emit_diffs=False)

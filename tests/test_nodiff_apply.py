@@ -1,0 +1,146 @@
+"""The no-diff apply mode (opset.add_changes(emit_diffs=False)) must be
+state-identical to the emitting path: same materialized documents, same
+conflict tables, same elem_ids order and values, and a document loaded
+no-diff must keep working incrementally afterwards (the rebuilt sequence
+index is the real one, not a lookalike). The mode exists for from-scratch
+loads (engine/dispatch.apply_host), where the reference must still pay
+per-op diff emission (op_set.js:105-129) but this architecture does not."""
+
+import random
+
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu.core.opset import OpSet
+from automerge_tpu.frontend.materialize import (apply_changes_to_doc,
+                                                build_root)
+
+
+def changes_of(doc):
+    return doc._doc.opset.get_missing_changes({})
+
+
+def trace_nested_conflicts():
+    a = am.change(am.init("A"), lambda d: am.assign(
+        d, {"board": {"lists": [{"title": "todo", "cards": ["x", "y"]}]},
+            "k": 1}))
+    b = am.merge(am.init("B"), a)
+    a2 = am.change(a, lambda d: d.__setitem__("k", "from-a"))
+    b2 = am.change(b, lambda d: d.__setitem__("k", "from-b"))
+    b2 = am.change(b2, lambda d: d["board"]["lists"][0]["cards"].append("z"))
+    a2 = am.change(a2, lambda d: d["board"]["lists"][0]["cards"]
+                   .__delitem__(0))
+    return changes_of(am.merge(a2, b2))
+
+
+def trace_text():
+    d = am.change(am.init("W"), lambda x: x.__setitem__("t", am.Text()))
+    d = am.change(d, lambda x: x["t"].insert_at(0, *"hello world"))
+    e = am.merge(am.init("E"), d)
+    d = am.change(d, lambda x: [x["t"].delete_at(0) for _ in range(3)])
+    e = am.change(e, lambda x: x["t"].insert_at(5, *" brave"))
+    return changes_of(am.merge(d, e))
+
+
+def trace_random(seed):
+    rng = random.Random(seed)
+    reps = {a: am.init(a) for a in "ABC"}
+    base = am.change(reps["A"], lambda x: x.__setitem__("t", am.Text()))
+    reps = {a: (base if a == "A" else am.merge(reps[a], base))
+            for a in "ABC"}
+    for _ in range(rng.randrange(10, 40)):
+        a = rng.choice("ABC")
+        d = reps[a]
+        k = rng.randrange(5)
+        if k == 0:
+            d = am.change(d, lambda x: x["t"].insert_at(
+                rng.randrange(len(x["t"]) + 1), chr(97 + rng.randrange(26))))
+        elif k == 1:
+            d = am.change(d, lambda x: (
+                x["t"].delete_at(rng.randrange(len(x["t"])))
+                if len(x["t"]) else x.__setitem__("pad", 0)))
+        elif k == 2:
+            d = am.change(d, lambda x: x.__setitem__(
+                f"f{rng.randrange(4)}", rng.randrange(100)))
+        elif k == 3:
+            d = am.change(d, lambda x: x.__setitem__(
+                f"m{rng.randrange(2)}", {"v": rng.randrange(9),
+                                         "xs": [1, 2]}))
+        else:
+            src = rng.choice("ABC")
+            if src != a:
+                d = am.merge(d, reps[src])
+        reps[a] = d
+    m = reps["A"]
+    for a in "BC":
+        m = am.merge(m, reps[a])
+    return changes_of(m)
+
+
+def _load(changes, emit):
+    doc = am.init("check")
+    return apply_changes_to_doc(doc, doc._doc.opset, list(changes),
+                                incremental=False, emit_diffs=emit)
+
+
+def assert_same_state(chs):
+    a = _load(chs, True)
+    b = _load(chs, False)
+    assert am.equals(a, b)
+    assert dict(a._conflicts) == dict(b._conflicts)
+    oa, ob = a._doc.opset, b._doc.opset
+    assert oa.clock == ob.clock and oa.deps == ob.deps
+    for oid, obj_a in oa.by_object.items():
+        obj_b = ob.by_object[oid]
+        if obj_a.is_sequence:
+            assert list(obj_a.elem_ids.keys) == list(obj_b.elem_ids.keys), oid
+            assert list(obj_a.elem_ids.values) == \
+                list(obj_b.elem_ids.values), oid
+    return b
+
+
+@pytest.mark.parametrize("trace", [trace_nested_conflicts, trace_text])
+def test_nodiff_matches_emitting_path(trace):
+    assert_same_state(trace())
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_nodiff_matches_on_random_traces(seed):
+    assert_same_state(trace_random(seed))
+
+
+def test_nodiff_load_then_incremental_edits():
+    chs = trace_text()
+    loaded = _load(chs, False)
+    # keep editing through the normal (emitting) incremental path: the
+    # rebuilt elem_ids must behave exactly like an incrementally built one
+    d = am.change(loaded, lambda x: x["t"].insert_at(0, "Z"))
+    d = am.change(d, lambda x: x["t"].delete_at(2))
+    want = am.change(_load(chs, True),
+                     lambda x: x["t"].insert_at(0, "Z"))
+    want = am.change(want, lambda x: x["t"].delete_at(2))
+    assert str(d["t"]) == str(want["t"])
+    assert am.equals(d, want)
+
+
+def test_nodiff_out_of_order_delivery_queues_and_converges():
+    chs = trace_text()
+    doc = am.init("check")
+    opset = doc._doc.opset
+    shuffled = list(chs)
+    random.Random(3).shuffle(shuffled)
+    for c in shuffled:
+        opset, diffs = opset.add_changes([c], emit_diffs=False)
+        assert diffs == []
+    ref = _load(chs, True)._doc.opset
+    assert opset.clock == ref.clock
+    assert not opset.queue
+    got = build_root("check", opset, {})
+    assert am.equals(got, _load(chs, True))
+
+
+def test_nodiff_rejects_incremental():
+    doc = am.init("x")
+    with pytest.raises(ValueError):
+        apply_changes_to_doc(doc, doc._doc.opset, [], incremental=True,
+                             emit_diffs=False)
