@@ -32,8 +32,15 @@ _LINK = {
     "h2d_call_s": 0.010,         # per host->device transfer call
     "h2d_bytes_per_s": 450e6,    # below the ~24MB/call collapse point
     "d2h_call_s": 0.070,         # per readback call
-    "host_op_s": 6e-6,           # interpretive per-op apply+materialize
-    "bulk_op_s": 1.2e-6,         # bulk-build per-op (past fixed ~1ms)
+    "host_op_s": 6e-6,           # no-diff interpretive per-op apply +
+                                 # materialize (measured 2.9-5.8e-6 across
+                                 # map/text/mixed shapes, r5)
+    "bulk_op_s": 5.5e-6,         # bulk-build per-op from IN-MEMORY changes
+                                 # (changes_to_columns conversion dominates;
+                                 # measured 5.6-8.1e-6 at 8K-114K ops, r5.
+                                 # load()-from-text is far cheaper via the
+                                 # native JSON parse, but that is not the
+                                 # path apply_host prices)
     "bulk_fixed_s": 0.001,
 }
 
@@ -72,11 +79,17 @@ def calibrate_from_profile(profile: dict) -> dict:
 
 
 # apply_host engages the vectorized bulk build above this many changes per
-# document. Higher than bulkload's own load() threshold (64): bulk's win
-# comes from replacing per-op interpretive application, which pays off
-# later on short CONCURRENT traces (survivor grouping over many actors)
-# than on the single-actor logs load() sees.
-HOST_BULK_MIN_CHANGES = 256
+# document. Recalibrated for the no-diff interpretive mode (opset.
+# add_changes(emit_diffs=False)): with per-op edit records and sequence-
+# index upkeep gone, the interpretive path is O(ops) with one end-of-batch
+# RGA linearization — the same asymptotics as bulk — and bulk's remaining
+# edge is numpy constants vs the Python op loop, which only outweighs its
+# changes_to_columns conversion cost on very large in-memory logs
+# (measured: interp wins/ties at 45/500/2000/8000/16384 changes across
+# map/text/mixed shapes; bulk wins 1.35x at 65536). load()-from-text keeps
+# its own much lower threshold (64): its native JSON parse feeds columns
+# directly, skipping the conversion that dominates here.
+HOST_BULK_MIN_CHANGES = 24576
 
 
 @dataclass

@@ -128,12 +128,16 @@ def test_causal_order_passthrough_and_reorder():
     assert _causal_order(shuffled[1:]) is None
 
 
-def test_apply_host_bulk_engages_on_concurrent_log():
+def test_apply_host_bulk_engages_on_concurrent_log(monkeypatch):
     """The r3 bench's config-3 routing tax: a merged multi-actor log used
     to pay a failed bulk attempt (causal-order bail) and fall back. After
     the stable reorder, bulk must ENGAGE and match the interpretive result
-    exactly."""
-    changes = _trace_concurrent()       # > HOST_BULK_MIN_CHANGES changes
+    exactly. (The threshold is lowered for the test: the r5 no-diff
+    interpretive mode pushed the real crossover to tens of thousands of
+    changes; this pins the engagement MECHANISM, not the constant.)"""
+    from automerge_tpu.engine import dispatch as _dispatch
+    monkeypatch.setattr(_dispatch, "HOST_BULK_MIN_CHANGES", 256)
+    changes = _trace_concurrent()
     assert len(changes) >= 256
     am.metrics.reset()
     got = apply_host(changes)

@@ -126,3 +126,24 @@ def test_conformance_laws_hold_for_random_programs(instrs):
     obs = am.merge(am.init("obs2"), merged)
     obs = am.merge(obs, merged)
     assert am.equals(obs, merged)
+
+    # law 5: the no-diff apply mode (add_changes(emit_diffs=False), the
+    # from-scratch-load fast path) is state-identical to the emitting
+    # path — equal documents, conflict tables, and per-list element order
+    from automerge_tpu.frontend.materialize import apply_changes_to_doc
+    d_emit = am.init("nd")
+    d_emit = apply_changes_to_doc(d_emit, d_emit._doc.opset,
+                                  list(changes), incremental=False)
+    d_fast = am.init("nd")
+    d_fast = apply_changes_to_doc(d_fast, d_fast._doc.opset,
+                                  list(changes), incremental=False,
+                                  emit_diffs=False)
+    assert am.equals(d_emit, d_fast)
+    assert dict(d_emit._conflicts) == dict(d_fast._conflicts)
+    oa, ob = d_emit._doc.opset, d_fast._doc.opset
+    for oid, obj_a in oa.by_object.items():
+        if obj_a.is_sequence:
+            obj_b = ob.by_object[oid]
+            assert list(obj_a.elem_ids.keys) == list(obj_b.elem_ids.keys)
+            assert list(obj_a.elem_ids.values) == \
+                list(obj_b.elem_ids.values)
