@@ -52,8 +52,11 @@ Admission after compaction is untouched: causal admission is clock-based
 ((actor, seq) against per-doc clock dicts, which compaction never shrinks),
 so a change whose deps reference compacted-away history admits normally.
 The authoritative change log is NOT touched here — `missing_changes`,
-`materialize` and rebuild-from-log keep their full fidelity; log-horizon
-truncation is a separate, optional layer (sync/service.py).
+`materialize` and rebuild-from-log keep their full fidelity; bounding the
+log's host-RAM growth is the separate log-horizon layer
+(sync/logarchive.py + ResidentRowsDocSet.archive_log_prefix), which moves
+the causally-stable prefix below the same floor into an append-only
+archive with transparent cold reads for lagging peers.
 """
 
 from __future__ import annotations

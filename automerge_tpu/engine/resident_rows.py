@@ -145,6 +145,11 @@ class ResidentRowsDocSet(ResidentDocSet):
         self.lazy_dispatch = False
         # per-doc admitted change log (for materialization/debugging)
         self.change_log: list[list] = [[] for _ in self.doc_ids]
+        # log-horizon layer (sync/logarchive.py): per-doc clock below which
+        # the admitted prefix has been moved to the archive; the in-RAM
+        # change_log holds only the tail above it. Empty dict = no horizon.
+        self.log_horizon: list[dict] = [{} for _ in self.doc_ids]
+        self.log_archive = None   # LogArchive, injected by the service
         if actors:
             # Pre-registering the expected actor set avoids a mirror remap +
             # re-upload when they first appear in deltas.
@@ -230,6 +235,7 @@ class ResidentRowsDocSet(ResidentDocSet):
             self.ins_idx.append({})
             self.ghost_eids.append(set())
             self.change_log.append([])
+            self.log_horizon.append({})
         n = len(self.doc_ids)
         if n > self.cap_docs:
             k = _pad_to(n, 8) - self.cap_docs
@@ -714,6 +720,43 @@ class ResidentRowsDocSet(ResidentDocSet):
         if msg:
             raise RuntimeError(msg)
 
+    def archive_log_prefix(self, doc_id: str,
+                           floor: dict[str, int]) -> int:
+        """Log-horizon layer: move the causally-stable prefix of one doc's
+        admitted log (every change with seq <= floor[actor]) out of RAM
+        into self.log_archive, advancing self.log_horizon. The floor must
+        be a causal-stability floor (service._compaction_floor_locked):
+        such floors are transitive clocks, so the prefix is causally
+        closed and archive-then-tail replay order is always valid.
+        Returns the number of changes archived (0 when no archive is
+        attached or nothing is below the floor)."""
+        from .resident import AdmittedRef
+
+        if self.log_archive is None or not floor:
+            return 0
+        i = self.doc_index[doc_id]
+        hz = self.log_horizon[i]
+        if not any(s > hz.get(a, 0) for a, s in floor.items()):
+            # floor has not advanced past the horizon (e.g. a lagging peer
+            # pins it): nothing below it is still in RAM — skip the O(log)
+            # scan the auto-trigger would otherwise pay on every flush
+            return 0
+        keep, move = [], []
+        for c in self.change_log[i]:
+            (move if c.seq <= floor.get(c.actor, 0) else keep).append(c)
+        if not move:
+            return 0
+        self.log_archive.append(
+            doc_id, [c.change() if isinstance(c, AdmittedRef) else c
+                     for c in move])
+        self.change_log[i] = keep
+        hz = self.log_horizon[i]
+        for a, s in floor.items():
+            if s > hz.get(a, 0):
+                hz[a] = int(s)
+        metrics.bump("log_horizon_truncations")
+        return len(move)
+
     def _rebuild_from_log(self) -> None:
         """Disaster recovery: reconstruct the whole instance from the
         admitted change log (the authoritative record) plus any causally-
@@ -724,14 +767,24 @@ class ResidentRowsDocSet(ResidentDocSet):
         reason (the original failure was deterministic, e.g. the batch
         genuinely exceeds capacity), the instance is poisoned: serving
         reads would silently drop admitted changes, so every later
-        apply/read raises loudly instead."""
+        apply/read raises loudly instead.
+
+        With a log horizon the RAM log is only the tail: the archived
+        prefix is cold-read back and replayed first (it is causally closed
+        below the floor). The rebuilt instance holds the FULL log in RAM
+        again with an empty horizon — the service's next threshold pass
+        re-archives; the archive's (actor, seq) read-dedup makes the
+        resulting re-append harmless."""
         from .resident import AdmittedRef
 
         docs = list(self.doc_ids)
         round_: dict[str, list] = {}
         for i, d in enumerate(docs):
-            chs = [c.change() if isinstance(c, AdmittedRef) else c
-                   for c in self.change_log[i]]
+            chs = []
+            if self.log_archive is not None and self.log_horizon[i]:
+                chs.extend(self.log_archive.read(d))
+            chs.extend(c.change() if isinstance(c, AdmittedRef) else c
+                       for c in self.change_log[i])
             for p in self.tables[i].queue:
                 pay = p.payload
                 chs.append(AdmittedRef(*pay).change()
@@ -740,6 +793,10 @@ class ResidentRowsDocSet(ResidentDocSet):
                 round_[d] = chs
         fresh = ResidentRowsDocSet(docs, actors=list(self.actors),
                                    native=self._native is not None)
+        fresh.log_archive = self.log_archive
+        fresh.compaction_floors = dict(self.compaction_floors)
+        fresh.device = self.device
+        fresh.lazy_dispatch = self.lazy_dispatch
         fresh._rebuilding = True
         try:
             if round_:
@@ -1702,8 +1759,13 @@ class ResidentRowsDocSet(ResidentDocSet):
 
         i = self.doc_index[doc_id]
         doc = api.init("resident-view")
-        changes = [c.change() if isinstance(c, AdmittedRef) else c
-                   for c in self.change_log[i]]
+        changes = []
+        if self.log_archive is not None and self.log_horizon[i]:
+            # RAM holds only the tail above the log horizon; the replay
+            # needs the archived prefix too (cold path, like a fresh peer)
+            changes.extend(self.log_archive.read(doc_id))
+        changes.extend(c.change() if isinstance(c, AdmittedRef) else c
+                       for c in self.change_log[i])
         doc = apply_changes_to_doc(doc, doc._doc.opset, changes,
                                    incremental=False, emit_diffs=False)
         from .batchdoc import oracle_state

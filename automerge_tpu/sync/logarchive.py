@@ -1,0 +1,88 @@
+"""Append-only change-log archive for the log-horizon layer.
+
+Row compaction (engine/compaction.py) bounds the DEVICE working set of a
+long-lived document, but the host-side admitted change log still grows
+with history — the reference has the same unbounded growth (its OpSet
+keeps every change, /root/reference/src/op_set.js:272-285, and save()
+serializes all of it, automerge.js:223-226). The log-horizon layer moves
+the causally-stable prefix (everything at or below the compaction floor,
+i.e. acknowledged by every registered peer) out of RAM into this archive:
+
+- steady-state peers sync from the in-RAM tail and never touch it;
+- a lagging or brand-new peer transparently triggers a COLD READ — the
+  reference `{docId, clock, changes}` wire protocol keeps working with no
+  resync extension, it just costs a file read on the serving side
+  (metric: ``log_archive_cold_reads``);
+- rebuild-from-log (the failure-recovery path) replays archive + tail.
+
+Format: one JSONL file per document (name = sha1(doc_id) prefix, the
+doc_id recorded on every line), each line one change dict — the same
+shape `Change.to_dict` / `coerce_change` round-trip and the save file
+uses. Append-only; reads deduplicate by (actor, seq) so a re-archive
+after a rebuild (which restores the full RAM log) cannot double-serve.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+
+from ..core.change import Change, coerce_change
+from ..utils import metrics
+
+
+class LogArchive:
+    """Per-document append-only JSONL archive under one directory."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}
+
+    def _path(self, doc_id: str) -> str:
+        h = hashlib.sha1(doc_id.encode()).hexdigest()[:20]
+        return os.path.join(self.root, f"{h}.jsonl")
+
+    def append(self, doc_id: str, changes) -> int:
+        """Append materialized changes for one doc; returns count written."""
+        if not changes:
+            return 0
+        path = self._path(doc_id)
+        with self._lock:
+            with open(path, "a") as f:
+                for c in changes:
+                    rec = c.to_dict() if isinstance(c, Change) else dict(c)
+                    rec["_doc"] = doc_id
+                    f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+            self._counts[doc_id] = self._counts.get(doc_id, 0) + len(changes)
+        metrics.bump("log_archived_changes", len(changes))
+        return len(changes)
+
+    def read(self, doc_id: str) -> list[Change]:
+        """All archived changes for a doc, deduplicated by (actor, seq).
+
+        The ``log_archive_cold_reads`` metric (operator signal: peers
+        falling behind the horizon) is bumped by the missing_changes call
+        site, not here — internal replays (rebuild-from-log, materialize)
+        also read and must not pollute it."""
+        path = self._path(doc_id)
+        if not os.path.exists(path):
+            return []
+        out: dict[tuple, Change] = {}
+        with self._lock:
+            with open(path) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    rec = json.loads(line)
+                    if rec.pop("_doc", doc_id) != doc_id:
+                        continue  # sha1-prefix collision guard
+                    c = coerce_change(rec)
+                    out[(c.actor, c.seq)] = c
+        return list(out.values())
+
+    def count(self, doc_id: str) -> int:
+        return self._counts.get(doc_id, 0)

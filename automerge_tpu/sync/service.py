@@ -66,7 +66,8 @@ class DocHandle:
 class EngineDocSet:
     def __init__(self, doc_ids: list[str] | None = None,
                  live_views: bool = False, backend: str = "resident",
-                 device=None):
+                 device=None, log_archive_dir: str | None = None,
+                 log_horizon_changes: int | None = None):
         """live_views=True turns the node into a view server: every ingress
         runs the fused apply+reconcile with device-side diff emission
         (engine/diffs.py), per-doc MirrorDoc views are maintained
@@ -82,7 +83,17 @@ class EngineDocSet:
         through the whole-batch vectorized admission path, and `batch()`
         coalesces many ingresses into ONE device dispatch — the steady
         state of a streaming sync service. live_views requires the
-        docs-major backend (device-side diff emission lives there)."""
+        docs-major backend (device-side diff emission lives there).
+
+        log_archive_dir (rows backend only) attaches a log-horizon archive
+        (sync/logarchive.py): the causally-stable log prefix — below the
+        same peer-clock floor compaction uses — can move out of RAM via
+        archive_logs(), and moves automatically whenever a doc's in-RAM
+        log exceeds log_horizon_changes. Steady-state peers sync from the
+        RAM tail; lagging/new peers transparently cold-read the archive
+        (the reference wire protocol is unchanged); rebuild-from-log
+        replays archive + tail. Together with row compaction this bounds
+        BOTH device and host memory of a long-lived document."""
         if backend not in ("resident", "rows"):
             raise ValueError(f"unknown backend {backend!r}")
         if backend == "rows" and live_views:
@@ -99,10 +110,25 @@ class EngineDocSet:
                 # pin every upload/dispatch of this node to one jax device
                 # (ShardedEngineDocSet assigns shards round-robin)
                 self._resident.device = device
+            if log_archive_dir is not None:
+                from .logarchive import LogArchive
+                self._resident.log_archive = LogArchive(log_archive_dir)
         else:
             self._resident = ResidentDocSet(list(doc_ids or []))
             if device is not None:
                 raise ValueError("device pinning requires backend='rows'")
+            if log_archive_dir is not None:
+                raise ValueError(
+                    "log_archive_dir requires backend='rows' (the log-"
+                    "horizon layer lives on the rows engine's admitted log)")
+        if log_horizon_changes is not None and (
+                backend != "rows" or log_archive_dir is None):
+            # silently ignoring the bound would reproduce the exact
+            # failure (unbounded RAM log) the parameter exists to prevent
+            raise ValueError(
+                "log_horizon_changes requires backend='rows' AND "
+                "log_archive_dir (the truncated prefix must go somewhere)")
+        self.log_horizon_changes = log_horizon_changes
         self._pending: dict[str, list] = {}   # rows backend: coalesced round
         self._batch_depth = 0
         self._admit_notify: list[str] = []    # docs awaiting handler gossip
@@ -211,6 +237,26 @@ class EngineDocSet:
                 return {}
             floor = {a: min(s, peer.get(a, 0)) for a, s in floor.items()}
         return {a: s for a, s in floor.items() if s > 0}
+
+    def archive_logs(self, doc_ids: list[str] | None = None) -> dict[str, int]:
+        """Explicitly move each doc's causally-stable log prefix (below the
+        same peer-clock floor compaction uses) into the attached archive.
+        Returns per-doc archived-change counts. Requires backend='rows'
+        with log_archive_dir set."""
+        with self._lock:
+            self._maybe_flush_locked()
+            rset = self._resident
+            if getattr(rset, "log_archive", None) is None:
+                raise ValueError(
+                    "no log archive attached (construct with "
+                    "log_archive_dir=...)")
+            out: dict[str, int] = {}
+            for d in (doc_ids if doc_ids is not None
+                      else list(rset.doc_index)):
+                floor = self._compaction_floor_locked(d)
+                out[d] = (rset.archive_log_prefix(d, floor)
+                          if floor else 0)
+            return out
 
     # -- registry surface (doc_set.js:5-38) ---------------------------------
 
@@ -341,7 +387,16 @@ class EngineDocSet:
         pending = self._pending
         self._pending = {}
         rset = self._resident
-        pre = {d: len(rset.change_log[rset.doc_index[d]]) for d in pending}
+        # Admission detection is CLOCK-based, not log-length-based: a
+        # mid-admission rebuild under a log horizon restores the archived
+        # prefix into change_log (length jumps without any new admission),
+        # while per-doc clocks only move when this round's changes admit.
+        pre = {d: dict(rset.tables[rset.doc_index[d]].clock)
+               for d in pending}
+
+        def _changed(d):
+            # dict() coercion also materializes fast-path StaleViews
+            return dict(rset.tables[rset.doc_index[d]].clock) != pre[d]
         try:
             self._apply_with_compaction(rset, pending)
         except DeviceDispatchError as e:
@@ -364,26 +419,32 @@ class EngineDocSet:
             # every later flush on the same retry; restore the rest.
             self._pending = {
                 d: cols for d, cols in pending.items()
-                if d != e.doc_id
-                and len(rset.change_log[rset.doc_index[d]]) == pre[d]}
+                if d != e.doc_id and not _changed(d)}
             raise
         except Exception:
             # Pre-admission failure (budget precheck, malformed frame, …).
             # Restore ONLY the docs whose changes verifiably did not admit
-            # (per-doc change_log count vs `pre`); re-queueing an admitted
-            # doc would make the retry drop its changes as duplicates while
-            # its ops are already in row state — silent divergence. Docs
-            # that did admit still gossip below via the shared tail.
-            self._pending = {
-                d: cols for d, cols in pending.items()
-                if len(rset.change_log[rset.doc_index[d]]) == pre[d]}
-            self._admit_notify.extend(
-                d for d in pending
-                if len(rset.change_log[rset.doc_index[d]]) > pre[d])
+            # (per-doc clock vs `pre`); re-queueing an admitted doc would
+            # make the retry drop its changes as duplicates while its ops
+            # are already in row state — silent divergence. Docs that did
+            # admit still gossip below via the shared tail.
+            self._pending = {d: cols for d, cols in pending.items()
+                             if not _changed(d)}
+            self._admit_notify.extend(d for d in pending if _changed(d))
             raise
-        admitted = [d for d in pending
-                    if len(rset.change_log[rset.doc_index[d]]) > pre[d]]
+        admitted = [d for d in pending if _changed(d)]
         self._admit_notify.extend(admitted)
+        # Log-horizon auto-trigger AFTER the pre/post log-length
+        # comparisons above (archiving shrinks the RAM log, so it must
+        # never run between them).
+        if self.log_horizon_changes is not None \
+                and getattr(rset, "log_archive", None) is not None:
+            for d in admitted:
+                i = rset.doc_index[d]
+                if len(rset.change_log[i]) > self.log_horizon_changes:
+                    floor = self._compaction_floor_locked(d)
+                    if floor:
+                        rset.archive_log_prefix(d, floor)
 
     def _apply_with_compaction(self, rset, pending: dict) -> None:
         """Apply one coalesced round; on VMEM-budget pressure, compact
@@ -584,6 +645,19 @@ class EngineDocSet:
                         c if isinstance(c, Change) else c.change()
                         for c in rset.change_log[i]
                         if c.seq > clock.get(c.actor, 0)]
+                    if i is not None and rset.log_horizon[i] \
+                            and rset.log_archive is not None \
+                            and any(clock.get(a, 0) < s
+                                    for a, s in rset.log_horizon[i].items()):
+                        # peer is behind the log horizon: transparent cold
+                        # read of the archived prefix — the reference
+                        # {docId, clock, changes} protocol is unchanged,
+                        # the serving side just pays a file read
+                        from ..utils import metrics as _metrics
+                        _metrics.bump("log_archive_cold_reads")
+                        cold = [c for c in rset.log_archive.read(doc_id)
+                                if c.seq > clock.get(c.actor, 0)]
+                        out = cold + out
                 else:
                     out = []
                     for actor, changes in self._log.get(doc_id, {}).items():
