@@ -3,6 +3,7 @@ from .watchable import WatchableDoc
 from .connection import Connection
 from .service import EngineDocSet
 from .sharded_service import ShardedEngineDocSet
+from .logarchive import LogArchive
 
 __all__ = ["DocSet", "WatchableDoc", "Connection", "EngineDocSet",
-           "ShardedEngineDocSet"]
+           "ShardedEngineDocSet", "LogArchive"]

@@ -40,29 +40,41 @@ class LogArchive:
         self.root = root
         os.makedirs(root, exist_ok=True)
         self._lock = threading.Lock()
-        self._counts: dict[str, int] = {}
 
     def _path(self, doc_id: str) -> str:
         h = hashlib.sha1(doc_id.encode()).hexdigest()[:20]
         return os.path.join(self.root, f"{h}.jsonl")
 
     def append(self, doc_id: str, changes) -> int:
-        """Append materialized changes for one doc; returns count written."""
+        """Append materialized changes for one doc; returns count written.
+
+        The whole batch goes down as ONE buffered write + flush: a crash
+        mid-append can tear at most the final line (which read() then
+        skips), never interleave records."""
         if not changes:
             return 0
         path = self._path(doc_id)
+        lines = []
+        for c in changes:
+            rec = c.to_dict() if isinstance(c, Change) else dict(c)
+            rec["_doc"] = doc_id
+            lines.append(json.dumps(rec, separators=(",", ":")))
         with self._lock:
             with open(path, "a") as f:
-                for c in changes:
-                    rec = c.to_dict() if isinstance(c, Change) else dict(c)
-                    rec["_doc"] = doc_id
-                    f.write(json.dumps(rec, separators=(",", ":")) + "\n")
-            self._counts[doc_id] = self._counts.get(doc_id, 0) + len(changes)
+                f.write("\n".join(lines) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
         metrics.bump("log_archived_changes", len(changes))
         return len(changes)
 
     def read(self, doc_id: str) -> list[Change]:
         """All archived changes for a doc, deduplicated by (actor, seq).
+
+        A torn FINAL line (crash or full disk mid-append) is tolerated and
+        skipped — the failed append()'s caller never truncated the RAM log
+        for it, so nothing is lost; corruption anywhere BEFORE the final
+        line still raises (the archive is the only copy of the truncated
+        prefix, and silently dropping records would be divergence).
 
         The ``log_archive_cold_reads`` metric (operator signal: peers
         falling behind the horizon) is bumped by the missing_changes call
@@ -74,15 +86,23 @@ class LogArchive:
         out: dict[tuple, Change] = {}
         with self._lock:
             with open(path) as f:
-                for line in f:
-                    if not line.strip():
-                        continue
-                    rec = json.loads(line)
-                    if rec.pop("_doc", doc_id) != doc_id:
-                        continue  # sha1-prefix collision guard
-                    c = coerce_change(rec)
-                    out[(c.actor, c.seq)] = c
+                lines = f.read().split("\n")
+        last = len(lines) - 1
+        for k, line in enumerate(lines):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                # a torn line can only be the file's final element (a
+                # complete append always ends with a newline, leaving ""
+                # as the last split element)
+                if k == last:
+                    metrics.bump("log_archive_torn_tail_skipped")
+                    continue
+                raise
+            if rec.pop("_doc", doc_id) != doc_id:
+                continue  # sha1-prefix collision guard
+            c = coerce_change(rec)
+            out[(c.actor, c.seq)] = c
         return list(out.values())
-
-    def count(self, doc_id: str) -> int:
-        return self._counts.get(doc_id, 0)

@@ -434,9 +434,9 @@ class EngineDocSet:
             raise
         admitted = [d for d in pending if _changed(d)]
         self._admit_notify.extend(admitted)
-        # Log-horizon auto-trigger AFTER the pre/post log-length
-        # comparisons above (archiving shrinks the RAM log, so it must
-        # never run between them).
+        # Log-horizon auto-trigger: runs last because it needs the final
+        # admitted set of this flush (admission detection itself is
+        # clock-based, so archiving cannot perturb it).
         if self.log_horizon_changes is not None \
                 and getattr(rset, "log_archive", None) is not None:
             for d in admitted:
@@ -655,8 +655,15 @@ class EngineDocSet:
                         # the serving side just pays a file read
                         from ..utils import metrics as _metrics
                         _metrics.bump("log_archive_cold_reads")
+                        hz = rset.log_horizon[i]
+                        # clip to the CURRENT horizon: after a rebuild
+                        # restored the full log to RAM, a later partial
+                        # re-archive can leave the archive holding more
+                        # than the horizon covers — the RAM tail already
+                        # serves that overlap
                         cold = [c for c in rset.log_archive.read(doc_id)
-                                if c.seq > clock.get(c.actor, 0)]
+                                if clock.get(c.actor, 0) < c.seq
+                                <= hz.get(c.actor, 0)]
                         out = cold + out
                 else:
                     out = []

@@ -28,18 +28,27 @@ from .service import EngineDocSet
 
 class ShardedEngineDocSet:
     def __init__(self, n_shards: int = 2, doc_ids: list[str] | None = None,
-                 backend: str = "rows", devices=None):
+                 backend: str = "rows", devices=None,
+                 log_archive_dir: str | None = None,
+                 log_horizon_changes: int | None = None):
         """devices: optional list of jax devices; shards bind round-robin
         so K shards drive K chips from one process (each shard's uploads
         and dispatches are pinned via the engine's `device` attribute —
-        engine/resident_rows._to_dev). None = backend default device."""
+        engine/resident_rows._to_dev). None = backend default device.
+
+        log_archive_dir/log_horizon_changes thread the log-horizon layer
+        to every shard (shard k archives under <dir>/shard<k>; routing is
+        stable, so a doc's archive stays with its shard across restarts)."""
         if n_shards < 1:
             raise ValueError("n_shards must be >= 1")
         self.n_shards = n_shards
         self.shards = [
             EngineDocSet(backend=backend,
                          device=(devices[k % len(devices)]
-                                 if devices else None))
+                                 if devices else None),
+                         log_archive_dir=(None if log_archive_dir is None
+                                          else f"{log_archive_dir}/shard{k}"),
+                         log_horizon_changes=log_horizon_changes)
             for k in range(n_shards)]
         for d in doc_ids or []:
             self.add_doc(d)
@@ -78,6 +87,17 @@ class ShardedEngineDocSet:
 
     def apply_columns(self, doc_id: str, cols):
         return self.shard_of(doc_id).apply_columns(doc_id, cols)
+
+    def archive_logs(self, doc_ids: list[str] | None = None) -> dict[str, int]:
+        """Per-doc archived counts across shards (log-horizon layer)."""
+        out: dict[str, int] = {}
+        if doc_ids is None:
+            for s in self.shards:
+                out.update(s.archive_logs())
+        else:
+            for d in doc_ids:
+                out.update(self.shard_of(d).archive_logs([d]))
+        return out
 
     def flush(self) -> None:
         """Flush every shard even if one raises (shards are independent;

@@ -160,6 +160,60 @@ def test_rebuild_from_log_replays_archive_plus_tail(tmp_path):
     assert am.equals(fresh, d2)
 
 
+def test_torn_archive_tail_is_skipped(tmp_path):
+    """A crash mid-append can tear only the final line; read() skips it
+    (the RAM log was not truncated for a failed append) while corruption
+    before the tail still raises."""
+    import json as _json
+
+    d = history()
+    e = make_service(tmp_path)
+    e.apply_changes("doc", changes_of(d))
+    e.archive_logs()
+    rset = e._resident
+    arch = rset.log_archive
+    path = arch._path("doc")
+    with open(path, "a") as f:
+        f.write('{"actor": "alice", "se')     # torn mid-record, no newline
+    got = arch.read("doc")
+    assert len(got) == len(changes_of(d))
+    fresh = am.apply_changes(am.init("obs"),
+                             list(e.missing_changes("doc", {})))
+    assert am.equals(fresh, d)
+    # mid-file corruption is NOT silently skipped
+    lines = open(path).read().split("\n")
+    lines[1] = lines[1][:10]
+    open(path, "w").write("\n".join(lines))
+    with pytest.raises(_json.JSONDecodeError):
+        arch.read("doc")
+
+
+def test_post_rebuild_overlap_is_not_served_twice(tmp_path):
+    """After a rebuild restores the full log to RAM, a later PARTIAL
+    re-archive leaves the archive holding more than the horizon covers;
+    cold reads clip to the current horizon so no change ships twice."""
+    d = history()
+    chs = changes_of(d)
+    e = make_service(tmp_path)
+    e.apply_changes("doc", chs)
+    e.archive_logs()                          # archive holds 1..N
+    rset = e._resident
+    i = rset.doc_index["doc"]
+    # simulate the post-rebuild state: full log back in RAM, horizon reset,
+    # then a lagging peer pins the re-archive at seq 10
+    full = [c for c in e.missing_changes("doc", {})]
+    rset.change_log[i] = list(full)
+    rset.log_horizon[i] = {}
+    e.note_peer_clock("peer-1", "doc", {"alice": 10})
+    e.archive_logs()                          # horizon now alice:10
+    assert rset.log_horizon[i] == {"alice": 10}
+
+    out = e.missing_changes("doc", {})
+    keys = [(c.actor, c.seq) for c in out]
+    assert len(keys) == len(set(keys)), "duplicate changes on the wire"
+    assert sorted(keys) == sorted((c.actor, c.seq) for c in chs)
+
+
 def test_soak_both_walls_bounded_together(tmp_path):
     """The complete long-lived-document story: row compaction bounds the
     DEVICE working set (VMEM budget) while the log horizon bounds HOST
@@ -212,6 +266,33 @@ def test_archive_requires_rows_backend(tmp_path):
     # silently leave the RAM log unbounded
     with pytest.raises(ValueError):
         EngineDocSet(backend="rows", log_horizon_changes=100)
+
+
+def test_sharded_node_archives_per_shard(tmp_path):
+    from automerge_tpu.sync.sharded_service import ShardedEngineDocSet
+
+    node = ShardedEngineDocSet(n_shards=3,
+                               log_archive_dir=str(tmp_path / "arch"),
+                               log_horizon_changes=20)
+    docs = {}
+    for k in range(6):
+        d = am.init(f"a{k}")
+        for j in range(30):
+            d = am.change(d, lambda x, j=j: x.__setitem__(f"f{j % 4}", j))
+        docs[f"doc{k}"] = d
+        node.apply_changes(f"doc{k}", changes_of(d))
+    # the per-shard auto-trigger already archived during ingress
+    # (threshold 20 < 30 changes/doc): horizons set, RAM logs bounded
+    for did in docs:
+        s = node.shard_of(did)
+        i = s._resident.doc_index[did]
+        assert s._resident.log_horizon[i], did
+        assert len(s._resident.change_log[i]) <= 20, did
+    assert sum(node.archive_logs().values()) == 0   # nothing left to move
+    for did, d in docs.items():
+        fresh = am.apply_changes(am.init("obs"),
+                                 list(node.missing_changes(did, {})))
+        assert am.equals(fresh, d), did
 
 
 def test_pinned_floor_skips_rescan_and_archives_after_catchup(tmp_path):
