@@ -45,12 +45,42 @@ class LogArchive:
         h = hashlib.sha1(doc_id.encode()).hexdigest()[:20]
         return os.path.join(self.root, f"{h}.jsonl")
 
+    @staticmethod
+    def _repair_tail(path: str) -> None:
+        """Truncate a torn final line (crash/ENOSPC mid-append) so a new
+        append cannot glue onto the fragment and corrupt the file mid-way.
+        Safe: the failed append's caller never truncated the RAM log, so
+        the fragment's record still lives there."""
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return
+        if size == 0:
+            return
+        with open(path, "r+b") as f:
+            pos = size
+            while pos > 0:
+                step = min(4096, pos)
+                f.seek(pos - step)
+                block = f.read(step)
+                if pos == size and block.endswith(b"\n"):
+                    return              # clean tail, nothing to repair
+                nl = block.rfind(b"\n")
+                if nl >= 0:
+                    f.truncate(pos - step + nl + 1)
+                    metrics.bump("log_archive_torn_tail_repaired")
+                    return
+                pos -= step
+            f.truncate(0)               # single torn line, no newline at all
+            metrics.bump("log_archive_torn_tail_repaired")
+
     def append(self, doc_id: str, changes) -> int:
         """Append materialized changes for one doc; returns count written.
 
-        The whole batch goes down as ONE buffered write + flush: a crash
-        mid-append can tear at most the final line (which read() then
-        skips), never interleave records."""
+        The whole batch goes down as ONE buffered write + fsync after a
+        torn-tail repair check: a crash mid-append can tear at most the
+        final line, and the next append truncates the fragment before
+        writing, so records never interleave or glue."""
         if not changes:
             return 0
         path = self._path(doc_id)
@@ -60,6 +90,8 @@ class LogArchive:
             rec["_doc"] = doc_id
             lines.append(json.dumps(rec, separators=(",", ":")))
         with self._lock:
+            if os.path.exists(path):
+                self._repair_tail(path)
             with open(path, "a") as f:
                 f.write("\n".join(lines) + "\n")
                 f.flush()
@@ -86,23 +118,20 @@ class LogArchive:
         out: dict[tuple, Change] = {}
         with self._lock:
             with open(path) as f:
-                lines = f.read().split("\n")
-        last = len(lines) - 1
-        for k, line in enumerate(lines):
-            if not line.strip():
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                # a torn line can only be the file's final element (a
-                # complete append always ends with a newline, leaving ""
-                # as the last split element)
-                if k == last:
-                    metrics.bump("log_archive_torn_tail_skipped")
-                    continue
-                raise
-            if rec.pop("_doc", doc_id) != doc_id:
-                continue  # sha1-prefix collision guard
-            c = coerce_change(rec)
-            out[(c.actor, c.seq)] = c
+                for line in f:         # streamed: the archive grows forever
+                    if not line.strip():
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        # torn only if nothing non-empty follows (a
+                        # complete append always ends with a newline)
+                        if any(l.strip() for l in f):
+                            raise
+                        metrics.bump("log_archive_torn_tail_skipped")
+                        break
+                    if rec.pop("_doc", doc_id) != doc_id:
+                        continue  # sha1-prefix collision guard
+                    c = coerce_change(rec)
+                    out[(c.actor, c.seq)] = c
         return list(out.values())

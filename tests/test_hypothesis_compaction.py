@@ -109,10 +109,41 @@ def _run_program(instrs):
                                  HealthCheck.data_too_large])
 @given(st.lists(_instr, min_size=4, max_size=40), st.data())
 def test_compaction_invariants_under_random_delivery(instrs, data):
+    _run_fuzz_scenario(instrs, data, archive=False)
+
+
+@settings(max_examples=_EXAMPLES, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow,
+                                 HealthCheck.data_too_large])
+@given(st.lists(_instr, min_size=4, max_size=40), st.data())
+def test_compaction_and_log_horizon_under_random_delivery(instrs, data):
+    """The same invariants with log-horizon archival in the event mix:
+    row compaction (device) and log truncation (host) interleave with
+    delivery and peer adverts; hash stays invariant, the delivered-prefix
+    oracle parity holds, and a fresh observer reconstructs everything
+    through the archive cold path at the end."""
+    _run_fuzz_scenario(instrs, data, archive=True)
+
+
+def _run_fuzz_scenario(instrs, data, archive: bool):
+    if archive:
+        import shutil
+        import tempfile
+        root = tempfile.mkdtemp(prefix="amtpu-fuzz-arch-")
+        try:
+            _run_fuzz_body(instrs, data, archive, root)
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+    else:
+        _run_fuzz_body(instrs, data, archive, None)
+
+
+def _run_fuzz_body(instrs, data, archive: bool, root):
     merged, snaps = _run_program(instrs)
     all_changes = merged._doc.opset.get_missing_changes({})
 
-    e = EngineDocSet(backend="rows")
+    e = EngineDocSet(backend="rows",
+                     **({"log_archive_dir": root} if archive else {}))
     rset = e._resident
 
     delivered: list = []
@@ -144,9 +175,15 @@ def test_compaction_invariants_under_random_delivery(instrs, data):
             delivered_clock[c.actor] = c.seq
             pending.remove(c)
 
-        action = data.draw(st.sampled_from(
-            ("none", "none", "advert", "compact", "check")), label="action")
-        if action == "advert":
+        actions = ("none", "none", "advert", "compact", "check") \
+            + (("archive",) if archive else ())
+        action = data.draw(st.sampled_from(actions), label="action")
+        if action == "archive" and "doc" in rset.doc_index:
+            h_before = np.uint32(e.hashes()["doc"])
+            e.archive_logs(["doc"])
+            assert np.uint32(e.hashes()["doc"]) == h_before, \
+                "archival moved the convergence hash"
+        elif action == "advert":
             a = data.draw(st.sampled_from(ACTORS), label="peer")
             snap = data.draw(st.sampled_from(snaps[a]), label="snap")
             e.note_peer_clock(f"peer-{a}", "doc", snap)
@@ -180,3 +217,13 @@ def test_compaction_invariants_under_random_delivery(instrs, data):
     assert np.uint32(e.hashes()["doc"]) == h
     assert "".join(e.materialize("doc")["data"]["t"]) == \
         "".join(merged["t"])
+
+    if archive:
+        # a brand-new observer reconstructs the full document through the
+        # archive cold path (missing_changes = cold prefix + RAM tail)
+        fresh = am.apply_changes(am.init("obs"),
+                                 list(e.missing_changes("doc", {})))
+        assert "".join(fresh["t"]) == "".join(merged["t"])
+        for k, v in merged.items():
+            if k != "t":
+                assert fresh[k] == v, (k, fresh[k], v)

@@ -188,6 +188,26 @@ def test_torn_archive_tail_is_skipped(tmp_path):
         arch.read("doc")
 
 
+def test_append_after_torn_tail_repairs_not_glues(tmp_path):
+    """An append following a torn tail must truncate the fragment first:
+    gluing records onto it would turn a recoverable tear into permanent
+    mid-file corruption."""
+    from automerge_tpu.sync.logarchive import LogArchive
+
+    d = history(6)
+    chs = changes_of(d)
+    arch = LogArchive(str(tmp_path / "a"))
+    arch.append("d", chs[:3])
+    with open(arch._path("d"), "a") as f:
+        f.write('{"torn": tru')                 # crash mid-append
+    assert len(arch.read("d")) == 3             # tail skipped
+    arch.append("d", chs[3:])                   # repairs, then appends
+    got = arch.read("d")
+    assert sorted((c.actor, c.seq) for c in got) == \
+        sorted((c.actor, c.seq) for c in chs)
+    assert metrics.snapshot().get("log_archive_torn_tail_repaired")
+
+
 def test_post_rebuild_overlap_is_not_served_twice(tmp_path):
     """After a rebuild restores the full log to RAM, a later PARTIAL
     re-archive leaves the archive holding more than the horizon covers;
