@@ -150,6 +150,10 @@ class ResidentRowsDocSet(ResidentDocSet):
         # change_log holds only the tail above it. Empty dict = no horizon.
         self.log_horizon: list[dict] = [{} for _ in self.doc_ids]
         self.log_archive = None   # LogArchive, injected by the service
+        # bumped by _rebuild_from_log: lets the service's admission
+        # detection use cheap log-length compares except across a rebuild
+        # (which restores the archived prefix into the RAM log)
+        self._rebuild_gen = 0
         if actors:
             # Pre-registering the expected actor set avoids a mirror remap +
             # re-upload when they first appear in deltas.
@@ -813,8 +817,10 @@ class ResidentRowsDocSet(ResidentDocSet):
             self._poison(e)
             raise
         fresh._rebuilding = False
+        gen = getattr(self, "_rebuild_gen", 0)
         self.__dict__.clear()
         self.__dict__.update(fresh.__dict__)
+        self._rebuild_gen = gen + 1
 
     def _replay_chunked(self, fresh: "ResidentRowsDocSet", round_: dict,
                         chunk: int = 256) -> None:
