@@ -275,6 +275,83 @@ def test_soak_both_walls_bounded_together(tmp_path):
     assert am.equals(fresh, d)
 
 
+def test_concurrent_writers_archiver_and_reader(tmp_path):
+    """Threaded stress: three writer threads streaming per-actor changes,
+    one thread archiving in a loop, one reading missing_changes/hashes —
+    all against one node. Validates the lock discipline (no deadlock, no
+    torn state) and final convergence with full reconstruction; the class
+    of bug the r5 gossip-re-entry deadlock belonged to."""
+    import threading
+
+    e = make_service(tmp_path, log_horizon_changes=15)
+    base = am.change(am.init("root"),
+                     lambda x: x.__setitem__("t", am.Text()))
+    e.apply_changes("doc", changes_of(base))
+    errors = []
+    docs = {}
+
+    def writer(actor):
+        try:
+            d = am.merge(am.init(actor), base)
+            served = {c.actor: c.seq for c in changes_of(d)}
+            for k in range(60):
+                d = am.change(d, lambda x, k=k, actor=actor: x.__setitem__(
+                    f"{actor}{k % 7}", k))
+                new = [c for c in changes_of(d)
+                       if c.seq > served.get(c.actor, 0)]
+                for c in new:
+                    served[c.actor] = c.seq
+                e.apply_changes("doc", [c for c in new if c.actor == actor])
+            docs[actor] = d
+        except Exception as ex:  # pragma: no cover - failure reporting
+            errors.append(ex)
+
+    stop = threading.Event()
+
+    def archiver():
+        try:
+            while not stop.is_set():
+                e.archive_logs(["doc"])
+        except Exception as ex:
+            errors.append(ex)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                e.missing_changes("doc", {})
+                e.hashes()
+        except Exception as ex:
+            errors.append(ex)
+
+    # daemon=True: if the deadlock this test hunts ever reappears, the
+    # assertion below must REPORT it — non-daemon threads would hang the
+    # interpreter at exit instead
+    ws = [threading.Thread(target=writer, args=(a,), daemon=True)
+          for a in "ABC"]
+    aux = [threading.Thread(target=archiver, daemon=True),
+           threading.Thread(target=reader, daemon=True)]
+    for t in ws + aux:
+        t.start()
+    for t in ws:
+        t.join(timeout=120)
+    stop.set()
+    for t in aux:
+        t.join(timeout=30)
+    assert not errors, errors
+    assert all(not t.is_alive() for t in ws + aux), "deadlocked thread"
+
+    # final truth: merge every writer's replica; the node must match and
+    # a fresh observer must reconstruct it through the archive
+    m = base
+    for d in docs.values():
+        m = am.merge(m, d)
+    e.flush()
+    assert np.uint32(e.hashes()["doc"]) == oracle_hash(changes_of(m))
+    fresh = am.apply_changes(am.init("obs"),
+                             list(e.missing_changes("doc", {})))
+    assert am.equals(fresh, m)
+
+
 def test_archive_requires_rows_backend(tmp_path):
     with pytest.raises(ValueError):
         EngineDocSet(backend="resident",
