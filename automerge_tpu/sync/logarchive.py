@@ -54,18 +54,19 @@ class LogArchive:
         try:
             size = os.path.getsize(path)
         except OSError:
-            return
+            return                      # nothing on disk yet
         if size == 0:
             return
         with open(path, "r+b") as f:
+            f.seek(size - 1)
+            if f.read(1) == b"\n":
+                return                  # clean tail, nothing to repair
+            # torn: truncate back to the last complete line
             pos = size
             while pos > 0:
                 step = min(4096, pos)
                 f.seek(pos - step)
-                block = f.read(step)
-                if pos == size and block.endswith(b"\n"):
-                    return              # clean tail, nothing to repair
-                nl = block.rfind(b"\n")
+                nl = f.read(step).rfind(b"\n")
                 if nl >= 0:
                     f.truncate(pos - step + nl + 1)
                     metrics.bump("log_archive_torn_tail_repaired")
@@ -90,8 +91,7 @@ class LogArchive:
             rec["_doc"] = doc_id
             lines.append(json.dumps(rec, separators=(",", ":")))
         with self._lock:
-            if os.path.exists(path):
-                self._repair_tail(path)
+            self._repair_tail(path)     # no-op on a missing or clean file
             with open(path, "a") as f:
                 f.write("\n".join(lines) + "\n")
                 f.flush()

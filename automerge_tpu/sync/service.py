@@ -29,6 +29,7 @@ from typing import Callable
 from ..core.change import Change
 from ..engine.resident import ResidentDocSet
 from ..engine.resident_rows import CompactionAnchorError, DeviceDispatchError
+from ..utils import metrics
 
 
 class _HandleOpSet:
@@ -661,8 +662,7 @@ class EngineDocSet:
                         # read of the archived prefix — the reference
                         # {docId, clock, changes} protocol is unchanged,
                         # the serving side just pays a file read
-                        from ..utils import metrics as _metrics
-                        _metrics.bump("log_archive_cold_reads")
+                        metrics.bump("log_archive_cold_reads")
                         hz = rset.log_horizon[i]
                         # clip to the CURRENT horizon: after a rebuild
                         # restored the full log to RAM, a later partial
