@@ -218,16 +218,27 @@ def run_text_load_config(n_edits=65536, oracle_cap=8192):
     small, small_vis = gen_text_load_log(oracle_cap)
     full, full_vis = gen_text_load_log(n_edits)
 
-    t0 = time.perf_counter()
-    doc_small_oracle = am.init("o")
-    doc_small_oracle = apply_changes_to_doc(
-        doc_small_oracle, doc_small_oracle._doc.opset,
-        [coerce_change(c) for c in json.loads(small)], incremental=False)
-    oracle_small_s = time.perf_counter() - t0
-
-    t0 = time.perf_counter()
-    doc_small_bulk = am.load(small)
-    bulk_small_s = time.perf_counter() - t0
+    # interleaved A/B reps with medians (same discipline as the routed
+    # configs): from-scratch loads are repeatable, so both sides see the
+    # same interpreter/allocator state on this single-core host
+    import statistics
+    ora_ts, blk_ts = [], []
+    doc_small_oracle = doc_small_bulk = None
+    for _ in range(3):
+        # the oracle's timed region keeps parse + coerce + apply — the
+        # same wire-string start line am.load pays on the engine side
+        t0 = time.perf_counter()
+        d = am.init("o")
+        doc_small_oracle = apply_changes_to_doc(
+            d, d._doc.opset,
+            [coerce_change(c) for c in json.loads(small)],
+            incremental=False)
+        ora_ts.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        doc_small_bulk = am.load(small)
+        blk_ts.append(time.perf_counter() - t0)
+    oracle_small_s = statistics.median(ora_ts)
+    bulk_small_s = statistics.median(blk_ts)
     assert try_bulk_load(small) is not None, "bulk path did not engage"
     if not am.equals(doc_small_oracle, doc_small_bulk):
         raise AssertionError("bulk/interpretive load parity failure")
@@ -295,31 +306,45 @@ def run_interactive_text_config(n_edits=65536, n_keys=1000):
             moves.append(("del", rng.randint(0, n - 1), None))
             n -= 1
 
-    t0 = time.perf_counter()
-    for kind, pos, ch in moves:
-        if kind == "ins":
-            doc = am.change(doc, lambda d, pos=pos, ch=ch:
-                            d["t"].insert_at(pos, ch))
-        else:
-            doc = am.change(doc, lambda d, pos=pos: d["t"].delete_at(pos))
-    engine_s = time.perf_counter() - t0
-    assert len(doc["t"]) == n
-
-    # flat-index frontend cost model, same trace (list insert + position
-    # dict rebuild + full snapshot tuple, per keystroke)
+    # Interleaved slices with per-side medians (same discipline as the
+    # routed and resident measurements): both sides consume the SAME
+    # keystroke trace in thirds, alternating engine/oracle, so
+    # single-core interpreter drift cannot load one side.
+    import statistics
+    n_slices = min(3, len(moves))
+    per = len(moves) // n_slices
     keys = [f"A:{i}" for i in range(vis)]
     vals = ["x"] * vis
-    t0 = time.perf_counter()
-    for kind, pos, ch in moves:
-        if kind == "ins":
-            keys.insert(pos, "k")
-            vals.insert(pos, ch)
-        else:
-            keys.pop(pos)
-            vals.pop(pos)
-        _pos = {k: i for i, k in enumerate(keys)}   # position map rebuild
-        _snapshot = tuple(vals)                      # snapshot rebuild
-    oracle_s = time.perf_counter() - t0
+    eng_ts, ora_ts = [], []
+    for s in range(n_slices):
+        chunk = moves[s * per:(s + 1) * per if s < n_slices - 1
+                      else len(moves)]
+        t0 = time.perf_counter()
+        for kind, pos, ch in chunk:
+            if kind == "ins":
+                doc = am.change(doc, lambda d, pos=pos, ch=ch:
+                                d["t"].insert_at(pos, ch))
+            else:
+                doc = am.change(doc, lambda d, pos=pos:
+                                d["t"].delete_at(pos))
+        eng_ts.append((time.perf_counter() - t0) / len(chunk))
+
+        # flat-index frontend cost model, same trace slice (list insert +
+        # position dict rebuild + full snapshot tuple, per keystroke)
+        t0 = time.perf_counter()
+        for kind, pos, ch in chunk:
+            if kind == "ins":
+                keys.insert(pos, "k")
+                vals.insert(pos, ch)
+            else:
+                keys.pop(pos)
+                vals.pop(pos)
+            _pos = {k: i for i, k in enumerate(keys)}  # position map rebuild
+            _snapshot = tuple(vals)                    # snapshot rebuild
+        ora_ts.append((time.perf_counter() - t0) / len(chunk))
+    assert len(doc["t"]) == n
+    engine_s = statistics.median(eng_ts) * n_keys
+    oracle_s = statistics.median(ora_ts) * n_keys
 
     return {
         "config": 7,
