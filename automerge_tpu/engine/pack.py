@@ -427,6 +427,26 @@ def apply_rows_hash_bytes(wire_u8, bmeta: tuple, dims: tuple,
 # make/ins op rows carry no kernel state (amask needs action >= set, and
 # insertion data travels in the ins tables) and are dropped outright.
 
+def select_field_sharding(batch: dict, max_fids: int):
+    """The op-axis target ladder for wide documents: try splitting into
+    field-disjoint virtual docs at each target (largest first, so the
+    fewest virtual docs that fit the VMEM envelope win) and return
+    (sharded_batch, owner, target_ops) for the first eligible split, or
+    (None, None, None) when the ineligibility is elems/actors-driven and
+    op-axis sharding cannot help. ONE ladder shared by bench.run_engine's
+    device path and the interpret-mode bench-shape tests, so the tested
+    split is always the shipped split."""
+    a0 = batch["clock"].shape[2]
+    le0 = batch["ins_mask"].shape[1] * batch["ins_mask"].shape[2]
+    for target in (512, 256, 128):
+        if not rows_dims_eligible(target, a0, le0):
+            continue
+        cand, owner = shard_batch_by_fields(batch, max_fids, target)
+        if rows_eligible(cand, max_fids):
+            return cand, owner, target
+    return None, None, None
+
+
 def shard_batch_by_fields(batch: dict, max_fids: int, target_ops: int = 512):
     """Split docs with more than `target_ops` assigns into field-disjoint
     virtual docs of at most `target_ops` assigns each.

@@ -625,27 +625,18 @@ def run_engine(doc_changes, repeat=None):
     shard_info = {}
     if HAVE_PALLAS and jax.default_backend() == "tpu" and not eligible:
         # wide docs: split by field into virtual doc columns whose hashes
-        # sum back exactly (pack.shard_batch_by_fields) — turns a per-doc
-        # VMEM bound into docs-axis parallelism
-        from automerge_tpu.engine.pack import (rows_dims_eligible,
-                                               shard_batch_by_fields)
+        # sum back exactly — the ladder lives in pack.select_field_sharding
+        # (shared with the interpret-mode bench-shape tests)
+        from automerge_tpu.engine.pack import select_field_sharding
         orig_batch = batch
-        a0 = batch["clock"].shape[2]
-        le0 = batch["ins_mask"].shape[1] * batch["ins_mask"].shape[2]
-        # sharding only shrinks the op axis; skip entirely when the
-        # ineligibility is elems/actors-driven
-        for target in (512, 256, 128):
-            if not rows_dims_eligible(target, a0, le0):
-                continue
-            sharded, ow = shard_batch_by_fields(batch, max_fids, target)
-            if rows_eligible(sharded, max_fids):
-                shard_info = {"field_sharded": {
-                    "virtual_docs": int(len(ow)),
-                    "real_docs": int(orig_batch["op_mask"].shape[0]),
-                    "target_ops": target}}
-                batch, owner = sharded, ow
-                eligible = True
-                break
+        sharded, ow, target = select_field_sharding(batch, max_fids)
+        if sharded is not None:
+            shard_info = {"field_sharded": {
+                "virtual_docs": int(len(ow)),
+                "real_docs": int(orig_batch["op_mask"].shape[0]),
+                "target_ops": target}}
+            batch, owner = sharded, ow
+            eligible = True
     use_rows = (HAVE_PALLAS and jax.default_backend() == "tpu" and eligible)
     d_, i_ = batch["op_mask"].shape
     a_ = batch["clock"].shape[2]
