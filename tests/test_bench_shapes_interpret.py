@@ -83,6 +83,29 @@ def test_cfg3_cfg4_rows_path_interpret(gen):
     assert (got[:len(dc)] == _oracle_hashes(dc)).all()
 
 
+@pytest.mark.parametrize("gen", [bench.gen_lww_storm, bench.gen_trellis])
+def test_dense_kernel_parity_on_bench_shapes(gen):
+    """The dense one-hot formulation (TPU-only in production, the prime
+    suspect in the r5 tunnel fault) must agree with the segment path on
+    the exact bench batches it would execute on hardware."""
+    import jax
+
+    from automerge_tpu.engine import kernels
+
+    dc, batch, mf = _batch_for(gen)
+    assert kernels._dense_cost(batch, mf) <= kernels.DENSE_BUDGET
+    seg = np.asarray(kernels.apply_doc(batch, mf)["hash"])
+    kernels.FORCE_DENSE = True
+    try:
+        jax.clear_caches()
+        den = np.asarray(kernels.apply_doc(batch, mf)["hash"])
+    finally:
+        kernels.FORCE_DENSE = False
+        jax.clear_caches()
+    assert (seg == den).all()
+    assert (seg[:len(dc)].astype(np.uint32) == _oracle_hashes(dc)).all()
+
+
 def test_cfg5_subset_rows_path_interpret():
     """A 256-doc slice of the config-5 DocSet batch through the byte wire
     (the full 10K-doc batch in interpret mode would take minutes)."""
