@@ -40,7 +40,7 @@ def _row_buffer(doc_changes):
     return rows, dims, n
 
 
-def probe(name, doc_changes, force_xl, passes):
+def probe(name, doc_changes, force_xl, passes, interpret=False):
     from functools import partial
 
     import jax
@@ -64,7 +64,7 @@ def probe(name, doc_changes, force_xl, passes):
     def chained(r):
         acc = jnp.zeros((), jnp.uint32)
         for _ in range(passes):
-            h = reconcile_rows_hash.__wrapped__(r, dims, False,
+            h = reconcile_rows_hash.__wrapped__(r, dims, interpret,
                                                 force_xl=force_xl)
             acc = acc + h.sum()
             # serialize the passes: next input depends on this pass's hash
@@ -101,9 +101,37 @@ def main():
     ap.add_argument("--docs", type=int, default=10000)
     ap.add_argument("--xl-docs", type=int, default=2048)
     ap.add_argument("--passes", type=int, default=8)
+    ap.add_argument("--interpret-smoke", action="store_true",
+                    help="run tiny probes in pallas interpret mode on the "
+                         "CPU backend — validates this script's plumbing "
+                         "so the recovery hook cannot trip on a latent "
+                         "bug the first time the chip returns (timings "
+                         "are meaningless; nothing is written)")
     args = ap.parse_args()
 
     import jax
+    if args.interpret_smoke:
+        # pin BEFORE the first backend read: default_backend() initializes
+        # the axon plugin, which HANGS (never raises) on a wedged tunnel
+        jax.config.update("jax_platforms", "cpu")
+        backend = jax.default_backend()
+        import bench
+        bench._load_package()
+        out = [probe("smoke-base", bench.gen_docset(64), False, 2,
+                     interpret=True),
+               probe("smoke-trellis", bench.gen_trellis() * 8, False, 2,
+                     interpret=True)]
+        print(json.dumps({"smoke": True, "backend": backend,
+                          "probes": [{k: p[k] for k in p
+                                      if k in ("probe", "skipped", "docs",
+                                               "passes")}
+                                     for p in out]}))
+        skipped = [p["probe"] for p in out if "skipped" in p]
+        if skipped:
+            # a skipped probe validated nothing — fail loudly so the
+            # smoke cannot green-light broken plumbing
+            raise SystemExit(f"smoke probes skipped: {skipped}")
+        return
     backend = jax.default_backend()
     if backend != "tpu":
         print(json.dumps({"error": f"backend is {backend}; the roofline "
