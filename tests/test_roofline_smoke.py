@@ -1,0 +1,24 @@
+"""The tunnel-recovery hook runs profile_roofline.py the first time the
+chip returns; this pins its plumbing (row-buffer build, chained kernel
+jit, readback) via the --interpret-smoke flag so a latent bug cannot trip
+the one recovery window. The smoke fails loudly if any probe is skipped."""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def test_roofline_interpret_smoke_runs_clean():
+    env = dict(os.environ)
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.dirname(
+             os.path.abspath(__file__))), "profile_roofline.py"),
+         "--interpret-smoke"],
+        capture_output=True, text=True, timeout=540, env=env)
+    assert out.returncode == 0, out.stderr[-800:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["smoke"] is True and rec["backend"] == "cpu"
+    assert len(rec["probes"]) == 2
+    assert all("skipped" not in p for p in rec["probes"])
