@@ -1032,63 +1032,67 @@ def run_resident_rounds(doc_changes, n_rounds=12, fraction=0.2):
     # per-side medians: the r5 records showed the one-shot measurement
     # swinging 1.76-2.14x purely with interpreter/allocator drift (the
     # same class the routed configs fixed with interleaved medians).
-    # Each slice keeps the exact per-round composition of the old
-    # measurement: n_rounds of ingress + ONE convergence read.
+    # Every slice measures the SAME document depth: a fresh engine and
+    # fresh oracle docs per slice, each warmed by warm_rounds then timed
+    # for n_rounds + one convergence read. (A first cut reused one engine
+    # across slices; the lazy reconcile is O(state), so later slices
+    # timed a deeper document than the oracle's O(changes) side and the
+    # median biased low.)
     n_slices = 3
-    rounds = []
-    for rnd in range(n_slices * n_rounds + warm_rounds):
-        deltas = {}
-        for i in changed:
-            prev = docs[i]
-            new = am.change(prev, lambda d, rnd=rnd, i=i: d.__setitem__(
-                "n", rnd * 1000 + i))
-            deltas[doc_ids[i]] = new._doc.opset.get_missing_changes(
-                prev._doc.opset.clock)
-            docs[i] = new
-        rounds.append(deltas)
     from automerge_tpu.engine.resident_rows import ResidentRowsDocSet
     from automerge_tpu.sync.frames import encode_round_frame
-    wire_frames = [encode_round_frame(r) for r in rounds]
-
-    rset = ResidentRowsDocSet(doc_ids)
-    rset.apply_rounds([{doc_ids[i]: doc_changes[i] for i in range(n)}])
-    total = n_slices * n_rounds + warm_rounds
-    rset.reserve(ops_per_doc=int(rset.op_count.max()) + total + 1,
-                 changes_per_doc=int(rset.change_count.max()) + total + 1)
-    rset.lazy_dispatch = True
-    # warm: compiles the reconcile for the final shapes + touches the
-    # admission caches
-    rset.apply_round_frames(wire_frames[:warm_rounds])
-    np.asarray(rset.hashes())
-    warm_round_list, rounds = rounds[:warm_rounds], rounds[warm_rounds:]
-    frames = wire_frames[warm_rounds:]
-
-    # oracle documents brought up through the warm rounds untimed (their
-    # deltas are causal dependencies of the timed ones — without this the
-    # oracle would just queue the timed changes and we would time a no-op)
-    oracle_docs = {i: apply_changes_to_doc(am.init("o"), am.init("o2")._doc.opset,
-                                           doc_changes[i], incremental=False)
-                   for i in changed}
-    for r in warm_round_list:
-        for i in changed:
-            doc = oracle_docs[i]
-            oracle_docs[i] = apply_changes_to_doc(
-                doc, doc._doc.opset, r[doc_ids[i]], incremental=True)
 
     import gc
     import statistics
     eng_slices, ora_slices = [], []
+    base_load = {doc_ids[i]: doc_changes[i] for i in range(n)}
     for k in range(n_slices):
-        sl = slice(k * n_rounds, (k + 1) * n_rounds)
+        # per-slice rounds from the SAME base state (fresh replicas), with
+        # slice-distinct values so no cache anywhere can help
+        slice_docs = {i: docs[i] for i in changed}
+        rounds = []
+        for rnd in range(n_rounds + warm_rounds):
+            deltas = {}
+            for i in changed:
+                prev = slice_docs[i]
+                new = am.change(prev, lambda d, rnd=rnd, i=i, k=k:
+                                d.__setitem__("n", (k + 1) * 100000
+                                              + rnd * 1000 + i))
+                deltas[doc_ids[i]] = new._doc.opset.get_missing_changes(
+                    prev._doc.opset.clock)
+                slice_docs[i] = new
+            rounds.append(deltas)
+        wire_frames = [encode_round_frame(r) for r in rounds]
+
+        rset = ResidentRowsDocSet(doc_ids)
+        rset.apply_rounds([base_load])
+        total = n_rounds + warm_rounds
+        rset.reserve(ops_per_doc=int(rset.op_count.max()) + total + 1,
+                     changes_per_doc=int(rset.change_count.max()) + total + 1)
+        rset.lazy_dispatch = True
+        # warm: compiles the reconcile for the final shapes + touches the
+        # admission caches
+        rset.apply_round_frames(wire_frames[:warm_rounds])
+        np.asarray(rset.hashes())
         gc.collect()
         time.sleep(0.1)
         t0 = time.perf_counter()
-        for f in frames[sl]:
+        for f in wire_frames[warm_rounds:]:
             rset.apply_round_frames([f])
         np.asarray(rset.hashes())   # the slice's convergence read
         eng_slices.append((time.perf_counter() - t0) / n_rounds)
 
-        json_rounds = _oracle_wire_rounds(rounds[sl])
+        # oracle documents brought up through the warm rounds untimed
+        # (their deltas are causal dependencies of the timed ones)
+        oracle_docs = {i: apply_changes_to_doc(
+            am.init("o"), am.init("o2")._doc.opset, doc_changes[i],
+            incremental=False) for i in changed}
+        for r in rounds[:warm_rounds]:
+            for i in changed:
+                doc = oracle_docs[i]
+                oracle_docs[i] = apply_changes_to_doc(
+                    doc, doc._doc.opset, r[doc_ids[i]], incremental=True)
+        json_rounds = _oracle_wire_rounds(rounds[warm_rounds:])
         gc.collect()
         time.sleep(0.1)
         t0 = time.perf_counter()
@@ -1103,7 +1107,8 @@ def run_resident_rounds(doc_changes, n_rounds=12, fraction=0.2):
     engine_round = statistics.median(eng_slices)
     oracle_round = statistics.median(ora_slices)
 
-    ops_per_round = sum(len(c.ops) for d in rounds[0].values() for c in d)
+    ops_per_round = sum(len(c.ops) for d in rounds[warm_rounds].values()
+                        for c in d)
     return engine_round, oracle_round, ops_per_round
 
 

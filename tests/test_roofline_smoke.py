@@ -10,13 +10,12 @@ import sys
 
 
 def test_roofline_interpret_smoke_runs_clean():
-    env = dict(os.environ)
     out = subprocess.run(
         [sys.executable,
          os.path.join(os.path.dirname(os.path.dirname(
              os.path.abspath(__file__))), "profile_roofline.py"),
          "--interpret-smoke"],
-        capture_output=True, text=True, timeout=540, env=env)
+        capture_output=True, text=True, timeout=540)
     assert out.returncode == 0, out.stderr[-800:]
     rec = json.loads(out.stdout.strip().splitlines()[-1])
     assert rec["smoke"] is True and rec["backend"] == "cpu"
