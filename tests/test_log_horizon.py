@@ -275,6 +275,38 @@ def test_soak_both_walls_bounded_together(tmp_path):
     assert am.equals(fresh, d)
 
 
+def test_fresh_peer_syncs_through_archive_over_real_tcp(tmp_path):
+    """The archive cold path over a REAL socket: an archiving rows node
+    serves a brand-new TCP peer its full history (cold prefix + RAM
+    tail), and the peer's edits flow back past the horizon."""
+    from automerge_tpu.sync.tcp import TcpSyncClient, TcpSyncServer
+    from tests.test_tcp_sync import wait_until
+
+    d = history()
+    node = make_service(tmp_path)
+    node.apply_changes("doc", changes_of(d))
+    node.archive_logs()
+    rset = node._resident
+    assert not rset.change_log[rset.doc_index["doc"]]  # all archived
+
+    fresh = DocSet()
+    server = TcpSyncServer(node).start()
+    client = TcpSyncClient(fresh, server.host, server.port).start()
+    try:
+        assert wait_until(lambda: (fresh.get_doc("doc") is not None
+                                   and fresh.get_doc("doc").get("n") == 39))
+        got = fresh.get_doc("doc")
+        assert "".join(got["t"]) == "hello"
+        # edit on the fresh peer; the archiving node converges
+        fresh.set_doc("doc", am.change(
+            got, lambda x: x["t"].insert_at(5, "!")))
+        assert wait_until(lambda: "".join(
+            node.materialize("doc")["data"]["t"]) == "hello!")
+    finally:
+        client.close()
+        server.close()
+
+
 def test_concurrent_writers_archiver_and_reader(tmp_path):
     """Threaded stress: three writer threads streaming per-actor changes,
     one thread archiving in a loop, one reading missing_changes/hashes —
