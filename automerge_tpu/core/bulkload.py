@@ -83,7 +83,7 @@ def try_bulk_build(cols) -> OpSet | None:
             # handles it. Counted so an unexpected fallback (a fast-path
             # bug demoted to a perf regression) is observable rather than
             # silent.
-            metrics.bump("bulkload_fallback_keyerror")
+            metrics.bump("core_bulk_fallbacks")
             return None
 
 
@@ -464,8 +464,8 @@ def build_opset(cols) -> OpSet:
     clock = {actors[a]: int(c) for a, c in
              zip(*np.unique(ch_actor, return_counts=True))}
 
-    metrics.bump("changes_applied", n_ch)
-    metrics.bump("ops_applied", n_ops)
+    metrics.bump("core_changes_applied", n_ch)
+    metrics.bump("core_ops_applied", n_ops)
     return OpSet(states={a: AList(v) for a, v in states.items()},
                  by_object=by_object, clock=clock, deps=frontier,
                  queue=(), history=AList(history))

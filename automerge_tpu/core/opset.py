@@ -532,9 +532,9 @@ def apply_change(b: Builder, change: Change, emit: bool = True) -> list[dict]:
     b.deps[actor] = seq
     b.clock[actor] = seq
     b.history = b.history.append(change)
-    metrics.bump("changes_applied")
-    metrics.bump("ops_applied", len(change.ops))
-    metrics.bump("diffs_emitted", len(diffs))
+    metrics.bump("core_changes_applied")
+    metrics.bump("core_ops_applied", len(change.ops))
+    metrics.bump("core_diffs_emitted", len(diffs))
     return diffs
 
 
@@ -657,6 +657,9 @@ class OpSet:
                 if obj is not None:
                     rebuild_elem_ids(obj)
             b._deferred_seqs.clear()
+        # causal-queue depth after the batch: a growing gauge means peers
+        # are delivering out of causal order (or a dep will never arrive)
+        metrics.gauge("core_queue_depth", len(b.queue))
         return self.freeze(b), diffs
 
     # -- change-graph queries (op_set.js:299-330) ---------------------------

@@ -331,7 +331,7 @@ def compact(rset, floors: dict[str, dict[str, int]],
         rset._hash_handle = None
         rset.rows_dev = None
         rset._elems_hi = max((t.max_elems for t in rset.tables), default=0)
-        metrics.bump("rows_compacted")
+        metrics.bump("rows_docs_compacted")
     return stats
 
 

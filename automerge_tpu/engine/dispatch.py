@@ -253,7 +253,7 @@ def apply_host(changes, actor_id: str = "engine"):
             opset = try_bulk_build(changes_to_columns(ordered))
             if opset is not None:
                 from ..utils import metrics
-                metrics.bump("host_bulk_built")
+                metrics.bump("engine_bulk_built")
                 return materialize_root(actor_id, opset)
     doc = init(actor_id)
     # no-diff apply: a from-scratch load has no diff consumer, so the
@@ -272,9 +272,12 @@ def apply_batch_adaptive(doc_changes: list, passes: int = 1):
     """
     import numpy as np
 
+    from ..utils import metrics
+
     plan = plan_for(doc_changes, passes)
-    if plan.backend == "host":
-        return plan, [apply_host(chs) for chs in doc_changes]
-    from .batchdoc import apply_batch
-    _encs, _batch, out = apply_batch(doc_changes)
-    return plan, np.asarray(out["hash"])
+    with metrics.trace("engine_dispatch", backend=plan.backend):
+        if plan.backend == "host":
+            return plan, [apply_host(chs) for chs in doc_changes]
+        from .batchdoc import apply_batch
+        _encs, _batch, out = apply_batch(doc_changes)
+        return plan, np.asarray(out["hash"])

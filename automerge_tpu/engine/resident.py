@@ -35,6 +35,7 @@ import jax.numpy as jnp
 
 from ..core.change import Change
 from ..core.ids import ROOT_ID, HEAD, make_elem_id
+from ..utils import metrics
 from .encode import (A_DEL, A_INS, A_LINK, A_MAKE_LIST, A_MAKE_MAP,
                      A_MAKE_TEXT, A_SET, ASSIGN_CODES, _ACTION_CODE,
                      ValueTable, content_hash, value_hash_of, _pad_to)
@@ -766,8 +767,10 @@ class ResidentDocSet:
     def _apply_flat(self, flat, meta, diffs: bool):
         self._ensure_actor_hash_state()
         if not diffs:
-            self.state, out = _scatter_and_apply(self.state, flat, meta,
-                                                 max_fids=self.cap_fids)
+            with metrics.trace("engine_resident_apply"):
+                self.state, out = metrics.dispatch_jit(
+                    "scatter_and_apply", _scatter_and_apply,
+                    self.state, flat, meta, max_fids=self.cap_fids)
             self._out = out
             return np.asarray(out["hash"])[:len(self.doc_ids)]
         prev = self._prev_for_diffs()
@@ -775,9 +778,11 @@ class ResidentDocSet:
         actor_hashes = jnp.asarray(
             [content_hash(a) for a in self.actors]
             + [0] * (self.cap_actors - len(self.actors)), dtype=jnp.int32)
-        self.state, out, survh, chg_fid, chg_elem = _scatter_apply_diff(
-            self.state, flat, meta, actor_hashes, *prev,
-            max_fids=self.cap_fids)
+        with metrics.trace("engine_resident_apply"):
+            self.state, out, survh, chg_fid, chg_elem = metrics.dispatch_jit(
+                "scatter_apply_diff", _scatter_apply_diff,
+                self.state, flat, meta, actor_hashes, *prev,
+                max_fids=self.cap_fids)
         self._out = out
         # the baseline for the NEXT diff round: device refs (no transfer);
         # independent of _out so hash-only rounds / add_docs in between do
@@ -842,9 +847,11 @@ class ResidentDocSet:
     def reconcile(self):
         """Run the reconcile kernel over resident state; returns per-doc
         uint32 hashes (numpy, aligned with doc_ids)."""
-        self._ensure_actor_hash_state()
-        self._out = apply_doc(self.state, self.cap_fids)
-        return np.asarray(self._out["hash"])[:len(self.doc_ids)]
+        with metrics.trace("engine_hashes"):
+            self._ensure_actor_hash_state()
+            self._out = metrics.dispatch_jit("apply_doc", apply_doc,
+                                             self.state, self.cap_fids)
+            return np.asarray(self._out["hash"])[:len(self.doc_ids)]
 
     def hashes(self) -> np.ndarray:
         """Per-doc state hashes, reusing the cached reconcile output when no

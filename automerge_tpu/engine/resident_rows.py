@@ -707,7 +707,7 @@ class ResidentRowsDocSet(ResidentDocSet):
                     # (the failure is deterministic) — poison and fail fast
                     self._poison(e)
                     raise
-                metrics.bump("rows_rebuilt_from_log")
+                metrics.bump("rows_log_rebuilt")
                 self._rebuild_from_log()
                 raise DeviceDispatchError(
                     str(e), admission_complete=False) from e
@@ -717,7 +717,7 @@ class ResidentRowsDocSet(ResidentDocSet):
         self._poisoned = (f"resident row state no longer reflects the "
                           f"admitted change log ({cause!r}); rebuild the "
                           f"node from its durable log")
-        metrics.bump("rows_poisoned")
+        metrics.bump("rows_engine_poisoned")
 
     def _check_poisoned(self) -> None:
         msg = getattr(self, "_poisoned", None)
@@ -758,7 +758,7 @@ class ResidentRowsDocSet(ResidentDocSet):
         for a, s in floor.items():
             if s > hz.get(a, 0):
                 hz[a] = int(s)
-        metrics.bump("log_horizon_truncations")
+        metrics.bump("rows_horizon_truncated")
         return len(move)
 
     def _rebuild_from_log(self) -> None:
@@ -947,7 +947,8 @@ class ResidentRowsDocSet(ResidentDocSet):
         if pre_rows is not None:
             self.rows_dev = self._to_dev(pre_rows)
             self._dirty = False
-        self.rows_dev, hashes = _scan_rounds(
+        self.rows_dev, hashes = metrics.dispatch_jit(
+            "scan_rounds", _scan_rounds,
             self.rows_dev, self._to_dev(stacked), self.dims(), interpret)
         self._hash_handle = hashes[-1]
         return np.asarray(hashes)[:, :len(self.doc_ids)]
@@ -1153,6 +1154,10 @@ class ResidentRowsDocSet(ResidentDocSet):
     # round-frame ingress: the streaming sync service's hot path
 
     def apply_round_frames(self, frames, interpret: bool | None = None):
+        with metrics.trace("rows_round_apply"):
+            return self._apply_round_frames(frames, interpret)
+
+    def _apply_round_frames(self, frames, interpret: bool | None = None):
         """Apply a micro-batch of sync rounds shipped as ROUND FRAMES
         (sync/frames.py AMR1: one columnar frame per round covering every
         document touched that round) in ONE asynchronous device dispatch.
@@ -1715,7 +1720,8 @@ class ResidentRowsDocSet(ResidentDocSet):
         if pre_rows is not None:
             self.rows_dev = self._to_dev(pre_rows)
             self._dirty = False
-        self.rows_dev, h = _apply_final(
+        self.rows_dev, h = metrics.dispatch_jit(
+            "apply_final", _apply_final,
             self.rows_dev, self._to_dev(padded), self.dims(), interpret)
         self._hash_handle = h  # polling hashes() between deltas is free
         return h
@@ -1731,15 +1737,16 @@ class ResidentRowsDocSet(ResidentDocSet):
         # surfaces HERE, at the readback barrier, not at dispatch time. The
         # same recovery applies — the host mirror is authoritative, so drop
         # the buffer, mark dirty, and let the next call re-upload + retry.
-        with self._dispatch_guard():
+        with metrics.trace("rows_hashes"), self._dispatch_guard():
             if self.rows_dev is None or self._dirty:
                 self.rows_dev = self._to_dev(self.rows_host)
                 self._dirty = False
                 self._hash_handle = None
             h = getattr(self, "_hash_handle", None)
             if h is None:
-                h = reconcile_rows_hash(self.rows_dev, self.dims(),
-                                        interpret)
+                h = metrics.dispatch_jit(
+                    "reconcile_rows_hash", reconcile_rows_hash,
+                    self.rows_dev, self.dims(), interpret)
                 self._hash_handle = h
             return np.asarray(h)[:len(self.doc_ids)]
 

@@ -46,6 +46,9 @@ class Connection:
         self._wire = wire
         self._their_clock: dict[str, dict[str, int]] = {}
         self._our_clock: dict[str, dict[str, int]] = {}
+        # last metrics snapshot the peer answered with (request_metrics)
+        self.peer_metrics: dict | None = None
+        self.on_peer_metrics: Callable[[dict], None] | None = None
         # engine-backed DocSets track each peer's advertised clock as the
         # compaction floor (engine/compaction.py); this object is the
         # registry key, released again in close()
@@ -78,7 +81,10 @@ class Connection:
         if changes is not None:
             if self._wire == "columnar":
                 from .frames import encode_frame
+                from ..utils import metrics
                 msg["frame"] = encode_frame(changes)
+                metrics.bump("sync_frames_sent")
+                metrics.bump("sync_frame_bytes_sent", len(msg["frame"]))
             else:
                 msg["changes"] = [c.to_dict() for c in changes]
         self._send_msg(msg)
@@ -116,9 +122,36 @@ class Connection:
             raise ValueError("Cannot pass an old state object to a connection")
         self.maybe_send_changes(doc_id)
 
+    # -- metrics pull (METRICS message type; no reference counterpart) ------
+
+    def request_metrics(self) -> None:
+        """Ask the peer for its metrics.snapshot(). The answer lands in
+        self.peer_metrics (and on_peer_metrics fires, if set). Carried as a
+        `{"metrics": ...}` message — JSON, so it crosses the TCP transport
+        and any reference-framing relay unchanged; doc-sync peers that
+        predate the message type simply never send it."""
+        self._send_msg({"metrics": "pull"})
+
+    def _handle_metrics_msg(self, msg: dict) -> bool:
+        kind = msg.get("metrics")
+        if kind is None:
+            return False
+        from ..utils import metrics
+        if kind == "pull":
+            metrics.bump("sync_metrics_pulls")
+            self._send_msg({"metrics": "snapshot",
+                            "snapshot": metrics.snapshot()})
+        elif kind == "snapshot":
+            self.peer_metrics = msg.get("snapshot") or {}
+            if self.on_peer_metrics is not None:
+                self.on_peer_metrics(self.peer_metrics)
+        return True
+
     # -- receiving (connection.js:96-113) -----------------------------------
 
     def receive_msg(self, msg: dict):
+        if self._handle_metrics_msg(msg):
+            return None
         doc_id = msg["docId"]
         if msg.get("clock") is not None:
             self._their_clock = self._clock_union(self._their_clock, doc_id,
@@ -128,7 +161,8 @@ class Connection:
         if msg.get("frame") is not None:
             from .frames import decode_frame
             from ..utils import metrics
-            metrics.bump("wire_frames_received")
+            metrics.bump("sync_frames_received")
+            metrics.bump("sync_frame_bytes_received", len(msg["frame"]))
             cols = decode_frame(msg["frame"])
             # DocSets exposing a column ingress get the decoded columns
             # as-is (the engine service's native-encoder seam); plain

@@ -69,11 +69,11 @@ class LogArchive:
                 nl = f.read(step).rfind(b"\n")
                 if nl >= 0:
                     f.truncate(pos - step + nl + 1)
-                    metrics.bump("log_archive_torn_tail_repaired")
+                    metrics.bump("sync_archive_tail_repaired")
                     return
                 pos -= step
             f.truncate(0)               # single torn line, no newline at all
-            metrics.bump("log_archive_torn_tail_repaired")
+            metrics.bump("sync_archive_tail_repaired")
 
     def append(self, doc_id: str, changes) -> int:
         """Append materialized changes for one doc; returns count written.
@@ -96,7 +96,7 @@ class LogArchive:
                 f.write("\n".join(lines) + "\n")
                 f.flush()
                 os.fsync(f.fileno())
-        metrics.bump("log_archived_changes", len(changes))
+        metrics.bump("sync_changes_archived", len(changes))
         return len(changes)
 
     def read(self, doc_id: str) -> list[Change]:
@@ -128,7 +128,7 @@ class LogArchive:
                         # complete append always ends with a newline)
                         if any(l.strip() for l in f):
                             raise
-                        metrics.bump("log_archive_torn_tail_skipped")
+                        metrics.bump("sync_archive_tail_skipped")
                         break
                     if rec.pop("_doc", doc_id) != doc_id:
                         continue  # sha1-prefix collision guard

@@ -24,6 +24,7 @@ whose `._doc.opset` exposes `clock` / `get_missing_changes`),
 from __future__ import annotations
 
 import threading
+import time as _time
 from typing import Callable
 
 from ..core.change import Change
@@ -131,6 +132,9 @@ class EngineDocSet:
                 "log_archive_dir (the truncated prefix must go somewhere)")
         self.log_horizon_changes = log_horizon_changes
         self._pending: dict[str, list] = {}   # rows backend: coalesced round
+        # metrics label for this node's spans/counters; ShardedEngineDocSet
+        # sets it to the shard index so per-shard series stay separable
+        self._shard: str | None = None
         self._batch_depth = 0
         self._admit_notify: list[str] = []    # docs awaiting handler gossip
         # per doc: actor -> changes ordered by seq (admission guarantees
@@ -377,7 +381,35 @@ class EngineDocSet:
         self._drain_admitted()
         return handle
 
+    def _metric_labels(self) -> dict:
+        return {"shard": self._shard} if self._shard is not None else {}
+
     def _flush_locked(self) -> None:
+        """Apply every pending per-doc column batch as ONE round frame:
+        the traced sync-round span plus per-round throughput accounting
+        around _flush_pending_locked (which does the work)."""
+        if not self._pending:
+            return
+        labels = self._metric_labels()
+        n_ops = sum(len(c.op_action) for parts in self._pending.values()
+                    for c in parts)
+        t0 = _time.perf_counter()
+        with metrics.trace("sync_round_flush", **labels):
+            self._flush_pending_locked()
+        # failure paths raise out of the span (its timing still records).
+        # The swallowed mid-admission rebuild path restores the round to
+        # self._pending for retry — subtract those ops so throughput
+        # counters only see rounds whose changes reached truth (the retry
+        # flush counts them when they actually admit).
+        metrics.observe("sync_round_seconds", _time.perf_counter() - t0)
+        restored = sum(len(c.op_action) for parts in self._pending.values()
+                       for c in parts)
+        if restored < n_ops:
+            metrics.bump("sync_rounds_flushed", **labels)
+            metrics.bump("sync_ops_ingested", int(n_ops - restored),
+                         **labels)
+
+    def _flush_pending_locked(self) -> None:
         """Apply every pending per-doc column batch as ONE round frame
         through the streaming engine's batched admission; queue handler
         notifications for the docs that admitted changes."""
@@ -662,7 +694,7 @@ class EngineDocSet:
                         # read of the archived prefix — the reference
                         # {docId, clock, changes} protocol is unchanged,
                         # the serving side just pays a file read
-                        metrics.bump("log_archive_cold_reads")
+                        metrics.bump("sync_archive_cold_reads")
                         hz = rset.log_horizon[i]
                         # clip to the CURRENT horizon: after a rebuild
                         # restored the full log to RAM, a later partial
@@ -693,7 +725,8 @@ class EngineDocSet:
         """Converged per-doc state hashes (cached between deltas — polling
         this does not re-dispatch the reconcile kernel)."""
         try:
-            with self._lock:
+            with metrics.trace("sync_hashes", **self._metric_labels()), \
+                    self._lock:
                 self._maybe_flush_locked()
                 h = self._resident.hashes()
                 out = {d: int(h[i])

@@ -20,10 +20,19 @@ register_handler/unregister_handler), plus the engine reads
 from __future__ import annotations
 
 import contextlib
+import os
 import zlib
 from typing import Callable
 
+from ..utils import metrics
 from .service import EngineDocSet
+
+# Stall-watchdog budget for the hash fan-out (the r5 config-8 hang site:
+# `sharded_service.hashes → service.hashes → resident_rows.hashes` sat on a
+# readback barrier past a 3-minute timeout with no diagnosis). When a hash
+# read overruns this many seconds, one WARNING line with every thread's
+# active span stack is logged; 0 disables. Overridable per deployment.
+STALL_WATCHDOG_S = float(os.environ.get("AMTPU_STALL_WATCHDOG_S", "120"))
 
 
 class ShardedEngineDocSet:
@@ -50,6 +59,8 @@ class ShardedEngineDocSet:
                                           else f"{log_archive_dir}/shard{k}"),
                          log_horizon_changes=log_horizon_changes)
             for k in range(n_shards)]
+        for k, s in enumerate(self.shards):
+            s._shard = str(k)   # per-shard metric series (sync_round_flush…)
         for d in doc_ids or []:
             self.add_doc(d)
 
@@ -133,8 +144,9 @@ class ShardedEngineDocSet:
 
     def hashes(self) -> dict[str, int]:
         out: dict[str, int] = {}
-        for s in self.shards:
-            out.update(s.hashes())
+        with metrics.watchdog("sync_hashes_fanout", STALL_WATCHDOG_S):
+            for s in self.shards:
+                out.update(s.hashes())
         return out
 
     def materialize(self, doc_id: str):

@@ -83,11 +83,15 @@ def decode_msg(payload: bytes) -> dict:
 
 
 def send_frame(sock: socket.socket, msg: dict) -> None:
+    from ..utils import metrics
     payload = encode_msg(msg)
     sock.sendall(_HEADER.pack(len(payload)) + payload)
+    metrics.bump("sync_msgs_sent")
+    metrics.bump("sync_wire_bytes_sent", _HEADER.size + len(payload))
 
 
 def recv_frame(sock: socket.socket) -> dict | None:
+    from ..utils import metrics
     header = _recv_exact(sock, _HEADER.size)
     if header is None:
         return None
@@ -97,6 +101,8 @@ def recv_frame(sock: socket.socket) -> dict | None:
     payload = _recv_exact(sock, length)
     if payload is None:
         return None
+    metrics.bump("sync_msgs_received")
+    metrics.bump("sync_wire_bytes_received", _HEADER.size + length)
     return decode_msg(payload)
 
 

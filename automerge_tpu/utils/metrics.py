@@ -1,76 +1,610 @@
-"""Observability: counters, timers, and profiler hooks.
+"""Observability: structured span tracing, labeled metrics, stall watchdog.
 
 The reference has no instrumentation at all (SURVEY.md §5 — no logging, no
-timers anywhere in src/). The rebuild adds the counters the reference's
-maintainers could only infer from the data model, plus a trace hook that
-annotates device work for jax.profiler / xprof.
+timers anywhere in src/). The rebuild's first pass was a bare counter/timer
+singleton; this module grows it into the subsystem the production posture
+needs (ROADMAP north star; the r5 config-8 timeout died inside
+`sharded_service.hashes` with nothing but a thread dump to explain it):
+
+- a structured **span tracer**: nested spans per thread, a ring buffer of
+  recently completed spans, wall-clock timing plus a device-side
+  `jax.profiler.TraceAnnotation` (device time shows up in xprof captures
+  when a profiler trace is active), all thread-safe;
+- **labeled counters / gauges / histograms**
+  (`bump("engine_kernels_dispatched", kernel="apply_doc")`) with
+  bounded-cardinality label values;
+- a **stall watchdog** (`watchdog(name, budget_s)`): a background timer that
+  logs a one-line diagnosis with every thread's active span stack when a
+  traced region overruns its budget — the region keeps running, the
+  operator gets the "where is it stuck" line the r5 hang never produced;
+- **exporters**: `snapshot()` (flat, `json.dumps`-safe; bench.py embeds it
+  in BENCH_*.json) and `prometheus()` (text exposition).
+
+Metric naming scheme (docs/OBSERVABILITY.md)
+--------------------------------------------
+Canonical names are `<layer>_<noun>_<verb>`, where layer is one of:
+
+- `core`   — interpretive/bulk host apply (core/opset.py, core/bulkload.py)
+- `engine` — docs-major device engine + adaptive router (engine/)
+- `rows`   — docs-minor streaming engine (engine/resident_rows.py)
+- `sync`   — sync services, wire protocol, transports, log archive (sync/)
+- `obs`    — this subsystem's own signals (watchdog / budget overruns)
+
+Counters may end in a plural verb (`sync_frames_received`); span names are
+`<layer>_<region>` and export as `<name>_s` (seconds) + `<name>_count`.
+Every name used by the package is declared in the registries below — a
+collection-time lint (tests/test_metrics_lint.py) rejects unregistered
+literals. Pre-rename names remain readable as snapshot ALIASES for one
+release; new call sites must use canonical names.
 
 Usage:
     from automerge_tpu import metrics
-    metrics.snapshot()   # {"changes_applied": ..., "ops_applied": ...}
-    metrics.reset()
-
-    with metrics.trace("reconcile"):   # host timer + device annotation
+    metrics.bump("sync_frames_received")
+    with metrics.trace("rows_round_apply"):
         ...
+    with metrics.watchdog("sync_hashes_fanout", budget_s=120.0):
+        h = svc.hashes()
+    metrics.snapshot()      # flat JSON-able dict (canonical + alias keys)
+    metrics.prometheus()    # text exposition
 """
 
 from __future__ import annotations
 
+import logging
+import re
+import threading
 import time
-from collections import defaultdict
+from collections import deque
 from contextlib import contextmanager
+
+log = logging.getLogger("automerge_tpu.metrics")
+
+# How many completed spans the ring buffer retains. Small enough to never
+# matter for memory, large enough to cover a whole sync round's nesting on
+# a sharded fleet node.
+SPAN_RING = 512
+
+# ---------------------------------------------------------------------------
+# metric name registries (the naming contract; see module docstring)
+
+COUNTERS: dict[str, str] = {
+    # core — host interpretive / bulk apply
+    "core_changes_applied": "changes admitted by the host apply paths",
+    "core_ops_applied": "ops inside admitted changes (host apply paths)",
+    "core_diffs_emitted": "diff records produced by the interpretive apply",
+    "core_bulk_fallbacks": "bulk builds that fell back to interpretive",
+    # engine — docs-major device engine + adaptive router
+    "engine_docs_reconciled": "documents reconciled by the batched kernel",
+    "engine_ops_reconciled": "ops reconciled by the batched kernel",
+    "engine_bulk_built": "host-path documents built by the bulk loader",
+    "engine_kernels_dispatched": "jitted kernel dispatches {kernel=...}",
+    "engine_kernels_retraced":
+        "jit compile-cache misses (retrace/compile) {kernel=...}",
+    # rows — docs-minor streaming engine
+    "rows_rounds_batched": "round frames through the vectorized admission",
+    "rows_rounds_fallback": "round frames through the per-round fallback",
+    "rows_dispatch_failed": "device dispatches that failed (host recovered)",
+    "rows_log_rebuilt": "engine rebuilds replayed from the admitted log",
+    "rows_engine_poisoned": "engines poisoned by an unrecoverable failure",
+    "rows_horizon_truncated": "log prefixes truncated below the horizon",
+    "rows_docs_compacted": "documents compacted in place",
+    # sync — services, wire protocol, transports, log archive
+    "sync_frames_sent": "columnar change frames sent",
+    "sync_frames_received": "columnar change frames received",
+    "sync_frame_bytes_sent": "payload bytes of columnar frames sent",
+    "sync_frame_bytes_received": "payload bytes of columnar frames received",
+    "sync_msgs_sent": "protocol messages written to a TCP transport",
+    "sync_msgs_received": "protocol messages read from a TCP transport",
+    "sync_wire_bytes_sent": "framed bytes written to a TCP transport",
+    "sync_wire_bytes_received": "framed bytes read from a TCP transport",
+    "sync_ops_ingested": "ops admitted through service round flushes",
+    "sync_rounds_flushed": "coalesced service round flushes",
+    "sync_archive_cold_reads": "lagging-peer reads served from the archive",
+    "sync_changes_archived": "changes moved into the log archive",
+    "sync_archive_tail_repaired": "torn archive tails repaired on open",
+    "sync_archive_tail_skipped": "torn archive tails skipped on read",
+    "sync_metrics_pulls": "remote metrics snapshots served to peers",
+    # obs — the observability subsystem's own signals
+    "obs_watchdog_fired": "watchdog budget overruns {name=...}",
+    "obs_budget_exceeded": "trace(budget_s=...) post-hoc overruns {name=...}",
+}
+
+GAUGES: dict[str, str] = {
+    "core_queue_depth": "causal queue depth after the latest apply batch",
+}
+
+HISTOGRAMS: dict[str, str] = {
+    "sync_round_seconds": "latency of coalesced service round flushes",
+}
+
+SPANS: dict[str, str] = {
+    "engine_reconcile": "from-scratch batched encode + reconcile kernel",
+    "engine_dispatch": "adaptive-routed batch apply {backend=host|device}",
+    "engine_resident_apply": "docs-major resident delta scatter + apply",
+    "engine_hashes": "docs-major reconcile / hash read",
+    "rows_round_apply": "rows-engine round-frame admission + dispatch",
+    "rows_hashes": "rows-engine hash read (the readback barrier)",
+    "sync_round_flush": "service coalesced-round flush {shard=...}",
+    "sync_hashes": "service hash read, incl. read-triggered flush",
+    "sync_hashes_fanout": "sharded service hash fan-out over all shards",
+}
+
+# Pre-rename names, readable for one release: bump()/trace() on an alias
+# records under the canonical name; snapshot() emits both keys.
+ALIASES: dict[str, str] = {
+    "changes_applied": "core_changes_applied",
+    "ops_applied": "core_ops_applied",
+    "diffs_emitted": "core_diffs_emitted",
+    "bulkload_fallback_keyerror": "core_bulk_fallbacks",
+    "host_bulk_built": "engine_bulk_built",
+    "rows_compacted": "rows_docs_compacted",
+    "rows_rebuilt_from_log": "rows_log_rebuilt",
+    "rows_poisoned": "rows_engine_poisoned",
+    "log_horizon_truncations": "rows_horizon_truncated",
+    "wire_frames_received": "sync_frames_received",
+    "log_archive_cold_reads": "sync_archive_cold_reads",
+    "log_archived_changes": "sync_changes_archived",
+    "log_archive_torn_tail_repaired": "sync_archive_tail_repaired",
+    "log_archive_torn_tail_skipped": "sync_archive_tail_skipped",
+}
+
+REGISTRY: dict[str, str] = {**COUNTERS, **GAUGES, **HISTOGRAMS, **SPANS}
+
+
+def register(name: str, description: str, kind: str = "counter") -> None:
+    """Register an extension metric name (plugins, tests, deployments).
+    The collection-time lint accepts any registered name."""
+    REGISTRY[name] = description
+    {"counter": COUNTERS, "gauge": GAUGES, "histogram": HISTOGRAMS,
+     "span": SPANS}[kind][name] = description
+
+
+def _resolve(name: str) -> str:
+    return ALIASES.get(name, name)
+
+
+def _lk(labels: dict) -> tuple:
+    """Canonical hashable label key (sorted (k, str(v)) pairs)."""
+    if not labels:
+        return ()
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _flat_key(name: str, lk: tuple) -> str:
+    if not lk:
+        return name
+    return name + "{" + ",".join(f"{k}={v}" for k, v in lk) + "}"
+
+
+class _Span:
+    __slots__ = ("name", "lk", "t0", "wall", "depth", "parent", "thread")
+
+    def __init__(self, name, lk, depth, parent, thread):
+        self.name = name
+        self.lk = lk
+        self.t0 = time.perf_counter()
+        self.wall = time.time()
+        self.depth = depth
+        self.parent = parent
+        self.thread = thread
 
 
 class _Metrics:
+    """Thread-safe metrics store. Every public mutation takes self.lock —
+    the sync/tcp layer calls in from socket reader threads concurrently
+    with application threads."""
+
     def __init__(self):
-        self.counters: dict[str, int] = defaultdict(int)
-        self.timers: dict[str, float] = defaultdict(float)
+        self.lock = threading.RLock()
+        self.counters: dict[tuple, int] = {}
+        self.gauges: dict[tuple, float] = {}
+        self.timers: dict[tuple, float] = {}
+        self.span_counts: dict[tuple, int] = {}
+        # histogram summary: [count, sum, min, max]
+        self.hists: dict[tuple, list] = {}
+        self.spans: deque = deque(maxlen=SPAN_RING)
+        # thread ident -> stack of active _Span (the watchdog's evidence)
+        self.active: dict[int, list] = {}
+        self.watchdog_events: list[dict] = []
 
-    def bump(self, name: str, n: int = 1) -> None:
-        self.counters[name] += n
+    # -- primitives ---------------------------------------------------------
 
-    def add_time(self, name: str, seconds: float) -> None:
-        self.timers[name] += seconds
+    def bump(self, _name: str, _n: int = 1, **labels) -> None:
+        key = (_resolve(_name), _lk(labels))
+        with self.lock:
+            self.counters[key] = self.counters.get(key, 0) + _n
 
-    def snapshot(self) -> dict:
-        out = dict(self.counters)
-        out.update({f"{k}_s": round(v, 6) for k, v in self.timers.items()})
+    def gauge(self, _name: str, _value: float, **labels) -> None:
+        key = (_resolve(_name), _lk(labels))
+        with self.lock:
+            self.gauges[key] = _value
+
+    def observe(self, _name: str, _value: float, **labels) -> None:
+        key = (_resolve(_name), _lk(labels))
+        with self.lock:
+            h = self.hists.get(key)
+            if h is None:
+                self.hists[key] = [1, _value, _value, _value]
+            else:
+                h[0] += 1
+                h[1] += _value
+                h[2] = min(h[2], _value)
+                h[3] = max(h[3], _value)
+
+    def add_time(self, _name: str, _seconds: float, **labels) -> None:
+        key = (_resolve(_name), _lk(labels))
+        with self.lock:
+            self.timers[key] = self.timers.get(key, 0.0) + _seconds
+
+    # -- span stack ---------------------------------------------------------
+
+    def push_span(self, name: str, lk: tuple) -> _Span:
+        ident = threading.get_ident()
+        with self.lock:
+            stack = self.active.setdefault(ident, [])
+            span = _Span(name, lk, len(stack),
+                         stack[-1].name if stack else None,
+                         threading.current_thread().name)
+            stack.append(span)
+        return span
+
+    def pop_span(self, span: _Span, duration: float) -> None:
+        ident = threading.get_ident()
+        with self.lock:
+            stack = self.active.get(ident)
+            if stack is not None:
+                for i in range(len(stack) - 1, -1, -1):
+                    if stack[i] is span:
+                        del stack[i]
+                        break
+                if not stack:
+                    del self.active[ident]
+            self.timers[(span.name, span.lk)] = (
+                self.timers.get((span.name, span.lk), 0.0) + duration)
+            ckey = (span.name, span.lk)
+            self.span_counts[ckey] = self.span_counts.get(ckey, 0) + 1
+            self.spans.append({
+                "name": span.name,
+                "labels": dict(span.lk),
+                "start": span.wall,
+                "duration_s": round(duration, 6),
+                "depth": span.depth,
+                "parent": span.parent,
+                "thread": span.thread,
+            })
+
+    def span_stacks(self) -> dict[str, list[str]]:
+        """Active span stacks for every thread — `{"Thread-3":
+        ["sync_round_flush(12.1s)", "rows_hashes(11.8s)"]}`. This is the
+        watchdog's one-line diagnosis payload."""
+        now = time.perf_counter()
+        with self.lock:
+            out = {}
+            for stack in self.active.values():
+                if stack:
+                    out[stack[0].thread] = [
+                        f"{_flat_key(s.name, s.lk)}({now - s.t0:.2f}s)"
+                        for s in stack]
+            return out
+
+    # -- exporters ----------------------------------------------------------
+
+    def snapshot(self, aliases: bool = True) -> dict:
+        """Flat, json.dumps-safe view: counters as-is, gauges as-is,
+        timers as `<name>_s`, histograms as `<name>_{count,sum,min,max}`.
+        Labeled series flatten to `name{k=v,...}` keys. With aliases=True
+        (default) every pre-rename name whose canonical key is present is
+        also emitted, so existing consumers keep reading for one release."""
+        with self.lock:
+            out: dict = {}
+            for (name, lk), v in self.counters.items():
+                out[_flat_key(name, lk)] = v
+            for (name, lk), v in self.gauges.items():
+                out[_flat_key(name, lk)] = v
+            for (name, lk), h in self.hists.items():
+                base = _flat_key(name, lk)
+                out[base + "_count"] = h[0]
+                out[base + "_sum"] = round(h[1], 6)
+                out[base + "_min"] = round(h[2], 6)
+                out[base + "_max"] = round(h[3], 6)
+            for (name, lk), v in self.span_counts.items():
+                out[_flat_key(name, lk) + "_count"] = v
+            for (name, lk), v in self.timers.items():
+                out[_flat_key(name, lk) + "_s"] = round(v, 6)
+        if aliases:
+            for old, new in ALIASES.items():
+                for suffix in ("", "_s", "_count"):
+                    if new + suffix in out and old + suffix not in out:
+                        out[old + suffix] = out[new + suffix]
         return out
 
+    def prometheus(self, prefix: str = "amtpu_") -> str:
+        """Prometheus text exposition (0.0.4). Counters export as
+        `<prefix><name>`, span/timer totals as
+        `<prefix><name>_seconds_total`, histograms as summary-style
+        `_count`/`_sum` plus `_min`/`_max` gauges."""
+        def san(name):
+            return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+        def esc(value):
+            return (value.replace("\\", r"\\").replace('"', r'\"')
+                    .replace("\n", r"\n"))
+
+        def labelstr(lk):
+            if not lk:
+                return ""
+            return "{" + ",".join(f'{san(k)}="{esc(v)}"'
+                                  for k, v in lk) + "}"
+
+        with self.lock:
+            counters = sorted(self.counters.items())
+            gauges = sorted(self.gauges.items())
+            hists = sorted(self.hists.items())
+            span_counts = sorted(self.span_counts.items())
+            timers = sorted(self.timers.items())
+        lines: list[str] = []
+        typed: set[str] = set()
+
+        def emit(name, kind, lk, value, help_=None):
+            full = prefix + san(name)
+            if full not in typed:
+                typed.add(full)
+                desc = help_ or REGISTRY.get(name)
+                if desc:
+                    lines.append(f"# HELP {full} {desc}")
+                lines.append(f"# TYPE {full} {kind}")
+            lines.append(f"{full}{labelstr(lk)} {value}")
+
+        for (name, lk), v in counters:
+            emit(name, "counter", lk, v)
+        for (name, lk), v in gauges:
+            emit(name, "gauge", lk, v)
+        for (name, lk), h in hists:
+            emit(name + "_count", "counter", lk, h[0],
+                 help_=REGISTRY.get(name))
+            emit(name + "_sum", "counter", lk, h[1])
+            emit(name + "_min", "gauge", lk, h[2])
+            emit(name + "_max", "gauge", lk, h[3])
+        for (name, lk), v in span_counts:
+            emit(name + "_count", "counter", lk, v,
+                 help_=REGISTRY.get(name))
+        for (name, lk), v in timers:
+            emit(name + "_seconds_total", "counter", lk, v,
+                 help_=REGISTRY.get(name))
+        return "\n".join(lines) + ("\n" if lines else "")
+
     def reset(self) -> None:
-        self.counters.clear()
-        self.timers.clear()
+        with self.lock:
+            self.counters.clear()
+            self.gauges.clear()
+            self.timers.clear()
+            self.span_counts.clear()
+            self.hists.clear()
+            self.spans.clear()
+            self.watchdog_events.clear()
+            # active spans are NOT cleared: regions currently executing
+            # still finish and record into the fresh store
 
 
 _global = _Metrics()
 
+# ---------------------------------------------------------------------------
+# module-level API (the singleton surface every layer imports)
 
-def bump(name: str, n: int = 1) -> None:
-    _global.bump(name, n)
+
+def bump(_name: str, _n: int = 1, **labels) -> None:
+    _global.bump(_name, _n, **labels)
 
 
-def snapshot() -> dict:
-    return _global.snapshot()
+def gauge(_name: str, _value: float, **labels) -> None:
+    _global.gauge(_name, _value, **labels)
+
+
+def observe(_name: str, _value: float, **labels) -> None:
+    _global.observe(_name, _value, **labels)
+
+
+def add_time(_name: str, _seconds: float, **labels) -> None:
+    _global.add_time(_name, _seconds, **labels)
+
+
+def snapshot(aliases: bool = True) -> dict:
+    return _global.snapshot(aliases=aliases)
+
+
+def prometheus(prefix: str = "amtpu_") -> str:
+    return _global.prometheus(prefix=prefix)
 
 
 def reset() -> None:
     _global.reset()
 
 
-@contextmanager
-def trace(name: str):
-    """Host wall-clock accounting plus a device trace annotation (visible in
-    xprof captures when a jax.profiler trace is active)."""
+def recent_spans() -> list[dict]:
+    """Completed spans from the ring buffer, oldest first."""
+    with _global.lock:
+        return list(_global.spans)
+
+
+def span_stacks() -> dict[str, list[str]]:
+    return _global.span_stacks()
+
+
+def watchdog_events() -> list[dict]:
+    """Diagnoses recorded by fired watchdogs since the last reset()."""
+    with _global.lock:
+        return list(_global.watchdog_events)
+
+
+_annotation_cls = None
+
+
+def _device_annotation(name: str):
+    """jax.profiler.TraceAnnotation(name) when the profiler is importable
+    (device time then shows under `name` in xprof captures); None otherwise.
+    The class lookup is cached — trace() sits on hot paths."""
+    global _annotation_cls
+    if _annotation_cls is None:
+        try:
+            import jax.profiler
+            _annotation_cls = jax.profiler.TraceAnnotation
+        except Exception:  # profiler unavailable on some backends
+            _annotation_cls = False
+    if _annotation_cls is False:
+        return None
     try:
-        import jax.profiler
-        annotation = jax.profiler.TraceAnnotation(name)
-    except Exception:  # profiler unavailable on some backends
-        annotation = None
+        return _annotation_cls(name)
+    except Exception:
+        return None
+
+
+@contextmanager
+def trace(name: str, budget_s: float | None = None, **labels):
+    """Structured span: nests per thread, records wall seconds + a count
+    even when the body raises, annotates device work for jax.profiler, and
+    lands in the recent-span ring buffer. With budget_s, an overrun is
+    flagged post-hoc (`obs_budget_exceeded{name=...}` + one warning line);
+    for live stall detection of a possibly-hung region use watchdog()."""
+    name = _resolve(name)
+    lk = _lk(labels)
+    annotation = _device_annotation(_flat_key(name, lk))
+    span = _global.push_span(name, lk)
     t0 = time.perf_counter()
-    if annotation is not None:
-        with annotation:
+    try:
+        if annotation is not None:
+            with annotation:
+                yield span
+        else:
+            yield span
+    finally:
+        duration = time.perf_counter() - t0
+        _global.pop_span(span, duration)
+        if budget_s is not None and duration > budget_s:
+            bump("obs_budget_exceeded", name=name)
+            log.warning(
+                "span %r exceeded budget: %.3fs > %.3fs (labels %s)",
+                name, duration, budget_s, dict(lk))
+
+
+class _WatchdogMonitor:
+    """One shared background checker for every active watchdog. A
+    threading.Timer per watched region would spawn a thread per hashes()
+    poll; this parks a single daemon thread on a condition variable and
+    wakes it only at the earliest pending deadline."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._entries: dict[int, tuple[float, object]] = {}
+        self._thread: threading.Thread | None = None
+        self._seq = 0
+
+    def add(self, deadline: float, fire) -> int:
+        with self._cv:
+            self._seq += 1
+            key = self._seq
+            self._entries[key] = (deadline, fire)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name="amtpu-watchdog", daemon=True)
+                self._thread.start()
+            self._cv.notify()
+        return key
+
+    def remove(self, key: int) -> None:
+        with self._cv:
+            self._entries.pop(key, None)
+            self._cv.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                now = time.perf_counter()
+                due = [(k, f) for k, (d, f) in self._entries.items()
+                       if d <= now]
+                for k, _ in due:
+                    del self._entries[k]
+                if not due:
+                    if self._entries:
+                        nxt = min(d for d, _ in self._entries.values())
+                        self._cv.wait(timeout=max(nxt - now, 0.001))
+                    else:
+                        self._cv.wait()   # parked until the next add()
+                    continue
+            for _, fire in due:   # outside the cv: fire() takes other locks
+                try:
+                    fire()
+                except Exception:
+                    log.exception("watchdog fire failed")
+
+
+_monitor = _WatchdogMonitor()
+
+
+@contextmanager
+def watchdog(name: str, budget_s: float, logger=None):
+    """Stall watchdog around a traced region: the shared background checker
+    fires once at budget_s if the block has not exited, logging a one-line
+    diagnosis with every thread's active span stack (the "where is it
+    stuck" line the r5 config-8 hang never produced) and bumping
+    obs_watchdog_fired{name=...}. The watched block itself runs inside
+    trace(name), so the diagnosis always names at least the watched region.
+    The region is never interrupted. budget_s <= 0 disables."""
+    if budget_s is None or budget_s <= 0:
+        with trace(name):
             yield
-    else:
-        yield
-    _global.add_time(name, time.perf_counter() - t0)
-    _global.bump(f"{name}_count")
+        return
+    lg = logger or log
+    t_start = time.perf_counter()
+
+    def _fire():
+        stacks = _global.span_stacks()
+        desc = "; ".join(f"{t}: {' > '.join(s)}"
+                         for t, s in sorted(stacks.items())) \
+            or "no active spans"
+        lg.warning(
+            "watchdog %r: traced region still running after %.2fs "
+            "(budget %.2fs); active spans: %s",
+            name, time.perf_counter() - t_start, budget_s, desc)
+        bump("obs_watchdog_fired", name=name)
+        with _global.lock:
+            _global.watchdog_events.append({
+                "name": name, "budget_s": budget_s,
+                "elapsed_s": round(time.perf_counter() - t_start, 3),
+                "spans": stacks, "at": time.time()})
+
+    key = _monitor.add(t_start + budget_s, _fire)
+    try:
+        with trace(name):
+            yield
+    finally:
+        _monitor.remove(key)
+
+
+# ---------------------------------------------------------------------------
+# jit dispatch accounting
+
+
+def _cache_size(fn):
+    m = getattr(fn, "_cache_size", None)
+    if not callable(m):
+        return None
+    try:
+        return m()
+    except Exception:
+        return None
+
+
+def dispatch_jit(kernel: str, fn, *args, **kwargs):
+    """Call a jitted function, counting the dispatch under
+    `engine_kernels_dispatched{kernel=...}` and — via the jit compile-cache
+    size delta — any retrace/compile-cache miss under
+    `engine_kernels_retraced{kernel=...}`. A retrace storm on a hot kernel
+    is the classic silent TPU perf cliff; this makes it a counter."""
+    before = _cache_size(fn)
+    try:
+        return fn(*args, **kwargs)
+    finally:
+        bump("engine_kernels_dispatched", kernel=kernel)
+        after = _cache_size(fn)
+        if before is not None and after is not None and after > before:
+            bump("engine_kernels_retraced", kernel=kernel)

@@ -1335,6 +1335,7 @@ def _final_record(results_by_cfg: dict, backend: str | None, attempts: list):
             "device_speedup": r.get("device_speedup"),
             "engine_ops_per_s": r.get("engine_ops_per_s"),
             "backend": r.get("backend"),
+            "metrics": r.get("metrics"),
             **({"batched_speedup": r["batched"]["speedup"],
                 "batched_device_speedup": r["batched"]["device_speedup"],
                 "batched_docs": r["batched"]["docs"]}
@@ -1384,6 +1385,31 @@ def _final_record(results_by_cfg: dict, backend: str | None, attempts: list):
     return rec
 
 
+def _metrics_rollup(rec: dict) -> dict:
+    """Aggregate the per-config observability snapshots into the handful of
+    per-layer span totals the one-line record can afford (full per-config
+    snapshots stay in the BENCH_DETAIL.json sidecar). Labeled series
+    (`name{kernel=...}` / `{shard=...}`) collapse into their base name."""
+    import re as _re
+
+    tot: dict = {}
+    for v in rec.get("configs", {}).values():
+        for k, val in ((v or {}).get("metrics") or {}).items():
+            if isinstance(val, (int, float)):
+                base = _re.sub(r"\{[^}]*\}", "", k)
+                tot[base] = tot.get(base, 0) + val
+    keys = ("engine_reconcile_s", "engine_reconcile_count",
+            "engine_dispatch_s", "engine_resident_apply_s",
+            "engine_hashes_s", "engine_kernels_dispatched",
+            "engine_kernels_retraced", "rows_round_apply_s",
+            "rows_round_apply_count", "rows_hashes_s",
+            "sync_round_flush_s", "sync_rounds_flushed",
+            "sync_ops_ingested", "sync_hashes_s",
+            "obs_watchdog_fired", "obs_budget_exceeded")
+    return {k: (round(tot[k], 3) if isinstance(tot[k], float) else tot[k])
+            for k in keys if k in tot}
+
+
 def _compact_record(rec: dict) -> dict:
     """The one-line contract record (driver-parsed): headline fields only,
     kept well under the driver's tail-capture window (VERDICT r3 weak #6).
@@ -1414,6 +1440,9 @@ def _compact_record(rec: dict) -> dict:
         out["errors"] = len(rec["errors"])
     if any(v.get("dense_disabled") for v in rec.get("configs", {}).values()):
         out["dense_disabled"] = True
+    rollup = _metrics_rollup(rec)
+    if rollup:
+        out["metrics"] = rollup
     out["detail"] = "BENCH_DETAIL.json"
     return out
 
@@ -1457,12 +1486,15 @@ def worker_main(args):
     _load_package()
 
     rc = 0
+    from automerge_tpu.utils import metrics as _metrics
     configs = [args.config] if args.config else list(CONFIGS)
     for cfg in configs:
         if cfg in args.skip:
             continue
         try:
+            _metrics.reset()   # per-config observability snapshot
             r = run_config(cfg, n_docs=args.docs)
+            r["metrics"] = _metrics.snapshot(aliases=False)
             r["backend"] = backend
             from automerge_tpu.engine import kernels as _k
             if _k.DISABLE_DENSE:

@@ -1,0 +1,63 @@
+"""Metric-name lint: every literal metric name used by the package must be
+declared in the utils.metrics registries (REGISTRY or ALIASES). An
+unregistered name is a typo or a naming-scheme violation — either way it
+produces a series nobody can find in docs/OBSERVABILITY.md, which is how
+instrumentation rots. Runs as an ordinary tier-1 test (cheap: one regex
+pass over the source tree, no jax work)."""
+
+import pathlib
+import re
+
+from automerge_tpu.utils import metrics
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+# metrics.bump("name"...), metrics.trace("name"...), metrics.gauge(...),
+# metrics.observe(...), metrics.watchdog(...), metrics.dispatch_jit("kernel"
+# is a label, not a metric name, so it is not matched here.
+_CALL = re.compile(
+    r"metrics\.(?:bump|trace|gauge|observe|watchdog)\(\s*\n?\s*"
+    r"[\"']([A-Za-z0-9_]+)[\"']")
+
+_SOURCES = [ROOT / "bench.py", *sorted(
+    (ROOT / "automerge_tpu").rglob("*.py"))]
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_LAYERS = ("core_", "engine_", "rows_", "sync_", "obs_")
+
+
+def _used_names():
+    out = []
+    for path in _SOURCES:
+        for m in _CALL.finditer(path.read_text()):
+            out.append((path.relative_to(ROOT), m.group(1)))
+    return out
+
+
+def test_package_metric_names_are_registered():
+    used = _used_names()
+    assert used, "lint regex matched nothing — did the call syntax change?"
+    known = set(metrics.REGISTRY) | set(metrics.ALIASES)
+    unknown = [(str(p), n) for p, n in used if n not in known]
+    assert not unknown, (
+        f"unregistered metric names {unknown}: declare them in "
+        "automerge_tpu/utils/metrics.py (COUNTERS/GAUGES/HISTOGRAMS/SPANS) "
+        "per the <layer>_<noun>_<verb> scheme in docs/OBSERVABILITY.md")
+
+
+def test_package_call_sites_use_canonical_names():
+    """New call sites must use canonical names — aliases exist only so old
+    snapshot consumers keep reading for one release."""
+    stale = [(str(p), n) for p, n in _used_names() if n in metrics.ALIASES]
+    assert not stale, f"call sites still on pre-rename alias names: {stale}"
+
+
+def test_registry_names_follow_scheme():
+    for name in metrics.REGISTRY:
+        assert _NAME_RE.match(name), f"invalid metric name {name!r}"
+        assert name.startswith(_LAYERS), (
+            f"{name!r} lacks a layer prefix {_LAYERS} "
+            "(<layer>_<noun>_<verb>, docs/OBSERVABILITY.md)")
+    # aliases point at registered canonical names
+    for old, new in metrics.ALIASES.items():
+        assert new in metrics.REGISTRY, (old, new)

@@ -1,0 +1,93 @@
+"""Tier-1-safe observability smoke: one bench-shaped fleet round under the
+CPU backend must leave the metrics snapshot populated with the per-layer
+spans the ISSUE acceptance names (dispatch, resident apply, sync round) —
+the regression this guards is an instrumentation point silently falling off
+a hot path during a refactor (the r5 config-8 hang was undiagnosable for
+exactly that reason: nothing was measuring the layers it crossed)."""
+
+import json
+
+import automerge_tpu as am
+from automerge_tpu import metrics
+from automerge_tpu.core.change import Change, Op
+from automerge_tpu.core.ids import ROOT_ID
+from automerge_tpu.native.wire import changes_to_columns
+from automerge_tpu.sync.sharded_service import ShardedEngineDocSet
+
+
+def _fleet_round(n_docs=24, n_shards=2):
+    """The config-8 shape in miniature: columnar-wire bulk load + one
+    steady-state round through a sharded rows-backend service."""
+    svc = ShardedEngineDocSet(n_shards=n_shards)
+    ids = [f"d{i}" for i in range(n_docs)]
+    with svc.batch():
+        for i, did in enumerate(ids):
+            svc.apply_columns(did, changes_to_columns([Change(
+                actor=f"W{i}", seq=1, deps={},
+                ops=[Op("set", ROOT_ID, key=f"f{j}", value=i * 7 + j)
+                     for j in range(4)])]))
+    with svc.batch():
+        for i, did in enumerate(ids):
+            svc.apply_columns(did, changes_to_columns([Change(
+                actor=f"W{i}", seq=2, deps={},
+                ops=[Op("set", ROOT_ID, key="f0", value=100 + i)])]))
+    return svc, svc.hashes()
+
+
+def test_fleet_round_populates_expected_span_keys():
+    metrics.reset()
+    svc, h = _fleet_round()
+    assert len(h) == 24
+    snap = metrics.snapshot()
+    # sync layer: per-shard round flushes + the watchdogged hash fan-out
+    for shard in ("0", "1"):
+        assert snap.get("sync_round_flush{shard=%s}_count" % shard, 0) >= 1
+        assert "sync_round_flush{shard=%s}_s" % shard in snap
+        assert "sync_hashes{shard=%s}_s" % shard in snap
+    assert snap["sync_hashes_fanout_count"] == 1
+    assert snap["sync_rounds_flushed{shard=0}"] \
+        + snap["sync_rounds_flushed{shard=1}"] >= 2
+    assert snap["sync_ops_ingested{shard=0}"] \
+        + snap["sync_ops_ingested{shard=1}"] == 24 * 4 + 24
+    assert snap["sync_round_seconds_count"] >= 2
+    # rows layer: round-frame apply span + the hash readback barrier
+    assert snap["rows_round_apply_count"] >= 2
+    assert "rows_round_apply_s" in snap
+    assert snap["rows_hashes_count"] >= 1
+    # engine layer: every device/interpret dispatch is a labeled counter
+    dispatches = sum(v for k, v in snap.items()
+                     if k.startswith("engine_kernels_dispatched{"))
+    assert dispatches >= 1
+    # the whole snapshot is one json.dumps away from a BENCH record
+    assert json.loads(json.dumps(snap)) == snap
+
+
+def test_docset_merge_and_sync_round_report_per_layer_spans():
+    """ISSUE acceptance: snapshot() after a DocSet merge + one sync round
+    reports per-layer spans (dispatch, resident apply, sync round) with
+    counts and seconds."""
+    from automerge_tpu.engine.dispatch import apply_batch_adaptive
+    from automerge_tpu.sync.service import EngineDocSet
+
+    metrics.reset()
+    # DocSet merge through the adaptive router (host backend at this size)
+    docs = []
+    for i in range(4):
+        s = am.change(am.init(f"A{i}"), lambda d, i=i: d.__setitem__("x", i))
+        docs.append(s._doc.opset.get_missing_changes({}))
+    plan, _ = apply_batch_adaptive(docs)
+    # one sync round into a resident-engine service node
+    svc = EngineDocSet(backend="resident", live_views=False)
+    s = am.change(am.init("W"), lambda d: d.__setitem__("k", 1))
+    svc.apply_changes("doc", s._doc.opset.get_missing_changes({}))
+    _ = svc.hashes()
+
+    snap = metrics.snapshot()
+    key = "engine_dispatch{backend=%s}" % plan.backend
+    assert snap[key + "_count"] == 1 and snap[key + "_s"] > 0
+    assert snap["engine_hashes_count"] >= 1 and snap["engine_hashes_s"] > 0
+    assert snap["sync_hashes_count"] == 1 and snap["sync_hashes_s"] > 0
+    # and both exporters carry the same series
+    text = metrics.prometheus()
+    assert "amtpu_engine_dispatch_count" in text
+    assert "amtpu_sync_hashes_seconds_total" in text
