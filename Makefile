@@ -1,0 +1,21 @@
+# Convenience targets; the source of truth for the tier-1 line is
+# ROADMAP.md ("Tier-1 verify"), mirrored in scripts/verify.sh.
+
+.PHONY: verify lint test bench
+
+# The pre-merge gate: metrics-name lint + the full tier-1 suite with the
+# DOTS_PASSED count the driver compares against the seed.
+verify:
+	bash scripts/verify.sh
+
+# Just the metrics-name lint (fast; no jax dispatch work).
+lint:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_metrics_lint.py -q -p no:cacheprovider
+
+# The tier-1 suite without the lint-first staging or dots accounting.
+test:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly
+
+# The benchmark harness (never crashes; one FINAL JSON line).
+bench:
+	python bench.py

@@ -23,7 +23,7 @@ from .api import (
     save_transit, load_transit,
 )
 from .core.change import Change, Op
-from .utils import metrics
+from .utils import flightrec, metrics
 from .core.ids import ROOT_ID
 from .frontend.text import Text
 from .sync import Connection, DocSet, WatchableDoc
@@ -44,7 +44,7 @@ __all__ = [
     "get_missing_changes", "get_missing_deps", "get_clock", "get_actor_id",
     "can_undo", "undo", "can_redo", "redo",
     "Change", "Op", "ROOT_ID", "Text", "Connection", "DocSet",
-    "WatchableDoc", "uuid", "metrics", "__version__",
+    "WatchableDoc", "uuid", "metrics", "flightrec", "__version__",
 ]
 
 from .storage import save_binary, load_binary, changes_from_binary  # noqa: E402

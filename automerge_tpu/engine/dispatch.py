@@ -247,7 +247,7 @@ def apply_host(changes, actor_id: str = "engine"):
 
     if len(changes) >= HOST_BULK_MIN_CHANGES:
         # try_bulk_build owns the fallback contract (GC pause, observable
-        # bulkload_fallback_keyerror counter); materialize errors surface
+        # core_bulk_fallbacks counter); materialize errors surface
         ordered = _causal_order(changes)
         if ordered is not None:
             opset = try_bulk_build(changes_to_columns(ordered))

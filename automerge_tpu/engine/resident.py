@@ -35,7 +35,7 @@ import jax.numpy as jnp
 
 from ..core.change import Change
 from ..core.ids import ROOT_ID, HEAD, make_elem_id
-from ..utils import metrics
+from ..utils import flightrec, metrics
 from .encode import (A_DEL, A_INS, A_LINK, A_MAKE_LIST, A_MAKE_MAP,
                      A_MAKE_TEXT, A_SET, ASSIGN_CODES, _ACTION_CODE,
                      ValueTable, content_hash, value_hash_of, _pad_to)
@@ -851,6 +851,9 @@ class ResidentDocSet:
             self._ensure_actor_hash_state()
             self._out = metrics.dispatch_jit("apply_doc", apply_doc,
                                              self.state, self.cap_fids)
+            # breadcrumb before the readback barrier (see rows engine)
+            flightrec.record("engine_hash_readback",
+                             docs=len(self.doc_ids))
             return np.asarray(self._out["hash"])[:len(self.doc_ids)]
 
     def hashes(self) -> np.ndarray:

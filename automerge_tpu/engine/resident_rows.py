@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from .encode import _pad_to, content_hash
 from .resident import ResidentDocSet
 from .pallas_kernels import reconcile_rows_hash
-from ..utils import metrics
+from ..utils import flightrec, metrics
 
 
 def _ceil128(n: int) -> int:
@@ -1743,11 +1743,17 @@ class ResidentRowsDocSet(ResidentDocSet):
                 self._dirty = False
                 self._hash_handle = None
             h = getattr(self, "_hash_handle", None)
+            cached = h is not None
             if h is None:
                 h = metrics.dispatch_jit(
                     "reconcile_rows_hash", reconcile_rows_hash,
                     self.rows_dev, self.dims(), interpret)
                 self._hash_handle = h
+            # breadcrumb BEFORE the readback barrier: a tunnel hang
+            # surfaces at np.asarray below, and the flight recorder must
+            # already show this thread entered the readback
+            flightrec.record("rows_hash_readback", docs=len(self.doc_ids),
+                             cached=cached)
             return np.asarray(h)[:len(self.doc_ids)]
 
     def compact(self, floors: dict[str, dict[str, int]],

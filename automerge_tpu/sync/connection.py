@@ -27,6 +27,8 @@ from typing import Callable
 
 from ..core import clock as C
 from ..core.change import coerce_change
+from ..utils import metrics
+from .frames import TRACE_KEY, pack_trace, unpack_trace
 
 
 class Connection:
@@ -49,6 +51,12 @@ class Connection:
         # last metrics snapshot the peer answered with (request_metrics)
         self.peer_metrics: dict | None = None
         self.on_peer_metrics: Callable[[dict], None] | None = None
+        # last span ring the peer shipped (request_metrics(spans=True)) —
+        # merge with the local one via metrics.merge_timeline
+        self.peer_spans: list | None = None
+        # a ConvergenceAuditor (sync/audit.py) attaches itself here to
+        # receive the peer's audit digests/hashes as they arrive
+        self.auditor = None
         # engine-backed DocSets track each peer's advertised clock as the
         # compaction floor (engine/compaction.py); this object is the
         # registry key, released again in close()
@@ -63,6 +71,16 @@ class Connection:
         self._doc_set.register_handler(self.doc_changed)
 
     def close(self) -> None:
+        auditor = self.auditor
+        if auditor is not None:
+            # a dead transport must take its audit loop down with it, or
+            # the amtpu-auditor thread keeps firing pulls into the void
+            # (and leaks) until someone separately remembers stop()
+            self.auditor = None
+            try:
+                auditor.stop()
+            except Exception:
+                pass
         self._doc_set.unregister_handler(self.doc_changed)
         if self._floor_sink is not None:
             self._floor_sink.forget_peer(self)
@@ -75,19 +93,31 @@ class Connection:
         out[doc_id] = merged
         return out
 
+    def _send_traced(self, msg: dict) -> None:
+        """Every outgoing protocol message leaves through here: a
+        `sync_msg_send` span brackets the transport write, and the span's
+        trace context rides on the message (frames.TRACE_KEY) so the
+        peer's serving spans stitch onto it. Sends that happen while this
+        thread is already inside a span (a round flush, a serve-and-relay
+        chain) INHERIT that trace — a change propagating A→B→C is one
+        trace id across all three replicas."""
+        with metrics.trace("sync_msg_send") as span:
+            msg[TRACE_KEY] = pack_trace({"tid": span.trace_id,
+                                         "sid": span.span_id})
+            self._send_msg(msg)
+
     def send_msg(self, doc_id: str, clock: dict, changes=None) -> None:
         msg: dict = {"docId": doc_id, "clock": dict(clock)}
         self._our_clock = self._clock_union(self._our_clock, doc_id, clock)
         if changes is not None:
             if self._wire == "columnar":
                 from .frames import encode_frame
-                from ..utils import metrics
                 msg["frame"] = encode_frame(changes)
                 metrics.bump("sync_frames_sent")
                 metrics.bump("sync_frame_bytes_sent", len(msg["frame"]))
             else:
                 msg["changes"] = [c.to_dict() for c in changes]
-        self._send_msg(msg)
+        self._send_traced(msg)
 
     def maybe_send_changes(self, doc_id: str) -> None:
         doc = self._doc_set.get_doc(doc_id)
@@ -124,33 +154,69 @@ class Connection:
 
     # -- metrics pull (METRICS message type; no reference counterpart) ------
 
-    def request_metrics(self) -> None:
+    def request_metrics(self, spans: bool = False) -> None:
         """Ask the peer for its metrics.snapshot(). The answer lands in
-        self.peer_metrics (and on_peer_metrics fires, if set). Carried as a
+        self.peer_metrics (and on_peer_metrics fires, if set). With
+        spans=True the peer also ships its recent-span ring buffer (lands
+        in self.peer_spans; feed `metrics.merge_timeline({...})` together
+        with the local ring for the cross-replica timeline). Carried as a
         `{"metrics": ...}` message — JSON, so it crosses the TCP transport
         and any reference-framing relay unchanged; doc-sync peers that
         predate the message type simply never send it."""
-        self._send_msg({"metrics": "pull"})
+        msg: dict = {"metrics": "pull"}
+        if spans:
+            msg["spans"] = True
+        self._send_traced(msg)
 
     def _handle_metrics_msg(self, msg: dict) -> bool:
         kind = msg.get("metrics")
         if kind is None:
             return False
-        from ..utils import metrics
         if kind == "pull":
             metrics.bump("sync_metrics_pulls")
-            self._send_msg({"metrics": "snapshot",
-                            "snapshot": metrics.snapshot()})
+            resp = {"metrics": "snapshot", "snapshot": metrics.snapshot()}
+            if msg.get("spans"):
+                resp["spans"] = metrics.recent_spans()
+            self._send_traced(resp)
         elif kind == "snapshot":
             self.peer_metrics = msg.get("snapshot") or {}
+            if "spans" in msg:
+                self.peer_spans = msg.get("spans") or []
             if self.on_peer_metrics is not None:
                 self.on_peer_metrics(self.peer_metrics)
+        return True
+
+    # -- convergence audit (AUDIT message type; sync/audit.py) --------------
+
+    def request_audit(self) -> None:
+        """Start one audit round: ask the peer for its per-shard state
+        digests. The comparison (and the doc-level bisect on mismatch)
+        runs in the attached ConvergenceAuditor when the answer arrives."""
+        self._send_traced({"audit": "pull"})
+
+    def _handle_audit_msg(self, msg: dict) -> bool:
+        if msg.get("audit") is None:
+            return False
+        from .audit import handle_audit_msg
+        handle_audit_msg(self, msg)
         return True
 
     # -- receiving (connection.js:96-113) -----------------------------------
 
     def receive_msg(self, msg: dict):
+        """Transport entry point. The whole serve runs under a
+        `sync_msg_serve` span that adopts the sender's trace context
+        (frames.TRACE_KEY), so one sync round reads as one stitched trace
+        across replicas."""
+        ctx = unpack_trace(msg.pop(TRACE_KEY, None)) \
+            if isinstance(msg, dict) else None
+        with metrics.adopt_context(ctx), metrics.trace("sync_msg_serve"):
+            return self._receive_msg(msg)
+
+    def _receive_msg(self, msg: dict):
         if self._handle_metrics_msg(msg):
+            return None
+        if self._handle_audit_msg(msg):
             return None
         doc_id = msg["docId"]
         if msg.get("clock") is not None:
@@ -160,7 +226,6 @@ class Connection:
                 self._floor_sink.note_peer_clock(self, doc_id, msg["clock"])
         if msg.get("frame") is not None:
             from .frames import decode_frame
-            from ..utils import metrics
             metrics.bump("sync_frames_received")
             metrics.bump("sync_frame_bytes_received", len(msg["frame"]))
             cols = decode_frame(msg["frame"])

@@ -54,6 +54,35 @@ from ..native.wire import WireColumns, changes_to_columns  # noqa: F401
 
 FRAME_MAGIC = b"AMW1"
 
+# ---------------------------------------------------------------------------
+# trace-context header
+#
+# Cross-replica trace propagation (docs/OBSERVABILITY.md): every protocol
+# message MAY carry a `"trace"` key holding the sender's span context in
+# the compact form `<trace_id>-<span_id>` (hex, 16+8 chars). The receiver
+# adopts it (metrics.adopt_context) so its serving spans join the sender's
+# trace. It rides in the JSON part of the message — the plain-JSON wire and
+# the AMWM binary envelope's JSON head both carry it unchanged — and peers
+# that predate it simply ignore the key.
+
+TRACE_KEY = "trace"
+
+
+def pack_trace(ctx: dict) -> str:
+    """`{"tid": ..., "sid": ...}` -> compact `tid-sid` wire header."""
+    return f"{ctx['tid']}-{ctx.get('sid') or ''}"
+
+
+def unpack_trace(header) -> dict | None:
+    """Wire header -> `{"tid", "sid"}`; None for absent/malformed values
+    (an untraced or foreign peer must never break message handling)."""
+    if not isinstance(header, str) or not header:
+        return None
+    tid, _, sid = header.partition("-")
+    if not tid:
+        return None
+    return {"tid": tid, "sid": sid or None}
+
 
 # ---------------------------------------------------------------------------
 # columns <-> bytes

@@ -12,7 +12,7 @@ i.e. acknowledged by every registered peer) out of RAM into this archive:
 - a lagging or brand-new peer transparently triggers a COLD READ — the
   reference `{docId, clock, changes}` wire protocol keeps working with no
   resync extension, it just costs a file read on the serving side
-  (metric: ``log_archive_cold_reads``);
+  (metric: ``sync_archive_cold_reads``);
 - rebuild-from-log (the failure-recovery path) replays archive + tail.
 
 Format: one JSONL file per document (name = sha1(doc_id) prefix, the
@@ -108,7 +108,7 @@ class LogArchive:
         line still raises (the archive is the only copy of the truncated
         prefix, and silently dropping records would be divergence).
 
-        The ``log_archive_cold_reads`` metric (operator signal: peers
+        The ``sync_archive_cold_reads`` metric (operator signal: peers
         falling behind the horizon) is bumped by the missing_changes call
         site, not here — internal replays (rebuild-from-log, materialize)
         also read and must not pollute it."""

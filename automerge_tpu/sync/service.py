@@ -30,7 +30,7 @@ from typing import Callable
 from ..core.change import Change
 from ..engine.resident import ResidentDocSet
 from ..engine.resident_rows import CompactionAnchorError, DeviceDispatchError
-from ..utils import metrics
+from ..utils import flightrec, metrics
 
 
 class _HandleOpSet:
@@ -135,6 +135,10 @@ class EngineDocSet:
         # metrics label for this node's spans/counters; ShardedEngineDocSet
         # sets it to the shard index so per-shard series stay separable
         self._shard: str | None = None
+        # monotonic round counter: every flush's span is tagged with it
+        # (span-record tag, not a metric label — unbounded), so a stitched
+        # cross-replica timeline names WHICH round a span belonged to
+        self._round_seq = 0
         self._batch_depth = 0
         self._admit_notify: list[str] = []    # docs awaiting handler gossip
         # per doc: actor -> changes ordered by seq (admission guarantees
@@ -393,8 +397,13 @@ class EngineDocSet:
         labels = self._metric_labels()
         n_ops = sum(len(c.op_action) for parts in self._pending.values()
                     for c in parts)
+        self._round_seq += 1
+        round_no = self._round_seq
+        flightrec.record("round_flush", shard=self._shard, round=round_no,
+                         docs=len(self._pending), ops=int(n_ops))
         t0 = _time.perf_counter()
-        with metrics.trace("sync_round_flush", **labels):
+        with metrics.trace("sync_round_flush", tags={"round": round_no},
+                           **labels):
             self._flush_pending_locked()
         # failure paths raise out of the span (its timing still records).
         # The swallowed mid-admission rebuild path restores the round to
@@ -735,7 +744,36 @@ class EngineDocSet:
             self._drain_admitted_shielded()
             raise
         self._drain_admitted()
+        flightrec.record("hash_read", shard=self._shard, docs=len(out))
         return out
+
+    # -- convergence audit surface (sync/audit.py) ----------------------------
+
+    @property
+    def _audit_label(self) -> str:
+        return self._shard if self._shard is not None else "0"
+
+    def audit_state(self) -> dict[str, dict]:
+        """Per-shard audit digests: `{shard: {"digest": crc32, "docs": n}}`
+        over the engine's converged per-doc hashes. A standalone node is
+        its own single shard (label "0"); inside a ShardedEngineDocSet the
+        label is the shard index, so the auditor's divergence report names
+        the shard that owns the offending doc."""
+        from .audit import state_digest
+        h = self.hashes()
+        return {self._audit_label: {"digest": state_digest(h),
+                                    "docs": len(h)}}
+
+    def audit_shard_state(self, shard: str) -> dict:
+        """Doc-level audit detail for one shard: the engine's per-doc
+        convergence hashes plus each doc's clock frontier (the auditor
+        only alarms where clocks are EQUAL but hashes differ)."""
+        if shard != self._audit_label:
+            raise KeyError(f"not shard {shard!r} (this is "
+                           f"{self._audit_label!r})")
+        h = self.hashes()
+        return {"hashes": h,
+                "clocks": {d: self.clock_of(d) for d in h}}
 
     def materialize(self, doc_id: str):
         """Decode one document's converged state from the device."""

@@ -24,7 +24,7 @@ import os
 import zlib
 from typing import Callable
 
-from ..utils import metrics
+from ..utils import flightrec, metrics
 from .service import EngineDocSet
 
 # Stall-watchdog budget for the hash fan-out (the r5 config-8 hang site:
@@ -61,6 +61,10 @@ class ShardedEngineDocSet:
             for k in range(n_shards)]
         for k, s in enumerate(self.shards):
             s._shard = str(k)   # per-shard metric series (sync_round_flush…)
+        # monotonic hash fan-out counter: tagged onto the fan-out span and
+        # the flight-recorder progress events, so a post-mortem names which
+        # round stalled and how far the fan-out got before stalling
+        self._hash_round = 0
         for d in doc_ids or []:
             self.add_doc(d)
 
@@ -144,10 +148,35 @@ class ShardedEngineDocSet:
 
     def hashes(self) -> dict[str, int]:
         out: dict[str, int] = {}
-        with metrics.watchdog("sync_hashes_fanout", STALL_WATCHDOG_S):
-            for s in self.shards:
+        self._hash_round += 1
+        rnd = self._hash_round
+        with metrics.watchdog("sync_hashes_fanout", STALL_WATCHDOG_S,
+                              tags={"round": rnd}):
+            for k, s in enumerate(self.shards):
+                # per-shard progress breadcrumbs: if the fan-out stalls,
+                # the flight-recorder dump shows exactly how many shards
+                # answered before the stall — the diagnosis the r5
+                # config-8 hang never produced
+                flightrec.record("hash_shard", shard=str(k), round=rnd)
                 out.update(s.hashes())
+        flightrec.record("hash_fanout_done", round=rnd,
+                         shards=self.n_shards, docs=len(out))
         return out
 
     def materialize(self, doc_id: str):
         return self.shard_of(doc_id).materialize(doc_id)
+
+    # -- convergence audit surface (sync/audit.py) ---------------------------
+
+    def audit_state(self) -> dict[str, dict]:
+        """Per-shard audit digests across all K shards — the auditor
+        compares these shard-by-shard and bisects only mismatched shards
+        to the doc level."""
+        out: dict[str, dict] = {}
+        for s in self.shards:
+            out.update(s.audit_state())
+        return out
+
+    def audit_shard_state(self, shard: str) -> dict:
+        """Doc-level hashes + clock frontiers for one shard."""
+        return self.shards[int(shard)].audit_shard_state(shard)

@@ -82,16 +82,31 @@ def decode_msg(payload: bytes) -> dict:
     return msg
 
 
+def _msg_kind(msg: dict) -> str:
+    """Coarse message class for flight-recorder breadcrumbs."""
+    if "metrics" in msg:
+        return f"metrics:{msg['metrics']}"
+    if "audit" in msg:
+        return f"audit:{msg['audit']}"
+    if msg.get("frame") is not None:
+        return "frame"
+    if msg.get("changes") is not None:
+        return "changes"
+    return "clock"
+
+
 def send_frame(sock: socket.socket, msg: dict) -> None:
-    from ..utils import metrics
+    from ..utils import flightrec, metrics
     payload = encode_msg(msg)
     sock.sendall(_HEADER.pack(len(payload)) + payload)
     metrics.bump("sync_msgs_sent")
     metrics.bump("sync_wire_bytes_sent", _HEADER.size + len(payload))
+    flightrec.record("frame_send", kind=_msg_kind(msg),
+                     doc=msg.get("docId"), n=len(payload))
 
 
 def recv_frame(sock: socket.socket) -> dict | None:
-    from ..utils import metrics
+    from ..utils import flightrec, metrics
     header = _recv_exact(sock, _HEADER.size)
     if header is None:
         return None
@@ -103,7 +118,10 @@ def recv_frame(sock: socket.socket) -> dict | None:
         return None
     metrics.bump("sync_msgs_received")
     metrics.bump("sync_wire_bytes_received", _HEADER.size + length)
-    return decode_msg(payload)
+    msg = decode_msg(payload)
+    flightrec.record("frame_recv", kind=_msg_kind(msg),
+                     doc=msg.get("docId"), n=length)
+    return msg
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes | None:

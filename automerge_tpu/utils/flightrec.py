@@ -1,0 +1,248 @@
+"""Flight recorder: a bounded ring of structured events + crash dumps.
+
+The r5 config-8 hang taught the painful version of this lesson: a fleet
+that dies under a watchdog/timeout leaves, at best, a thread dump — no
+record of which peer it was talking to, which shard was mid-flush, or
+which kernel was the last one pushed at the device. This module is the
+always-on black box the post-mortem needs:
+
+- **record(kind, **fields)** appends a structured event — frame send/recv
+  (sync/tcp.py), round flushes (sync/service.py), hash fan-out progress
+  (sync/sharded_service.py, engine hashes paths), kernel dispatches
+  (metrics.dispatch_jit), watchdog fires — to an in-memory ring. Bounded
+  (AMTPU_FLIGHTREC_EVENTS, default 2048 events) and cheap (one dict append
+  under a lock), so it stays on in production.
+- **dump(reason)** writes one self-contained JSON file: the last N events
+  per thread, every thread's active span stack, recent completed spans,
+  watchdog diagnoses, and the full metrics snapshot. Returns the path.
+- **install()** arms automatic dumps on unhandled exceptions (sys and
+  threading excepthooks) and SIGTERM; the stall watchdog
+  (metrics.watchdog) dumps on fire without any installation.
+
+So the config-8 class of hang now produces a file naming the stalled span,
+its peer, and the last thing every thread did — instead of a bare
+`Timeout!`. Schema documented in docs/OBSERVABILITY.md.
+
+Env knobs: AMTPU_FLIGHTREC=0 disables recording entirely;
+AMTPU_FLIGHTREC_DIR picks the dump directory (default: the system temp
+dir); AMTPU_FLIGHTREC_EVENTS sizes the ring; AMTPU_FLIGHTREC_PER_THREAD
+caps the per-thread event tail embedded in a dump (default 64).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+
+log = logging.getLogger("automerge_tpu.flightrec")
+
+_ENABLED = os.environ.get("AMTPU_FLIGHTREC", "1") != "0"
+_RING = int(os.environ.get("AMTPU_FLIGHTREC_EVENTS", "2048"))
+_PER_THREAD = int(os.environ.get("AMTPU_FLIGHTREC_PER_THREAD", "64"))
+
+_lock = threading.Lock()
+_events: deque = deque(maxlen=_RING)
+_seq = 0
+_dump_count = 0
+_last_dump_path: str | None = None
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def record(_kind: str, **fields) -> None:
+    """Append one structured event to the ring. Field values should be
+    small JSON-able scalars (doc ids and per-event values are fine here —
+    the ring is bounded, unlike a metric label space)."""
+    if not _ENABLED:
+        return
+    global _seq
+    with _lock:
+        _seq += 1
+        _events.append({
+            "seq": _seq,
+            "t": time.time(),
+            "thread": threading.current_thread().name,
+            "kind": _kind,
+            **fields,
+        })
+
+
+def events() -> list[dict]:
+    """Ring contents, oldest first."""
+    with _lock:
+        return list(_events)
+
+
+def reset() -> None:
+    global _seq
+    with _lock:
+        _events.clear()
+        _seq = 0
+
+
+def last_dump() -> str | None:
+    """Path of the most recent dump() of this process, if any."""
+    return _last_dump_path
+
+
+def _dump_dir() -> str:
+    d = os.environ.get("AMTPU_FLIGHTREC_DIR")
+    if d:
+        return d
+    import tempfile
+    return tempfile.gettempdir()
+
+
+def _json_default(o):
+    try:
+        return int(o)          # numpy integers and friends
+    except Exception:
+        return repr(o)
+
+
+def dump(reason: str, path: str | None = None,
+         extra: dict | None = None) -> str | None:
+    """Write the post-mortem JSON: per-thread event tails, active span
+    stacks, recent completed spans, watchdog diagnoses, and the metrics
+    snapshot. Never raises (a broken dump must not mask the failure being
+    dumped); returns the file path, or None when disabled or the write
+    failed."""
+    global _dump_count, _last_dump_path
+    if not _ENABLED:
+        return None
+    try:
+        from . import metrics
+
+        with _lock:
+            evs = list(_events)
+            _dump_count += 1
+            n = _dump_count
+        threads: dict[str, list[dict]] = {}
+        for e in evs:
+            threads.setdefault(e["thread"], []).append(e)
+        threads = {t: es[-_PER_THREAD:] for t, es in threads.items()}
+        doc = {
+            "reason": reason,
+            "at": time.time(),
+            "pid": os.getpid(),
+            "argv": sys.argv,
+            "span_stacks": metrics.span_stacks(),
+            "threads": threads,
+            "recent_spans": metrics.recent_spans(),
+            "watchdog_events": metrics.watchdog_events(),
+            "metrics": metrics.snapshot(),
+        }
+        if extra:
+            doc["extra"] = extra
+        if path is None:
+            safe = "".join(c if c.isalnum() or c in "-_" else "-"
+                           for c in reason)[:48]
+            path = os.path.join(
+                _dump_dir(),
+                f"amtpu-flightrec-{os.getpid()}-{n:03d}-{safe}.json")
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1, default=_json_default)
+        _last_dump_path = path
+        # bounded label: the reason class, not the full reason string
+        metrics.bump("obs_flightrec_dumps", reason=reason.split(":")[0])
+        log.warning("flight recorder dumped to %s (reason: %s)",
+                    path, reason)
+        return path
+    except Exception:
+        log.exception("flight-recorder dump failed (reason: %s)", reason)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# automatic dump triggers: unhandled exceptions + SIGTERM
+
+
+_installed = False
+_prev_sys_hook = None
+_prev_threading_hook = None
+_prev_sigterm = None
+
+
+def install(signals: bool = True, excepthooks: bool = True) -> None:
+    """Arm automatic dumps: unhandled exceptions on any thread (sys and
+    threading excepthooks, chained to the previous hooks) and SIGTERM
+    (dump, then re-deliver so termination semantics are unchanged).
+    Idempotent. Long-lived processes (bench workers, sync services) call
+    this once at startup; libraries should not."""
+    global _installed, _prev_sys_hook, _prev_threading_hook, _prev_sigterm
+    if _installed or not _ENABLED:
+        return
+    _installed = True
+
+    if excepthooks:
+        _prev_sys_hook = sys.excepthook
+
+        def _sys_hook(exc_type, exc, tb):
+            dump("exception", extra={
+                "exception": "".join(traceback.format_exception(
+                    exc_type, exc, tb))[-8000:]})
+            (_prev_sys_hook or sys.__excepthook__)(exc_type, exc, tb)
+
+        sys.excepthook = _sys_hook
+
+        _prev_threading_hook = threading.excepthook
+
+        def _thread_hook(args):
+            dump("thread-exception", extra={
+                "thread": getattr(args.thread, "name", None),
+                "exception": "".join(traceback.format_exception(
+                    args.exc_type, args.exc_value,
+                    args.exc_traceback))[-8000:]})
+            (_prev_threading_hook or threading.__excepthook__)(args)
+
+        threading.excepthook = _thread_hook
+
+    if signals and threading.current_thread() is threading.main_thread():
+        import signal as _signal
+        try:
+            _prev_sigterm = _signal.getsignal(_signal.SIGTERM)
+
+            def _on_sigterm(signum, frame):
+                dump("sigterm")
+                if _prev_sigterm is _signal.SIG_IGN:
+                    return          # the process chose to ignore SIGTERM;
+                    #                 dumping must not turn that into death
+                if callable(_prev_sigterm):
+                    _prev_sigterm(signum, frame)
+                else:               # SIG_DFL (or unknown): default death
+                    _signal.signal(_signal.SIGTERM, _signal.SIG_DFL)
+                    os.kill(os.getpid(), _signal.SIGTERM)
+
+            _signal.signal(_signal.SIGTERM, _on_sigterm)
+        except (ValueError, OSError):   # non-main interpreter contexts
+            _prev_sigterm = None
+
+
+def uninstall() -> None:
+    """Restore the hooks install() replaced (tests; embedders shutting
+    down cleanly)."""
+    global _installed, _prev_sys_hook, _prev_threading_hook, _prev_sigterm
+    if not _installed:
+        return
+    _installed = False
+    if _prev_sys_hook is not None:
+        sys.excepthook = _prev_sys_hook
+        _prev_sys_hook = None
+    if _prev_threading_hook is not None:
+        threading.excepthook = _prev_threading_hook
+        _prev_threading_hook = None
+    if _prev_sigterm is not None:
+        import signal as _signal
+        try:
+            _signal.signal(_signal.SIGTERM, _prev_sigterm)
+        except (ValueError, OSError):
+            pass
+        _prev_sigterm = None

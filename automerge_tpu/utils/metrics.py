@@ -10,6 +10,13 @@ needs (ROADMAP north star; the r5 config-8 timeout died inside
   recently completed spans, wall-clock timing plus a device-side
   `jax.profiler.TraceAnnotation` (device time shows up in xprof captures
   when a profiler trace is active), all thread-safe;
+- **cross-replica trace context**: every span carries a `trace_id`/`span_id`
+  pair; a span opened under `adopt_context(ctx)` joins the remote trace
+  instead of starting a fresh one, so a sync round's spans stitch across
+  replicas (sync/connection.py stamps the context onto outgoing protocol
+  messages, docs/OBSERVABILITY.md "Trace propagation");
+  `merge_timeline({replica: spans})` folds per-replica span buffers into
+  one causally-ordered timeline;
 - **labeled counters / gauges / histograms**
   (`bump("engine_kernels_dispatched", kernel="apply_doc")`) with
   bounded-cardinality label values;
@@ -34,8 +41,8 @@ Counters may end in a plural verb (`sync_frames_received`); span names are
 `<layer>_<region>` and export as `<name>_s` (seconds) + `<name>_count`.
 Every name used by the package is declared in the registries below — a
 collection-time lint (tests/test_metrics_lint.py) rejects unregistered
-literals. Pre-rename names remain readable as snapshot ALIASES for one
-release; new call sites must use canonical names.
+literals. The pre-rename alias names the first release of the scheme kept
+readable have been dropped; snapshots now carry canonical names only.
 
 Usage:
     from automerge_tpu import metrics
@@ -44,13 +51,18 @@ Usage:
         ...
     with metrics.watchdog("sync_hashes_fanout", budget_s=120.0):
         h = svc.hashes()
-    metrics.snapshot()      # flat JSON-able dict (canonical + alias keys)
+    metrics.snapshot()      # flat JSON-able dict (canonical keys only)
     metrics.prometheus()    # text exposition
+    with metrics.adopt_context({"tid": ..., "sid": ...}):   # join a
+        ...                 # remote peer's trace (sync/connection.py)
+    metrics.merge_timeline({"a": spans_a, "b": spans_b})
 """
 
 from __future__ import annotations
 
+import binascii
 import logging
+import os
 import re
 import threading
 import time
@@ -104,9 +116,15 @@ COUNTERS: dict[str, str] = {
     "sync_archive_tail_repaired": "torn archive tails repaired on open",
     "sync_archive_tail_skipped": "torn archive tails skipped on read",
     "sync_metrics_pulls": "remote metrics snapshots served to peers",
+    "sync_audit_pulls": "convergence-audit digest requests served to peers",
+    "sync_audits_completed":
+        "convergence-audit rounds completed against a peer's digests",
+    "sync_divergences_detected":
+        "convergence-audit divergence reports (shard+doc isolated)",
     # obs — the observability subsystem's own signals
     "obs_watchdog_fired": "watchdog budget overruns {name=...}",
     "obs_budget_exceeded": "trace(budget_s=...) post-hoc overruns {name=...}",
+    "obs_flightrec_dumps": "flight-recorder post-mortem dumps {reason=...}",
 }
 
 GAUGES: dict[str, str] = {
@@ -127,26 +145,16 @@ SPANS: dict[str, str] = {
     "sync_round_flush": "service coalesced-round flush {shard=...}",
     "sync_hashes": "service hash read, incl. read-triggered flush",
     "sync_hashes_fanout": "sharded service hash fan-out over all shards",
+    "sync_msg_send": "one outgoing protocol message (trace-context root)",
+    "sync_msg_serve": "serving one received protocol message",
 }
 
-# Pre-rename names, readable for one release: bump()/trace() on an alias
-# records under the canonical name; snapshot() emits both keys.
-ALIASES: dict[str, str] = {
-    "changes_applied": "core_changes_applied",
-    "ops_applied": "core_ops_applied",
-    "diffs_emitted": "core_diffs_emitted",
-    "bulkload_fallback_keyerror": "core_bulk_fallbacks",
-    "host_bulk_built": "engine_bulk_built",
-    "rows_compacted": "rows_docs_compacted",
-    "rows_rebuilt_from_log": "rows_log_rebuilt",
-    "rows_poisoned": "rows_engine_poisoned",
-    "log_horizon_truncations": "rows_horizon_truncated",
-    "wire_frames_received": "sync_frames_received",
-    "log_archive_cold_reads": "sync_archive_cold_reads",
-    "log_archived_changes": "sync_changes_archived",
-    "log_archive_torn_tail_repaired": "sync_archive_tail_repaired",
-    "log_archive_torn_tail_skipped": "sync_archive_tail_skipped",
-}
+# The pre-rename alias names ("changes_applied", "wire_frames_received", …)
+# the scheme migration kept readable for one release are GONE: bump()/
+# trace() on them now registers as an unknown name and snapshot() emits
+# canonical keys only. Kept as an (empty) table so extension code probing
+# `metrics.ALIASES` keeps working.
+ALIASES: dict[str, str] = {}
 
 REGISTRY: dict[str, str] = {**COUNTERS, **GAUGES, **HISTOGRAMS, **SPANS}
 
@@ -176,8 +184,19 @@ def _flat_key(name: str, lk: tuple) -> str:
     return name + "{" + ",".join(f"{k}={v}" for k, v in lk) + "}"
 
 
+def _new_id(nbytes: int) -> str:
+    return binascii.hexlify(os.urandom(nbytes)).decode()
+
+
+# Thread-local adopted trace context: (trace_id, parent_span_id) a remote
+# peer shipped with a protocol message. Spans opened while it is set join
+# the remote trace instead of starting their own (adopt_context()).
+_tls = threading.local()
+
+
 class _Span:
-    __slots__ = ("name", "lk", "t0", "wall", "depth", "parent", "thread")
+    __slots__ = ("name", "lk", "t0", "wall", "depth", "parent", "thread",
+                 "trace_id", "span_id", "parent_sid", "tags")
 
     def __init__(self, name, lk, depth, parent, thread):
         self.name = name
@@ -187,6 +206,15 @@ class _Span:
         self.depth = depth
         self.parent = parent
         self.thread = thread
+        if parent is not None:
+            self.trace_id = parent.trace_id
+            self.parent_sid = parent.span_id
+        else:
+            ctx = getattr(_tls, "ctx", None)
+            self.trace_id = ctx[0] if ctx else _new_id(8)
+            self.parent_sid = ctx[1] if ctx else None
+        self.span_id = _new_id(4)
+        self.tags = None
 
 
 class _Metrics:
@@ -238,13 +266,16 @@ class _Metrics:
 
     # -- span stack ---------------------------------------------------------
 
-    def push_span(self, name: str, lk: tuple) -> _Span:
+    def push_span(self, name: str, lk: tuple, tags: dict | None = None
+                  ) -> _Span:
         ident = threading.get_ident()
         with self.lock:
             stack = self.active.setdefault(ident, [])
             span = _Span(name, lk, len(stack),
-                         stack[-1].name if stack else None,
+                         stack[-1] if stack else None,
                          threading.current_thread().name)
+            if tags:
+                span.tags = dict(tags)
             stack.append(span)
         return span
 
@@ -263,15 +294,22 @@ class _Metrics:
                 self.timers.get((span.name, span.lk), 0.0) + duration)
             ckey = (span.name, span.lk)
             self.span_counts[ckey] = self.span_counts.get(ckey, 0) + 1
-            self.spans.append({
+            rec = {
                 "name": span.name,
                 "labels": dict(span.lk),
                 "start": span.wall,
                 "duration_s": round(duration, 6),
                 "depth": span.depth,
-                "parent": span.parent,
+                "parent": (span.parent.name
+                           if span.parent is not None else None),
                 "thread": span.thread,
-            })
+                "trace_id": span.trace_id,
+                "span_id": span.span_id,
+                "parent_span_id": span.parent_sid,
+            }
+            if span.tags:
+                rec["tags"] = span.tags
+            self.spans.append(rec)
 
     def span_stacks(self) -> dict[str, list[str]]:
         """Active span stacks for every thread — `{"Thread-3":
@@ -289,12 +327,12 @@ class _Metrics:
 
     # -- exporters ----------------------------------------------------------
 
-    def snapshot(self, aliases: bool = True) -> dict:
+    def snapshot(self) -> dict:
         """Flat, json.dumps-safe view: counters as-is, gauges as-is,
         timers as `<name>_s`, histograms as `<name>_{count,sum,min,max}`.
-        Labeled series flatten to `name{k=v,...}` keys. With aliases=True
-        (default) every pre-rename name whose canonical key is present is
-        also emitted, so existing consumers keep reading for one release."""
+        Labeled series flatten to `name{k=v,...}` keys. Canonical names
+        only — the pre-rename alias keys the scheme migration emitted for
+        one release are gone."""
         with self.lock:
             out: dict = {}
             for (name, lk), v in self.counters.items():
@@ -311,11 +349,6 @@ class _Metrics:
                 out[_flat_key(name, lk) + "_count"] = v
             for (name, lk), v in self.timers.items():
                 out[_flat_key(name, lk) + "_s"] = round(v, 6)
-        if aliases:
-            for old, new in ALIASES.items():
-                for suffix in ("", "_s", "_count"):
-                    if new + suffix in out and old + suffix not in out:
-                        out[old + suffix] = out[new + suffix]
         return out
 
     def prometheus(self, prefix: str = "amtpu_") -> str:
@@ -408,8 +441,8 @@ def add_time(_name: str, _seconds: float, **labels) -> None:
     _global.add_time(_name, _seconds, **labels)
 
 
-def snapshot(aliases: bool = True) -> dict:
-    return _global.snapshot(aliases=aliases)
+def snapshot() -> dict:
+    return _global.snapshot()
 
 
 def prometheus(prefix: str = "amtpu_") -> str:
@@ -436,6 +469,108 @@ def watchdog_events() -> list[dict]:
         return list(_global.watchdog_events)
 
 
+# ---------------------------------------------------------------------------
+# cross-replica trace context
+
+
+def current_context() -> dict | None:
+    """The calling thread's live trace context — `{"tid": ..., "sid": ...}`
+    of its innermost active span, falling back to an adopted remote context
+    — or None when nothing is being traced. Public surface for CUSTOM
+    transports/embedders stamping the context onto their own envelopes;
+    the built-in Connection does not use it (its sync_msg_send span IS the
+    context it stamps — sync/connection.py:_send_traced)."""
+    ident = threading.get_ident()
+    with _global.lock:
+        stack = _global.active.get(ident)
+        if stack:
+            return {"tid": stack[-1].trace_id, "sid": stack[-1].span_id}
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is not None:
+        return {"tid": ctx[0], "sid": ctx[1]}
+    return None
+
+
+@contextmanager
+def adopt_context(ctx: dict | None):
+    """Join a remote trace: top-level spans opened by this thread inside
+    the block record the remote `tid` as their trace id and the remote
+    `sid` as their parent span, stitching the local serving work onto the
+    peer's span tree. A None/invalid ctx is a no-op (untraced peers cost
+    nothing). Nested adoptions restore the previous context on exit."""
+    if not isinstance(ctx, dict) or not ctx.get("tid"):
+        yield
+        return
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = (str(ctx["tid"]), str(ctx["sid"]) if ctx.get("sid") else None)
+    try:
+        yield
+    finally:
+        _tls.ctx = prev
+
+
+def _topo_trace(spans: list[dict]) -> list[dict]:
+    """Causal order within one trace: parent before child (even when clock
+    skew between replicas makes the child's start earlier), siblings by
+    start time, orphans (parent span not captured in any buffer) as roots.
+    Each span emits exactly once (the guard also breaks parent cycles a
+    span-id collision could fabricate)."""
+    by_sid = {s["span_id"]: s for s in spans if s.get("span_id")}
+    children: dict[str | None, list[dict]] = {}
+    for s in spans:
+        p = s.get("parent_span_id")
+        children.setdefault(p if p in by_sid else None, []).append(s)
+    out: list[dict] = []
+    emitted: set[int] = set()
+
+    def walk(parent_sid):
+        for s in sorted(children.get(parent_sid, []),
+                        key=lambda s: s.get("start", 0.0)):
+            if id(s) in emitted:
+                continue
+            emitted.add(id(s))
+            out.append(s)
+            if s.get("span_id"):
+                walk(s["span_id"])
+    walk(None)
+    for s in spans:        # collision leftovers: never drop a span
+        if id(s) not in emitted:
+            out.append(s)
+    return out
+
+
+def merge_timeline(buffers: dict[str, list[dict]]) -> list[dict]:
+    """Merge per-replica span buffers (each a `recent_spans()` list — local
+    or pulled from a peer via the `{"metrics": "pull", "spans": true}`
+    protocol message) into ONE causally-ordered timeline. Each output span
+    gains a `"replica"` key; traces are ordered by their earliest span
+    start, and within a trace parents precede children regardless of
+    replica clock skew — the cross-node picture of a sync round the
+    per-node ring buffers cannot show alone. A span present in several
+    buffers (overlapping pulls, or an in-process "peer" sharing the
+    store) is emitted once, under the first buffer that carried it."""
+    spans: list[dict] = []
+    seen: set = set()
+    for replica, buf in buffers.items():
+        for s in buf or []:
+            key = (s.get("span_id"), s.get("name"), s.get("start"))
+            if s.get("span_id") and key in seen:
+                continue
+            seen.add(key)
+            t = dict(s)
+            t["replica"] = replica
+            spans.append(t)
+    by_trace: dict[str, list[dict]] = {}
+    loose: list[dict] = []
+    for s in spans:
+        tid = s.get("trace_id")
+        (by_trace.setdefault(tid, []) if tid else loose).append(s)
+    groups = [(_topo_trace(group)) for group in by_trace.values()]
+    groups.extend([s] for s in loose)
+    groups.sort(key=lambda g: min(s.get("start", 0.0) for s in g))
+    return [s for g in groups for s in g]
+
+
 _annotation_cls = None
 
 
@@ -459,16 +594,21 @@ def _device_annotation(name: str):
 
 
 @contextmanager
-def trace(name: str, budget_s: float | None = None, **labels):
+def trace(name: str, budget_s: float | None = None,
+          tags: dict | None = None, **labels):
     """Structured span: nests per thread, records wall seconds + a count
     even when the body raises, annotates device work for jax.profiler, and
     lands in the recent-span ring buffer. With budget_s, an overrun is
     flagged post-hoc (`obs_budget_exceeded{name=...}` + one warning line);
-    for live stall detection of a possibly-hung region use watchdog()."""
+    for live stall detection of a possibly-hung region use watchdog().
+
+    `tags` ride on the ring-buffer span record ONLY — unlike **labels they
+    never become metric series keys, so unbounded values (round numbers,
+    doc ids) are safe there and forbidden as labels."""
     name = _resolve(name)
     lk = _lk(labels)
     annotation = _device_annotation(_flat_key(name, lk))
-    span = _global.push_span(name, lk)
+    span = _global.push_span(name, lk, tags)
     t0 = time.perf_counter()
     try:
         if annotation is not None:
@@ -490,7 +630,15 @@ class _WatchdogMonitor:
     """One shared background checker for every active watchdog. A
     threading.Timer per watched region would spawn a thread per hashes()
     poll; this parks a single daemon thread on a condition variable and
-    wakes it only at the earliest pending deadline."""
+    wakes it only at the earliest pending deadline. An idle checker (no
+    pending deadlines for `linger_s`) EXITS instead of parking forever —
+    thread hygiene between tests/services — and the next add() respawns
+    it."""
+
+    #: seconds an idle checker thread lingers before exiting (a steady
+    #: stream of watchdogged regions reuses the thread; a one-off lets it
+    #: die). Tests shrink this to assert hygiene quickly.
+    linger_s = 0.5
 
     def __init__(self):
         self._cv = threading.Condition()
@@ -515,6 +663,11 @@ class _WatchdogMonitor:
             self._entries.pop(key, None)
             self._cv.notify()
 
+    def thread(self) -> threading.Thread | None:
+        """The live checker thread, if any (hygiene tests join on it)."""
+        with self._cv:
+            return self._thread
+
     def _run(self) -> None:
         while True:
             with self._cv:
@@ -528,7 +681,16 @@ class _WatchdogMonitor:
                         nxt = min(d for d, _ in self._entries.values())
                         self._cv.wait(timeout=max(nxt - now, 0.001))
                     else:
-                        self._cv.wait()   # parked until the next add()
+                        self._cv.wait(timeout=self.linger_s)
+                        if not self._entries:
+                            # idle past the linger: exit; add() respawns.
+                            # The _thread reset happens under the cv, so
+                            # an add() racing this exit either sees the
+                            # old thread (and its entry is caught by the
+                            # empty-check above on the next loop) or
+                            # spawns a fresh one.
+                            self._thread = None
+                            return
                     continue
             for _, fire in due:   # outside the cv: fire() takes other locks
                 try:
@@ -541,16 +703,19 @@ _monitor = _WatchdogMonitor()
 
 
 @contextmanager
-def watchdog(name: str, budget_s: float, logger=None):
+def watchdog(name: str, budget_s: float, logger=None,
+             tags: dict | None = None):
     """Stall watchdog around a traced region: the shared background checker
     fires once at budget_s if the block has not exited, logging a one-line
     diagnosis with every thread's active span stack (the "where is it
-    stuck" line the r5 config-8 hang never produced) and bumping
-    obs_watchdog_fired{name=...}. The watched block itself runs inside
-    trace(name), so the diagnosis always names at least the watched region.
-    The region is never interrupted. budget_s <= 0 disables."""
+    stuck" line the r5 config-8 hang never produced), bumping
+    obs_watchdog_fired{name=...}, and dumping the flight recorder
+    (utils/flightrec.py) so the hang leaves a self-contained post-mortem
+    file. The watched block itself runs inside trace(name, tags=tags), so
+    the diagnosis always names at least the watched region. The region is
+    never interrupted. budget_s <= 0 disables."""
     if budget_s is None or budget_s <= 0:
-        with trace(name):
+        with trace(name, tags=tags):
             yield
         return
     lg = logger or log
@@ -571,10 +736,17 @@ def watchdog(name: str, budget_s: float, logger=None):
                 "name": name, "budget_s": budget_s,
                 "elapsed_s": round(time.perf_counter() - t_start, 3),
                 "spans": stacks, "at": time.time()})
+        try:    # the stall post-mortem: one self-contained JSON file
+            from . import flightrec
+            flightrec.record("watchdog_fire", name=name,
+                             budget_s=budget_s)
+            flightrec.dump(f"watchdog:{name}")
+        except Exception:
+            log.exception("flight-recorder dump on watchdog fire failed")
 
     key = _monitor.add(t_start + budget_s, _fire)
     try:
-        with trace(name):
+        with trace(name, tags=tags):
             yield
     finally:
         _monitor.remove(key)
@@ -599,12 +771,23 @@ def dispatch_jit(kernel: str, fn, *args, **kwargs):
     `engine_kernels_dispatched{kernel=...}` and — via the jit compile-cache
     size delta — any retrace/compile-cache miss under
     `engine_kernels_retraced{kernel=...}`. A retrace storm on a hot kernel
-    is the classic silent TPU perf cliff; this makes it a counter."""
+    is the classic silent TPU perf cliff; this makes it a counter. Each
+    dispatch also lands in the flight recorder's event ring, so a
+    post-mortem dump shows the last kernels every thread pushed at the
+    device before the hang."""
     before = _cache_size(fn)
     try:
         return fn(*args, **kwargs)
     finally:
         bump("engine_kernels_dispatched", kernel=kernel)
         after = _cache_size(fn)
-        if before is not None and after is not None and after > before:
+        retraced = (before is not None and after is not None
+                    and after > before)
+        if retraced:
             bump("engine_kernels_retraced", kernel=kernel)
+        try:
+            from . import flightrec
+            flightrec.record("dispatch", kernel=kernel,
+                             **({"retraced": True} if retraced else {}))
+        except Exception:
+            pass

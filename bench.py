@@ -1447,6 +1447,51 @@ def _compact_record(rec: dict) -> dict:
     return out
 
 
+class _ConfigTimeout(Exception):
+    """One config overran AMTPU_BENCH_CONFIG_TIMEOUT_S; carries the
+    flight-recorder dump path for the partial ERROR record."""
+
+    def __init__(self, cfg: int, budget_s: float, dump_path: str | None):
+        super().__init__(f"config {cfg} overran {budget_s:.0f}s budget")
+        self.dump_path = dump_path
+
+
+def _run_config_budgeted(cfg: int, n_docs, budget_s: float):
+    """run_config under a per-config wall-clock budget. An overrunning
+    config used to blow the PARENT's whole-run budget instead: the worker
+    got killed and the run ended as a bare `Timeout!` thread dump (r5,
+    config 8). Now the config runs on a worker thread; on overrun the main
+    thread dumps the flight recorder — the post-mortem names the stalled
+    span and the last events on every thread — and raises _ConfigTimeout
+    so the loop emits a partial record and MOVES ON to the next config.
+    The overrunning thread itself is daemonic and abandoned (a hung C
+    call cannot be interrupted in-process); its budget is gone either
+    way, but the remaining configs get theirs. budget_s <= 0 disables."""
+    if budget_s <= 0:
+        return run_config(cfg, n_docs=n_docs)
+    import threading
+
+    box: dict = {}
+
+    def _run():
+        try:
+            box["result"] = run_config(cfg, n_docs=n_docs)
+        except BaseException as e:  # re-raised on the main thread below
+            box["error"] = e
+
+    t = threading.Thread(target=_run, name=f"bench-config-{cfg}",
+                         daemon=True)
+    t.start()
+    t.join(budget_s)
+    if t.is_alive():
+        from automerge_tpu.utils import flightrec
+        path = flightrec.dump(f"bench-config-{cfg}-timeout")
+        raise _ConfigTimeout(cfg, budget_s, path)
+    if "error" in box:
+        raise box["error"]
+    return box["result"]
+
+
 def worker_main(args):
     """Run the measurements. Streams one `RESULT {json}` line per finished
     config and a `FINAL {json}` line at the end, all flushed immediately so
@@ -1486,20 +1531,40 @@ def worker_main(args):
     _load_package()
 
     rc = 0
+    from automerge_tpu.utils import flightrec as _flightrec
     from automerge_tpu.utils import metrics as _metrics
+    # black box for the whole worker: unhandled exceptions and SIGTERM
+    # (the parent's kill path) leave a post-mortem dump
+    _flightrec.install()
+    # Per-config wall-clock budget; 0 disables (see _run_config_budgeted).
+    cfg_budget = float(os.environ.get("AMTPU_BENCH_CONFIG_TIMEOUT_S", "600"))
     configs = [args.config] if args.config else list(CONFIGS)
+    zombie_cfg = None   # a timed-out config's abandoned thread may still
+    #                   # be running: later configs' observability data is
+    #                   # co-mingled with it and must say so
     for cfg in configs:
         if cfg in args.skip:
             continue
         try:
             _metrics.reset()   # per-config observability snapshot
-            r = run_config(cfg, n_docs=args.docs)
-            r["metrics"] = _metrics.snapshot(aliases=False)
+            _flightrec.reset()
+            r = _run_config_budgeted(cfg, args.docs, cfg_budget)
+            r["metrics"] = _metrics.snapshot()
+            if zombie_cfg is not None:
+                r["metrics_tainted_by"] = zombie_cfg
             r["backend"] = backend
             from automerge_tpu.engine import kernels as _k
             if _k.DISABLE_DENSE:
                 # the record must say which engine formulation it measured
                 r["dense_disabled"] = True
+        except _ConfigTimeout as e:
+            rc = 1
+            zombie_cfg = cfg
+            # partial record: where it was stuck + the full post-mortem
+            # path, instead of the bare `Timeout!` the r5 run died with
+            print(f"ERROR {json.dumps({'config': cfg, 'error': 'config-timeout', 'timeout_s': cfg_budget, 'flightrec': e.dump_path, 'spans': _metrics.span_stacks(), 'metrics': _metrics.snapshot()})}",
+                  flush=True)
+            continue
         except Exception as e:
             rc = 1
             print(f"ERROR {json.dumps({'config': cfg, 'error': repr(e)[:400]})}",

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# verify.sh — the one command a builder runs before claiming "tier-1 green".
+#
+# Stage 1: the metrics-name lint (fast fail: an unregistered or retired
+#          metric name is a doc-rot bug regardless of what else passes).
+# Stage 2: the tier-1 pytest line EXACTLY as ROADMAP.md specifies it,
+#          including the DOTS_PASSED count the driver compares against the
+#          seed. Keep this in sync with ROADMAP.md "Tier-1 verify".
+#
+# Usage: scripts/verify.sh   (or: make verify)
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== stage 1/2: metrics-name lint =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_metrics_lint.py -q \
+    -p no:cacheprovider || exit $?
+
+echo "== stage 2/2: tier-1 suite (ROADMAP.md) =="
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+exit $rc

@@ -88,7 +88,7 @@ else:
                          f"{sorted(engine.doc_ids)}")
 
 # the other host's docs really arrived as binary frames, not JSON
-assert am.metrics.snapshot().get("wire_frames_received", 0) > 0, \
+assert am.metrics.snapshot().get("sync_frames_received", 0) > 0, \
     f"[p{pid}] no columnar frames received"
 
 # concurrent edits on a shared doc: both hosts write doc0.winner straight

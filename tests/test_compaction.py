@@ -330,7 +330,7 @@ def test_soak_long_lived_doc_past_vmem_budget():
     assert budget_crossed_at is not None and budget_crossed_at < n_rounds - 5, \
         "soak too small to cross the pre-compaction budget"
     from automerge_tpu.utils import metrics
-    assert metrics.snapshot().get("rows_compacted"), "soak never compacted"
+    assert metrics.snapshot().get("rows_docs_compacted"), "soak never compacted"
     # final materialized text matches the oracle document
     assert "".join(e.materialize("doc")["data"]["t"]) == "".join(d["t"])
 

@@ -146,9 +146,9 @@ def test_apply_host_bulk_engages_on_concurrent_log(monkeypatch):
                                 incremental=False)
     assert am.equals(got, want)
     snap = am.metrics.snapshot()
-    assert snap.get("bulkload_fallback_keyerror", 0) == 0
+    assert snap.get("core_bulk_fallbacks", 0) == 0
     # positive signal: the bulk path really built (not interpretive)
-    assert snap.get("host_bulk_built", 0) == 1, snap
+    assert snap.get("engine_bulk_built", 0) == 1, snap
 
 
 def test_causal_order_property_random_shuffles():

@@ -1,0 +1,152 @@
+"""Flight recorder: event ring, dump schema, and the ISSUE acceptance —
+a stalled sharded hash fan-out under the watchdog produces a JSON
+post-mortem naming the stalled span stack and the last events per
+thread."""
+
+import json
+import threading
+import time
+
+from automerge_tpu import metrics
+from automerge_tpu.core.change import Change, Op
+from automerge_tpu.core.ids import ROOT_ID
+from automerge_tpu.native.wire import changes_to_columns
+from automerge_tpu.sync import sharded_service
+from automerge_tpu.sync.sharded_service import ShardedEngineDocSet
+from automerge_tpu.utils import flightrec
+
+
+def wait_until(predicate, timeout=5.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _cols(actor, seq, key, value):
+    return changes_to_columns([Change(
+        actor=actor, seq=seq, deps={},
+        ops=[Op("set", ROOT_ID, key=key, value=value)])])
+
+
+def test_record_and_ring_bound():
+    flightrec.reset()
+    for i in range(10):
+        flightrec.record("test_evt", i=i)
+    evs = flightrec.events()
+    assert [e["i"] for e in evs] == list(range(10))
+    assert all(e["kind"] == "test_evt" and "t" in e and "thread" in e
+               for e in evs)
+    # seq is monotonic across threads
+    assert [e["seq"] for e in evs] == sorted(e["seq"] for e in evs)
+
+
+def test_dump_schema_and_counter(tmp_path, monkeypatch):
+    monkeypatch.setenv("AMTPU_FLIGHTREC_DIR", str(tmp_path))
+    metrics.reset()
+    flightrec.reset()
+    flightrec.record("test_evt", x=1)
+    with metrics.trace("engine_hashes"):
+        path = flightrec.dump("unit-test", extra={"note": "hello"})
+    assert path and path.startswith(str(tmp_path))
+    doc = json.load(open(path))
+    assert doc["reason"] == "unit-test"
+    assert doc["extra"] == {"note": "hello"}
+    # the dumping thread's active span stack is captured
+    stacks = doc["span_stacks"]
+    assert any("engine_hashes" in frame
+               for stack in stacks.values() for frame in stack)
+    # per-thread event tails
+    me = threading.current_thread().name
+    assert any(e["kind"] == "test_evt" for e in doc["threads"][me])
+    assert isinstance(doc["metrics"], dict)
+    assert metrics.snapshot()["obs_flightrec_dumps{reason=unit-test}"] == 1
+    assert flightrec.last_dump() == path
+
+
+def test_stalled_sharded_fanout_dumps_postmortem(tmp_path, monkeypatch):
+    """ISSUE acceptance: force a stall in the sharded `hashes` fan-out
+    under the watchdog; the flight-recorder JSON dump names the stalled
+    span stack and carries the last N events per thread."""
+    monkeypatch.setenv("AMTPU_FLIGHTREC_DIR", str(tmp_path))
+    svc = ShardedEngineDocSet(n_shards=2)
+    for i in range(6):
+        svc.apply_columns(f"d{i}", _cols(f"W{i}", 1, "x", i))
+    svc.hashes()   # warm the hash kernels under the default (120s) budget:
+    #              # the cold-compile must not be what trips the watchdog
+    monkeypatch.setattr(sharded_service, "STALL_WATCHDOG_S", 0.15)
+    metrics.reset()
+    flightrec.reset()
+
+    stalled_shard = svc.shards[1]
+    orig_hashes = stalled_shard.hashes
+
+    def stalled():
+        with metrics.trace("rows_hashes"):   # the classic readback stall
+            time.sleep(0.6)
+        return orig_hashes()
+
+    monkeypatch.setattr(stalled_shard, "hashes", stalled)
+    before = flightrec.last_dump()
+    h = svc.hashes()          # stalls past the watchdog budget, completes
+    assert len(h) == 6
+    assert wait_until(lambda: flightrec.last_dump() not in (None, before))
+    path = flightrec.last_dump()
+    doc = json.load(open(path))
+    assert doc["reason"] == "watchdog:sync_hashes_fanout"
+
+    # the stalled span stack: fan-out > per-shard hash > readback
+    stacks = doc["span_stacks"]
+    joined = [" > ".join(stack) for stack in stacks.values()]
+    assert any("sync_hashes_fanout" in s and "rows_hashes" in s
+               for s in joined), stacks
+
+    # last-N events per thread, including the fan-out progress breadcrumbs
+    # that say how far the fan-out got (shard 0 answered, shard 1 did not)
+    evs = [e for es in doc["threads"].values() for e in es]
+    shards_entered = {e["shard"] for e in evs if e["kind"] == "hash_shard"}
+    assert {"0", "1"} <= shards_entered
+    assert not any(e["kind"] == "hash_fanout_done" for e in evs)
+
+    # the watchdog diagnosis itself rode along
+    assert any(w["name"] == "sync_hashes_fanout"
+               for w in doc["watchdog_events"])
+    snap = metrics.snapshot()
+    assert snap["obs_watchdog_fired{name=sync_hashes_fanout}"] == 1
+
+
+def test_excepthook_dump(tmp_path, monkeypatch):
+    """install() dumps on an unhandled thread exception, chaining to the
+    previous hook."""
+    monkeypatch.setenv("AMTPU_FLIGHTREC_DIR", str(tmp_path))
+    flightrec.reset()
+    seen = []
+    monkeypatch.setattr(threading, "excepthook", seen.append)
+    flightrec.install(signals=False)
+    try:
+        before = flightrec.last_dump()
+
+        def boom():
+            raise RuntimeError("crash for the recorder")
+
+        t = threading.Thread(target=boom, name="crasher")
+        t.start()
+        t.join()
+        assert wait_until(lambda: flightrec.last_dump() not in (None, before))
+        doc = json.load(open(flightrec.last_dump()))
+        assert doc["reason"] == "thread-exception"
+        assert "crash for the recorder" in doc["extra"]["exception"]
+        assert doc["extra"]["thread"] == "crasher"
+        assert seen, "previous excepthook was not chained"
+    finally:
+        flightrec.uninstall()
+
+
+def test_disabled_recorder_is_inert(monkeypatch):
+    monkeypatch.setattr(flightrec, "_ENABLED", False)
+    flightrec.reset()
+    flightrec.record("test_evt")
+    assert flightrec.events() == []
+    assert flightrec.dump("nope") is None

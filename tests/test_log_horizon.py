@@ -89,7 +89,7 @@ def test_caught_up_peer_never_cold_reads(tmp_path):
     horizon_clock = {c.actor: c.seq for c in chs[:-3]}
     out = e.missing_changes("doc", horizon_clock)
     assert len(out) == 3
-    assert metrics.snapshot().get("log_archive_cold_reads", 0) == 0
+    assert metrics.snapshot().get("sync_archive_cold_reads", 0) == 0
 
 
 def test_lagging_registered_peer_bounds_the_horizon(tmp_path):
@@ -109,7 +109,7 @@ def test_lagging_registered_peer_bounds_the_horizon(tmp_path):
     metrics.reset()
     out = e.missing_changes("doc", {"alice": 10})
     assert len(out) == len(chs) - 10
-    assert metrics.snapshot().get("log_archive_cold_reads", 0) == 0
+    assert metrics.snapshot().get("sync_archive_cold_reads", 0) == 0
 
 
 def test_auto_archive_keeps_ram_log_bounded(tmp_path):
@@ -205,7 +205,7 @@ def test_append_after_torn_tail_repairs_not_glues(tmp_path):
     got = arch.read("d")
     assert sorted((c.actor, c.seq) for c in got) == \
         sorted((c.actor, c.seq) for c in chs)
-    assert metrics.snapshot().get("log_archive_torn_tail_repaired")
+    assert metrics.snapshot().get("sync_archive_tail_repaired")
 
 
 def test_post_rebuild_overlap_is_not_served_twice(tmp_path):
@@ -265,7 +265,7 @@ def test_soak_both_walls_bounded_together(tmp_path):
                 e.apply_changes("doc", [c])
         peak_log = max(peak_log, len(rset.change_log[i]))
     assert total_ops > ROWS_MAX_OPS        # crossed the device budget
-    assert metrics.snapshot().get("rows_compacted"), "never compacted"
+    assert metrics.snapshot().get("rows_docs_compacted"), "never compacted"
     assert rset.log_horizon[i], "never archived"
     assert peak_log < served               # host log really was truncated
     assert np.uint32(e.hashes()["doc"]) == oracle_hash(changes_of(d))

@@ -16,9 +16,9 @@ def test_counters_track_applied_changes():
     s = am.change(am.init(), lambda d: d.__setitem__("a", 1))
     s = am.change(s, lambda d: am.assign(d, {"b": 2, "c": 3}))
     snap = metrics.snapshot()
-    assert snap["changes_applied"] == 2
-    assert snap["ops_applied"] == 3
-    assert snap["diffs_emitted"] >= 3
+    assert snap["core_changes_applied"] == 2
+    assert snap["core_ops_applied"] == 3
+    assert snap["core_diffs_emitted"] >= 3
 
 
 def test_engine_counters():
@@ -158,15 +158,16 @@ def test_prometheus_exposition():
     assert "amtpu_engine_reconcile_seconds_total" in text
 
 
-def test_legacy_alias_names_still_readable():
+def test_pre_rename_alias_names_are_gone():
+    """The one-release alias window is over: snapshots carry canonical
+    names only, and the alias table is empty (extension code probing
+    metrics.ALIASES keeps working, it just finds nothing)."""
     metrics.reset()
-    # a migrated call site records under the canonical name...
-    metrics.bump("wire_frames_received")
+    assert metrics.ALIASES == {}
+    metrics.bump("sync_frames_received")
     snap = metrics.snapshot()
     assert snap["sync_frames_received"] == 1
-    # ...and the pre-rename key stays readable for one release
-    assert snap["wire_frames_received"] == 1
-    assert metrics.snapshot(aliases=False).get("wire_frames_received") is None
+    assert "wire_frames_received" not in snap
 
 
 # -- thread safety ----------------------------------------------------------
@@ -215,7 +216,8 @@ def test_metrics_pull_message_roundtrip():
     conn_b = Connection(DocSet(), b_out.append)
     conn_a.request_metrics()
     (pull,) = a_out
-    assert pull == {"metrics": "pull"}
+    assert pull["metrics"] == "pull"
+    assert "trace" in pull            # cross-replica trace context header
     conn_b.receive_msg(pull)          # serves its snapshot
     (resp,) = b_out
     assert resp["metrics"] == "snapshot"

@@ -45,11 +45,25 @@ def test_package_metric_names_are_registered():
         "per the <layer>_<noun>_<verb> scheme in docs/OBSERVABILITY.md")
 
 
+# The pre-scheme names retired by the rename (and their one-release alias
+# window, now closed). A call site reintroducing one would silently mint a
+# fresh series nobody reads.
+_RETIRED = {
+    "changes_applied", "ops_applied", "diffs_emitted",
+    "bulkload_fallback_keyerror", "host_bulk_built", "rows_compacted",
+    "rows_rebuilt_from_log", "rows_poisoned", "log_horizon_truncations",
+    "wire_frames_received", "log_archive_cold_reads",
+    "log_archived_changes", "log_archive_torn_tail_repaired",
+    "log_archive_torn_tail_skipped",
+}
+
+
 def test_package_call_sites_use_canonical_names():
-    """New call sites must use canonical names — aliases exist only so old
-    snapshot consumers keep reading for one release."""
-    stale = [(str(p), n) for p, n in _used_names() if n in metrics.ALIASES]
-    assert not stale, f"call sites still on pre-rename alias names: {stale}"
+    """The alias window is over: no call site may use a retired pre-rename
+    name (or anything left in the — now empty — compat ALIASES table)."""
+    bad = _RETIRED | set(metrics.ALIASES)
+    stale = [(str(p), n) for p, n in _used_names() if n in bad]
+    assert not stale, f"call sites on retired pre-rename names: {stale}"
 
 
 def test_registry_names_follow_scheme():

@@ -78,6 +78,43 @@ def test_two_clients_gossip_through_server():
         server.close()
 
 
+def test_metrics_pull_over_real_tcp_pair():
+    """The `{"metrics": "pull"}` remote-snapshot message crossing a REAL
+    socket pair (it was previously only exercised in-memory), including
+    the span-ring pull and the merged cross-replica timeline."""
+    from automerge_tpu import metrics
+
+    metrics.reset()
+    ds_server, ds_client = DocSet(), DocSet()
+    server = TcpSyncServer(ds_server).start()
+    client = TcpSyncClient(ds_client, server.host, server.port).start()
+    try:
+        ds_server.set_doc("doc1", am.change(
+            am.init(), lambda d: d.__setitem__("hello", "net")))
+        assert wait_until(
+            lambda: ds_client.get_doc("doc1") == {"hello": "net"})
+
+        conn = client.peer.connection    # the client side of the socket
+        conn.request_metrics(spans=True)
+        assert wait_until(lambda: conn.peer_metrics is not None)
+        snap = conn.peer_metrics
+        assert snap.get("sync_msgs_received", 0) >= 1
+        assert snap.get("sync_metrics_pulls", 0) >= 1
+        assert conn.peer_spans is not None
+        timeline = metrics.merge_timeline({
+            "local": metrics.recent_spans(), "peer": conn.peer_spans})
+        assert any(s["name"] == "sync_msg_serve" for s in timeline)
+        # the pull answer crossed the wire under the puller's trace id:
+        # the serve span of the pull stitches to a local send span
+        sends = {s["span_id"]: s for s in metrics.recent_spans()
+                 if s["name"] == "sync_msg_send"}
+        assert any(s.get("parent_span_id") in sends
+                   for s in timeline if s["name"] == "sync_msg_serve")
+    finally:
+        client.close()
+        server.close()
+
+
 def test_reconnect_catches_up_after_disconnect():
     ds_server, ds_client = DocSet(), DocSet()
     server = TcpSyncServer(ds_server).start()

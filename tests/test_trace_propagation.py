@@ -1,0 +1,202 @@
+"""Cross-replica trace propagation: spans opened on one replica stitch to
+the serving spans on its peers (ISSUE 2 acceptance: one sync round = one
+stitched trace), and per-replica span buffers merge into one causally-
+ordered timeline."""
+
+from automerge_tpu import metrics
+from automerge_tpu.core.change import Change, Op
+from automerge_tpu.core.ids import ROOT_ID
+from automerge_tpu.native.wire import changes_to_columns
+from automerge_tpu.sync.connection import Connection
+from automerge_tpu.sync.frames import pack_trace, unpack_trace
+from automerge_tpu.sync.service import EngineDocSet
+
+
+def _cols(actor, seq, key, value):
+    return changes_to_columns([Change(
+        actor=actor, seq=seq, deps={},
+        ops=[Op("set", ROOT_ID, key=key, value=value)])])
+
+
+def _pump(qa, ca, qb, cb, rounds=30):
+    """Drain both in-memory queues until quiescent."""
+    for _ in range(rounds):
+        moved = False
+        while qa:
+            cb.receive_msg(qa.pop(0))
+            moved = True
+        while qb:
+            ca.receive_msg(qb.pop(0))
+            moved = True
+        if not moved:
+            return
+
+
+def _pair():
+    ea, eb = EngineDocSet(backend="rows"), EngineDocSet(backend="rows")
+    qa, qb = [], []
+    ca = Connection(ea, qa.append, wire="columnar")
+    cb = Connection(eb, qb.append, wire="columnar")
+    ca.open()
+    cb.open()
+    _pump(qa, ca, qb, cb)
+    return ea, eb, qa, ca, qb, cb
+
+
+# -- wire header ------------------------------------------------------------
+
+
+def test_trace_header_roundtrip():
+    ctx = {"tid": "aabbccdd00112233", "sid": "deadbeef"}
+    assert unpack_trace(pack_trace(ctx)) == ctx
+    # malformed / foreign values never break message handling
+    assert unpack_trace(None) is None
+    assert unpack_trace("") is None
+    assert unpack_trace(7) is None
+    assert unpack_trace("tidonly") == {"tid": "tidonly", "sid": None}
+
+
+# -- the acceptance path: one sync round, one trace -------------------------
+
+
+def test_sync_round_stitches_client_and_server_spans():
+    """The ISSUE acceptance: after one sync round between two replicas,
+    the sending replica's span and the receiving replica's serving span
+    share a trace id, with the serve span parented under the send span."""
+    metrics.reset()
+    ea, eb, qa, ca, qb, cb = _pair()
+    ea.apply_columns("doc1", _cols("A", 1, "x", 1))
+    _pump(qa, ca, qb, cb)
+    assert eb.hashes()["doc1"] == ea.hashes()["doc1"]
+
+    spans = metrics.recent_spans()
+    sends = {s["span_id"]: s for s in spans if s["name"] == "sync_msg_send"}
+    serves = [s for s in spans if s["name"] == "sync_msg_serve"]
+    assert sends and serves
+    stitched = [s for s in serves if s.get("parent_span_id") in sends]
+    assert stitched, (sends, serves)
+    for s in stitched:
+        parent = sends[s["parent_span_id"]]
+        assert s["trace_id"] == parent["trace_id"]
+
+
+def test_relay_chain_is_one_trace():
+    """A change propagating A -> B -> C keeps ONE trace id end to end:
+    B's relay send happens inside its serve span, so it inherits the
+    trace A started."""
+    metrics.reset()
+    ea, eb = EngineDocSet(backend="rows"), EngineDocSet(backend="rows")
+    ec = EngineDocSet(backend="rows")
+    q_ab, q_ba, q_bc, q_cb = [], [], [], []
+    c_ab = Connection(ea, q_ab.append, wire="columnar")   # a's link to b
+    c_ba = Connection(eb, q_ba.append, wire="columnar")   # b's link to a
+    c_bc = Connection(eb, q_bc.append, wire="columnar")   # b's link to c
+    c_cb = Connection(ec, q_cb.append, wire="columnar")   # c's link to b
+    for c in (c_ab, c_ba, c_bc, c_cb):
+        c.open()
+
+    def pump():
+        for _ in range(40):
+            moved = False
+            for q, dst in ((q_ab, c_ba), (q_ba, c_ab),
+                           (q_bc, c_cb), (q_cb, c_bc)):
+                while q:
+                    dst.receive_msg(q.pop(0))
+                    moved = True
+            if not moved:
+                return
+
+    pump()
+    metrics.reset()   # only the round below matters
+    ea.apply_columns("relay", _cols("A", 1, "k", 42))
+    pump()
+    assert ec.hashes().get("relay") == ea.hashes()["relay"]
+    spans = metrics.recent_spans()
+    # the serving spans on B and C (and the relay sends between) all carry
+    # the trace the originating send started
+    serves = [s for s in spans if s["name"] == "sync_msg_serve"]
+    tid_counts: dict[str, int] = {}
+    for s in serves:
+        tid_counts[s["trace_id"]] = tid_counts.get(s["trace_id"], 0) + 1
+    # at least one trace spans multiple serves (B's serve + C's serve)
+    assert max(tid_counts.values()) >= 2, tid_counts
+
+
+def test_round_flush_spans_carry_round_tags():
+    """service.py tags each flush span with the node's round number (a
+    span-record tag, not a metric label)."""
+    metrics.reset()
+    svc = EngineDocSet(backend="rows")
+    svc.apply_columns("d", _cols("A", 1, "x", 1))
+    svc.apply_columns("d", _cols("A", 2, "x", 2))
+    rounds = [s["tags"]["round"] for s in metrics.recent_spans()
+              if s["name"] == "sync_round_flush"]
+    assert rounds == [1, 2]
+
+
+# -- remote span pull + merged timeline -------------------------------------
+
+
+def test_remote_span_pull_and_merged_timeline():
+    metrics.reset()
+    ea, eb, qa, ca, qb, cb = _pair()
+    ea.apply_columns("doc1", _cols("A", 1, "x", 1))
+    _pump(qa, ca, qb, cb)
+    ca.request_metrics(spans=True)
+    _pump(qa, ca, qb, cb)
+    assert ca.peer_metrics is not None
+    assert ca.peer_spans, "peer did not ship its span ring"
+    timeline = metrics.merge_timeline({
+        "local": metrics.recent_spans(), "peer": ca.peer_spans})
+    assert all("replica" in s for s in timeline)
+    # at least one trace in the merged timeline has spans from a send
+    # and its serve (the stitched cross-replica round)
+    by_tid: dict[str, set] = {}
+    for s in timeline:
+        by_tid.setdefault(s["trace_id"], set()).add(s["name"])
+    assert any({"sync_msg_send", "sync_msg_serve"} <= names
+               for names in by_tid.values())
+
+
+def test_merge_timeline_orders_parent_before_child_despite_clock_skew():
+    """Causal order beats timestamps: a child span whose replica clock
+    reads EARLIER than its parent's still sorts after the parent."""
+    parent = {"name": "sync_msg_send", "trace_id": "t1", "span_id": "p1",
+              "parent_span_id": None, "start": 100.0}
+    child = {"name": "sync_msg_serve", "trace_id": "t1", "span_id": "c1",
+             "parent_span_id": "p1", "start": 99.0}   # skewed clock
+    other = {"name": "rows_hashes", "trace_id": "t2", "span_id": "x1",
+             "parent_span_id": None, "start": 50.0}
+    out = metrics.merge_timeline({"a": [parent], "b": [child, other]})
+    names = [(s["trace_id"], s["span_id"]) for s in out]
+    assert names.index(("t1", "p1")) < names.index(("t1", "c1"))
+    # traces order by earliest start: t2 (50.0) first
+    assert names[0] == ("t2", "x1")
+    assert out[0]["replica"] == "b"
+
+
+def test_merge_timeline_dedups_overlapping_buffers():
+    """A span present in several buffers (overlapping pulls; an
+    in-process peer sharing the store) must emit exactly once — the
+    duplicate-parent walk used to duplicate whole subtrees
+    exponentially."""
+    parent = {"name": "sync_msg_send", "trace_id": "t1", "span_id": "p1",
+              "parent_span_id": None, "start": 1.0}
+    child = {"name": "sync_msg_serve", "trace_id": "t1", "span_id": "c1",
+             "parent_span_id": "p1", "start": 2.0}
+    grand = {"name": "sync_round_flush", "trace_id": "t1", "span_id": "g1",
+             "parent_span_id": "c1", "start": 3.0}
+    buf = [parent, child, grand]
+    out = metrics.merge_timeline({"a": buf, "b": list(buf)})
+    assert len(out) == 3
+    assert [s["span_id"] for s in out] == ["p1", "c1", "g1"]
+    assert all(s["replica"] == "a" for s in out)
+
+
+def test_adopt_context_noop_for_untraced_peer():
+    metrics.reset()
+    with metrics.adopt_context(None):
+        with metrics.trace("sync_msg_serve") as s:
+            assert s.parent_sid is None
+    ctx = metrics.current_context()
+    assert ctx is None
