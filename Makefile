@@ -1,16 +1,23 @@
 # Convenience targets; the source of truth for the tier-1 line is
 # ROADMAP.md ("Tier-1 verify"), mirrored in scripts/verify.sh.
 
-.PHONY: verify lint test bench
+.PHONY: verify analyze lint test bench
 
-# The pre-merge gate: metrics-name lint + the full tier-1 suite with the
+# The pre-merge gate: static analysis + the full tier-1 suite with the
 # DOTS_PASSED count the driver compares against the seed.
 verify:
 	bash scripts/verify.sh
 
-# Just the metrics-name lint (fast; no jax dispatch work).
-lint:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_metrics_lint.py -q -p no:cacheprovider
+# graftlint: registry + jit-hygiene + lock-discipline vs the committed
+# analysis_baseline.json (docs/ANALYSIS.md). Exit 1 on any new finding.
+analyze:
+	JAX_PLATFORMS=cpu python -m automerge_tpu.analysis
+
+# The analyzer plus its pytest surface (registry lint + analyzer tests).
+lint: analyze
+	JAX_PLATFORMS=cpu python -m pytest tests/test_metrics_lint.py \
+	    tests/test_analysis_core.py tests/test_analysis_jit.py \
+	    tests/test_analysis_locks.py -q -p no:cacheprovider
 
 # The tier-1 suite without the lint-first staging or dots accounting.
 test:

@@ -1,0 +1,36 @@
+"""graftlint: project-native static analysis for jit hygiene, lock
+discipline, and observability-registry conformance.
+
+Run it:
+
+    python -m automerge_tpu.analysis            # repo + committed baseline
+    make analyze                                # same
+    scripts/verify.sh                           # stage 1 of the gate
+
+Three passes ship (docs/ANALYSIS.md):
+
+- **registry** — every metric/span name reaching `metrics.bump/trace/...`
+  and every `flightrec.record` event kind must be declared in its
+  registry; kind-correct (a counter name cannot be traced); not retired.
+- **jit-hygiene** — inside code reachable from `jax.jit`/`pjit`/pallas
+  call sites in `engine/` and `parallel/`: host-sync hazards (`.item()`,
+  `int()/float()` on tracers, `np.asarray` of device values), Python
+  branching on traced values, per-call `jax.jit` wraps and bad
+  `static_argnames` (retrace hazards), and shape literals drifting from
+  the canonical constants in `engine/pack.py`.
+- **lock-discipline** — a lock-acquisition graph over `sync/` and
+  `utils/`: inconsistent lock ordering, blocking calls (socket IO,
+  `join`, device readback, sleeps) while holding a lock — the r5 stall
+  class — and `threading.Thread` hygiene (explicit `daemon=`, a `name=`
+  the flight recorder can key on, join ownership).
+
+Pre-existing findings are grandfathered in `analysis_baseline.json` (repo
+root) with one-line justifications; new findings fail the build. Local
+deliberate exceptions use `# graftlint: disable=<rule>` comments.
+"""
+
+from .core import (  # noqa: F401
+    AnalysisReport, Baseline, Finding, Project, SourceUnit,
+    apply_suppressions, default_passes, load_project, parse_source,
+    run_analysis, run_passes,
+)

@@ -1,0 +1,734 @@
+"""jit-hygiene pass: host-sync, retrace, and shape-drift hazards in traced
+code.
+
+Scope: `engine/` and `parallel/` (the modules that own jit boundaries).
+The pass discovers every jit root — functions decorated with `jax.jit` /
+`pjit` (bare or via `partial`), functions/lambdas passed to a `jax.jit(...)`
+call, and pallas kernels (first argument of `pl.pallas_call`) — then walks
+the intra-package call graph from those roots, propagating which parameters
+are STATIC (python values at trace time) and which are TRACED (tracers).
+`static_argnames`/`static_argnums` seed the static set; call edges carry it
+(an argument fed only static values is static in the callee; revisits
+intersect, so a parameter traced at ANY call site is traced).
+
+Rules:
+
+- **jit-host-sync** (error): a host synchronization inside traced code —
+  `.item()` / `np.asarray` / `np.array` / `jax.device_get` / `float()` /
+  `int()` / `bool()` on a traced value, or `.block_until_ready()`
+  anywhere reachable from a root. Each is a device->host readback barrier
+  in the middle of a traced region: under `jit` it either fails or forces
+  a silent per-call sync (Eg-walker's lesson — hot CRDT paths must stay
+  sync-free).
+- **jit-tracer-branch** (error): Python control flow (`if`/`while`/
+  ternary/`assert`/`for`-over-tracer) on a traced value. Under tracing
+  this raises ConcretizationTypeError at best; at worst (when the value
+  happens to be concrete, e.g. under `interpret=True` tests) it silently
+  bakes one branch into the compiled program.
+- **jit-retrace** (error): compile-cache hazards — `jax.jit(...)` wrapped
+  inside a function body (the fresh wrapper's cache is discarded per
+  call: a guaranteed retrace storm on a hot path), and `static_argnames`
+  naming a parameter the function does not have (the typo silently makes
+  the argument traced, retracing per distinct value... or crashing).
+- **jit-shape-drift** (warning): shape literals re-deriving canonical
+  constants owned by `engine/pack.py` — open-coded lane-pad arithmetic
+  (`((n + 127) // 128) * 128` instead of `pack.pad_to_lanes`) and the
+  VMEM row budget. Drift here is how two layers disagree about padding
+  and produce shape-mismatch crashes only at dispatch time.
+
+Known limits (documented in docs/ANALYSIS.md): dataflow through
+containers is approximated (a tuple holding a tracer taints the whole
+tuple), duck-typed calls (`self._resident.X`) end the walk, and Python
+scalars flowing into traced shapes are not modeled. The baseline absorbs
+the residue.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .core import (Finding, Project, SourceUnit, const_str, dotted_name,
+                   str_tuple)
+
+# dotted names (after import-alias resolution) that mean "jit this"
+_JIT_NAMES = {
+    "jax.jit", "jax.pjit", "jax.experimental.pjit.pjit",
+}
+_PALLAS_CALL_NAMES = {
+    "jax.experimental.pallas.pallas_call",
+}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+
+# numpy/jax host-readback calls (resolved dotted prefixes)
+_READBACK_CALLS = {
+    "numpy.asarray", "numpy.array", "np.asarray", "np.array",
+    "jax.device_get",
+}
+
+# attribute reads on a tracer that yield PYTHON values (static)
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "at"}
+
+# builtins whose call on a tracer is a host sync
+_SCALAR_BUILTINS = {"float", "int", "bool", "complex"}
+
+DEFAULT_SCOPE = ("automerge_tpu/engine/", "automerge_tpu/parallel/")
+
+
+@dataclass
+class _Func:
+    unit: SourceUnit
+    node: ast.AST                    # FunctionDef | Lambda
+    qualname: str
+    params: list[str] = field(default_factory=list)
+
+    def key(self):
+        return (self.unit.rel, self.qualname)
+
+
+def _params_of(node: ast.AST) -> list[str]:
+    a = node.args
+    names = [p.arg for p in a.posonlyargs + a.args]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    names += [p.arg for p in a.kwonlyargs]
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _positional_params(node: ast.AST) -> list[str]:
+    a = node.args
+    return [p.arg for p in a.posonlyargs + a.args]
+
+
+class _ModuleIndex:
+    """Per-module symbol view: function defs by (qual)name and import
+    aliases resolved to dotted targets."""
+
+    def __init__(self, unit: SourceUnit, project: Project):
+        self.unit = unit
+        self.project = project
+        self.funcs: dict[str, _Func] = {}          # simple top-level name
+        self.all_funcs: dict[str, _Func] = {}      # qualname
+        self.aliases: dict[str, str] = {}          # local name -> dotted
+        self._collect()
+
+    def _collect(self) -> None:
+        mod = self.unit.modname
+        pkg = mod.rsplit(".", 1)[0] if "." in mod else ""
+
+        def walk(body, prefix, top):
+            for node in body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    q = f"{prefix}{node.name}"
+                    f = _Func(self.unit, node, q, _params_of(node))
+                    self.all_funcs[q] = f
+                    if top:
+                        self.funcs[node.name] = f
+                    walk(node.body, q + ".", False)
+                elif isinstance(node, ast.ClassDef):
+                    walk(node.body, f"{prefix}{node.name}.", False)
+                elif isinstance(node, (ast.If, ast.Try, ast.With)):
+                    walk(getattr(node, "body", []), prefix, top)
+
+        walk(self.unit.tree.body, "", True)
+
+        for node in ast.walk(self.unit.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = mod if self.unit.rel.endswith("__init__.py") \
+                        else pkg
+                    for _ in range(node.level - 1):
+                        base = base.rsplit(".", 1)[0] if "." in base else ""
+                    src = (base + "." + node.module) if node.module else base
+                else:
+                    src = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.aliases[a.asname or a.name] = f"{src}.{a.name}"
+
+    def resolve_dotted(self, name: str) -> str:
+        """Expand the leading alias of a dotted name ("pl.pallas_call" ->
+        "jax.experimental.pallas.pallas_call")."""
+        head, _, rest = name.partition(".")
+        target = self.aliases.get(head, head)
+        return f"{target}.{rest}" if rest else target
+
+    def resolve_func(self, call_func: ast.AST) -> "_Func | None":
+        """Resolve a Call's func expression to a project function: bare
+        names, imported symbols, module-attribute calls, and the
+        `f.__wrapped__` jit-unwrap idiom."""
+        if isinstance(call_func, ast.Attribute) \
+                and call_func.attr == "__wrapped__":
+            return self.resolve_func(call_func.value)
+        if isinstance(call_func, ast.Name):
+            f = self.funcs.get(call_func.id)
+            if f is not None:
+                return f
+            dotted = self.aliases.get(call_func.id)
+            if dotted and "." in dotted:
+                modname, sym = dotted.rsplit(".", 1)
+                return self._foreign(modname, sym)
+            return None
+        name = dotted_name(call_func)
+        if name and "." in name:
+            head, _, sym = name.rpartition(".")
+            modname = self.resolve_dotted(head)
+            return self._foreign(modname, sym)
+        return None
+
+    def _foreign(self, modname: str, sym: str) -> "_Func | None":
+        u = self.project.by_modname(modname)
+        if u is None:
+            return None
+        return _module_index(self.project, u).funcs.get(sym)
+
+
+def _module_index(project: Project, unit: SourceUnit) -> _ModuleIndex:
+    cache = project.__dict__.setdefault("_modindex_cache", {})
+    if unit.rel not in cache:
+        cache[unit.rel] = _ModuleIndex(unit, project)
+    return cache[unit.rel]
+
+
+# ---------------------------------------------------------------------------
+# root discovery
+
+
+@dataclass
+class _Root:
+    func: _Func
+    statics: frozenset
+
+
+def _jit_call_kind(node: ast.Call, idx: _ModuleIndex) -> str | None:
+    name = dotted_name(node.func)
+    if name is None:
+        return None
+    resolved = idx.resolve_dotted(name)
+    if resolved in _JIT_NAMES:
+        return "jit"
+    if resolved in _PALLAS_CALL_NAMES:
+        return "pallas"
+    return None
+
+
+def _statics_from_kwargs(node: ast.Call, func: _Func | None) -> frozenset:
+    statics: set[str] = set()
+    for kw in node.keywords:
+        if kw.arg == "static_argnames":
+            statics.update(str_tuple(kw.value) or ())
+        elif kw.arg == "static_argnums" and func is not None:
+            nums = []
+            if isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, int):
+                nums = [kw.value.value]
+            elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                nums = [e.value for e in kw.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, int)]
+            pos = _positional_params(func.node)
+            statics.update(pos[n] for n in nums if 0 <= n < len(pos))
+    return frozenset(statics)
+
+
+def _decorator_statics(dec: ast.AST, func: _Func,
+                       idx: _ModuleIndex) -> frozenset | None:
+    """None if the decorator is not a jit form; else its static set."""
+    if isinstance(dec, (ast.Name, ast.Attribute)):
+        name = dotted_name(dec)
+        if name and idx.resolve_dotted(name) in _JIT_NAMES:
+            return frozenset()
+        return None
+    if not isinstance(dec, ast.Call):
+        return None
+    name = dotted_name(dec.func)
+    resolved = idx.resolve_dotted(name) if name else None
+    if resolved in _JIT_NAMES:
+        return _statics_from_kwargs(dec, func)
+    if resolved in _PARTIAL_NAMES and dec.args:
+        inner = dotted_name(dec.args[0])
+        if inner and idx.resolve_dotted(inner) in _JIT_NAMES:
+            return _statics_from_kwargs(dec, func)
+    return None
+
+
+def _enclosing_funcs(tree: ast.Module) -> dict[int, ast.AST]:
+    """node-id -> nearest enclosing FunctionDef/Lambda (for detecting
+    jit-wrap-inside-a-function)."""
+    out: dict[int, ast.AST] = {}
+
+    def walk(node, enclosing):
+        for child in ast.iter_child_nodes(node):
+            out[id(child)] = enclosing
+            walk(child, child if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda))
+                else enclosing)
+
+    walk(tree, None)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# traced-value taint checking within one function
+
+
+class _TaintChecker(ast.NodeVisitor):
+    """Single-function walk: track which local names hold traced values,
+    flag host-sync and tracer-branch hazards, and record call edges into
+    other project functions with the static set each callee would see."""
+
+    def __init__(self, func: _Func, statics: frozenset,
+                 idx: _ModuleIndex, findings: set, edges: list,
+                 _depth: int = 0):
+        self.func = func
+        self.idx = idx
+        self.findings = findings
+        self.edges = edges
+        self.depth = _depth
+        self.returns_traced = False
+        params = set(_params_of(func.node))
+        self.traced: set[str] = {p for p in params
+                                 if p not in statics and p != "self"}
+        self.static: set[str] = set(statics) | {"self"}
+
+    # -- findings -----------------------------------------------------------
+
+    def _flag(self, rule: str, node: ast.AST, message: str,
+              severity: str = "error") -> None:
+        self.findings.add(Finding(
+            rule=rule, path=self.func.unit.rel,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            severity=severity, message=message))
+
+    # -- tracedness ---------------------------------------------------------
+
+    def _is_traced(self, node: ast.AST) -> bool:
+        """Conservative: an expression is traced if a traced name feeds it
+        through array-producing operations. Shape/dtype reads and len()
+        are static even on tracers."""
+        if isinstance(node, ast.Name):
+            return node.id in self.traced
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self._is_traced(node.value)
+        if isinstance(node, ast.Subscript):
+            return self._is_traced(node.value) or self._is_traced(node.slice)
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Name) and node.func.id == "len":
+                return False
+            parts = [node.func] if not isinstance(
+                node.func, (ast.Name,)) else []
+            parts += list(node.args) + [kw.value for kw in node.keywords]
+            if not any(self._is_traced(p) for p in parts):
+                return False
+            # a resolvable project callee may compute a PYTHON value from
+            # a tracer (shape reads, cost models): consult its returns
+            callee = self.idx.resolve_func(node.func)
+            if callee is not None and callee.key() != self.func.key() \
+                    and self.depth < 4:
+                statics = self._callee_statics(callee, node)
+                return _returns_traced(self.idx, callee, statics,
+                                       self.depth + 1)
+            return True
+        if isinstance(node, (ast.BinOp,)):
+            return self._is_traced(node.left) or self._is_traced(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._is_traced(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self._is_traced(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            return self._is_traced(node.left) or any(
+                self._is_traced(c) for c in node.comparators)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self._is_traced(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(self._is_traced(v) for v in node.values
+                       if v is not None)
+        if isinstance(node, ast.IfExp):
+            return any(self._is_traced(n)
+                       for n in (node.test, node.body, node.orelse))
+        if isinstance(node, ast.Starred):
+            return self._is_traced(node.value)
+        if isinstance(node, ast.JoinedStr):
+            return False
+        return False
+
+    def _bind(self, target: ast.AST, traced: bool) -> None:
+        if isinstance(target, ast.Name):
+            (self.traced.add if traced else self.traced.discard)(target.id)
+            (self.static.discard if traced else self.static.add)(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, traced)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, traced)
+        # attribute/subscript targets: no local binding to track
+
+    # -- statements ---------------------------------------------------------
+
+    def run(self) -> None:
+        body = self.func.node.body
+        if isinstance(body, list):
+            # two passes: a loop may use a name bound traced further down
+            for _ in range(2):
+                for stmt in body:
+                    self.visit(stmt)
+        else:                       # Lambda: a single expression
+            self.visit(body)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.generic_visit(node)
+        t = self._is_traced(node.value)
+        for tgt in node.targets:
+            self._bind(tgt, t)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self.visit(node.value)
+            self._bind(node.target, self._is_traced(node.value))
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.generic_visit(node)
+        if self._is_traced(node.value):
+            self._bind(node.target, True)
+
+    def visit_For(self, node: ast.For) -> None:
+        if self._is_traced(node.iter):
+            self._flag("jit-tracer-branch", node,
+                       "python `for` iterates over a traced value "
+                       f"in {self.func.qualname}(); loop bounds must be "
+                       "static under jit (use lax.scan/fori_loop)")
+            self._bind(node.target, True)
+        else:
+            self._bind(node.target, False)
+        self.generic_visit(node)
+
+    def _check_branch(self, test: ast.AST, node: ast.AST, kind: str) -> None:
+        if self._is_traced(test):
+            self._flag("jit-tracer-branch", node,
+                       f"python {kind} on a traced value in "
+                       f"{self.func.qualname}(); under jit this "
+                       "concretizes the tracer (use jnp.where/lax.cond, "
+                       "or make the argument static)")
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_branch(node.test, node, "branch")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_branch(node.test, node, "while-loop")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        self._check_branch(node.test, node, "conditional expression")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        self._check_branch(node.test, node, "assert")
+        self.generic_visit(node)
+
+    def visit_With(self, node: ast.With) -> None:
+        for item in node.items:
+            if item.optional_vars is not None:
+                self._bind(item.optional_vars,
+                           self._is_traced(item.context_expr))
+        self.generic_visit(node)
+
+    def visit_comprehension(self, node: ast.comprehension) -> None:
+        self._bind(node.target, self._is_traced(node.iter))
+        self.generic_visit(node)
+
+    def visit_Return(self, node: ast.Return) -> None:
+        self.generic_visit(node)
+        if node.value is not None and self._is_traced(node.value):
+            self.returns_traced = True
+
+    # nested defs get their own checker via call edges; don't walk into them
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        pass
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        pass
+
+    # -- calls --------------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        fn = self.func.qualname
+
+        # .item() / .block_until_ready() on anything traced
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr == "item" \
+                    and self._is_traced(node.func.value):
+                self._flag("jit-host-sync", node,
+                           f".item() on a traced value in {fn}(): a "
+                           "device->host readback barrier inside traced "
+                           "code")
+            elif node.func.attr == "block_until_ready":
+                self._flag("jit-host-sync", node,
+                           f".block_until_ready() in {fn}(): host sync "
+                           "barrier in jit-reachable code (hoist it to "
+                           "the caller that owns the readback)")
+
+        # float()/int()/bool() on a traced value
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in _SCALAR_BUILTINS and node.args \
+                and self._is_traced(node.args[0]):
+            self._flag("jit-host-sync", node,
+                       f"{node.func.id}() concretizes a traced value in "
+                       f"{fn}(): host sync under jit (keep it an array, "
+                       "or make the argument static)")
+
+        # np.asarray / jax.device_get of a traced value
+        name = dotted_name(node.func)
+        if name is not None:
+            resolved = self.idx.resolve_dotted(name)
+            if resolved in _READBACK_CALLS and node.args \
+                    and self._is_traced(node.args[0]):
+                self._flag("jit-host-sync", node,
+                           f"{name}() on a traced value in {fn}(): "
+                           "device->host readback inside traced code")
+
+        # edge into another project function
+        callee = self.idx.resolve_func(node.func)
+        if callee is not None and callee.key() != self.func.key():
+            statics = self._callee_statics(callee, node)
+            self.edges.append((callee, statics))
+
+    def _callee_statics(self, callee: _Func, node: ast.Call) -> frozenset:
+        params = _positional_params(callee.node)
+        if params[:1] == ["self"]:
+            params = params[1:]
+        statics: set[str] = set(params) | {
+            p.arg for p in callee.node.args.kwonlyargs}
+        seen: set[str] = set()
+        star = False
+        for i, arg in enumerate(node.args):
+            if isinstance(arg, ast.Starred):
+                star = True
+                continue
+            if i < len(params):
+                seen.add(params[i])
+                if self._is_traced(arg):
+                    statics.discard(params[i])
+        for kw in node.keywords:
+            if kw.arg is None:
+                star = True
+                continue
+            seen.add(kw.arg)
+            if self._is_traced(kw.value):
+                statics.discard(kw.arg)
+        if star:
+            # *args/**kwargs at the call site: anything not explicitly
+            # bound may receive a traced value
+            statics &= seen
+        return frozenset(statics)
+
+
+_returns_memo: dict[tuple, bool] = {}
+
+
+def _returns_traced(idx: _ModuleIndex, func: _Func, statics: frozenset,
+                    depth: int) -> bool:
+    """Whether `func`, called with `statics` known-static, can return a
+    traced value. A throwaway checker run (findings discarded — the real
+    worklist covers the callee with its own intersected statics); cycles
+    and depth overruns conservatively answer True."""
+    key = (func.key(), statics)
+    if key in _returns_memo:
+        return _returns_memo[key]
+    _returns_memo[key] = True          # cycle guard: assume traced
+    callee_idx = _module_index(idx.project, func.unit)
+    chk = _TaintChecker(func, statics, callee_idx, set(), [], _depth=depth)
+    try:
+        chk.run()
+    except RecursionError:
+        return True
+    if not isinstance(func.node.body, list):      # lambda: body IS the return
+        chk.returns_traced = chk._is_traced(func.node.body)
+    _returns_memo[key] = chk.returns_traced
+    return chk.returns_traced
+
+
+# ---------------------------------------------------------------------------
+# the pass
+
+
+class JitHygienePass:
+    name = "jit-hygiene"
+
+    def __init__(self, scope: tuple[str, ...] = DEFAULT_SCOPE):
+        self.scope = scope
+
+    def run(self, project: Project) -> list[Finding]:
+        _returns_memo.clear()
+        units = project.under(*self.scope)
+        findings: set[Finding] = set()
+        roots: list[_Root] = []
+
+        for unit in units:
+            idx = _module_index(project, unit)
+            enclosing = _enclosing_funcs(unit.tree)
+
+            # decorated roots + static_argnames typo check
+            for f in idx.all_funcs.values():
+                for dec in getattr(f.node, "decorator_list", []):
+                    statics = _decorator_statics(dec, f, idx)
+                    if statics is None:
+                        continue
+                    roots.append(_Root(f, statics))
+                    unknown = sorted(set(statics) - set(f.params))
+                    if unknown:
+                        findings.add(Finding(
+                            rule="jit-retrace", path=unit.rel,
+                            line=dec.lineno, col=dec.col_offset,
+                            severity="error",
+                            message=(f"static_argnames {unknown} name no "
+                                     f"parameter of {f.qualname}(); the "
+                                     "typo leaves the real argument "
+                                     "traced (retrace per value) or "
+                                     "breaks the call")))
+
+            # jax.jit(...) / pallas_call(...) call-expression roots
+            for node in ast.walk(unit.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                kind = _jit_call_kind(node, idx)
+                if kind is None or not node.args:
+                    continue
+                target = node.args[0]
+                if kind == "jit":
+                    host = enclosing.get(id(node))
+                    if host is not None and not self._wrapper_cached(
+                            host, node):
+                        host_name = getattr(host, "name", "<lambda>")
+                        findings.add(Finding(
+                            rule="jit-retrace", path=unit.rel,
+                            line=node.lineno, col=node.col_offset,
+                            severity="error",
+                            message=(f"jax.jit(...) wrapped inside "
+                                     f"{host_name}(): the wrapper's "
+                                     "compile cache dies with each call "
+                                     "— hoist to module level (or cache "
+                                     "the wrapper) or every call "
+                                     "retraces")))
+                if isinstance(target, ast.Lambda):
+                    f = _Func(unit, target, f"<lambda@{target.lineno}>",
+                              _params_of(target))
+                else:
+                    f = idx.resolve_func(target)
+                if f is not None:
+                    # resolve the target FIRST: static_argnums needs the
+                    # positional->name mapping of the actual function
+                    statics = _statics_from_kwargs(
+                        node, f) if kind == "jit" else frozenset()
+                    roots.append(_Root(f, statics))
+
+            self._check_shape_drift(unit, findings)
+
+        self._walk_roots(project, roots, findings)
+        return sorted(findings,
+                      key=lambda f: (f.path, f.line, f.col, f.rule))
+
+    @staticmethod
+    def _wrapper_cached(host: ast.AST, jit_call: ast.Call) -> bool:
+        """True when the in-function jit wrapper is stored into a
+        subscripted cache (`_CACHE[key] = fn`) — the memoized-builder
+        idiom keeps the compile cache alive across calls, so it is not a
+        retrace hazard."""
+        assigned: set[str] = set()
+        for a in ast.walk(host):
+            if isinstance(a, ast.Assign) and a.value is jit_call:
+                assigned.update(t.id for t in a.targets
+                                if isinstance(t, ast.Name))
+        if not assigned:
+            return False
+        for a in ast.walk(host):
+            if isinstance(a, ast.Assign) \
+                    and isinstance(a.value, ast.Name) \
+                    and a.value.id in assigned \
+                    and any(isinstance(t, ast.Subscript)
+                            for t in a.targets):
+                return True
+        return False
+
+    # -- reachability fixpoint ----------------------------------------------
+
+    def _walk_roots(self, project: Project, roots: list[_Root],
+                    findings: set) -> None:
+        best: dict[tuple, frozenset] = {}
+        work: list[tuple[_Func, frozenset]] = []
+        for r in roots:
+            self._merge(best, work, r.func, r.statics)
+        steps = 0
+        while work and steps < 10000:
+            steps += 1
+            func, statics = work.pop()
+            idx = _module_index(project, func.unit)
+            edges: list = []
+            _TaintChecker(func, statics, idx, findings, edges).run()
+            for callee, callee_statics in edges:
+                self._merge(best, work, callee, callee_statics)
+
+    @staticmethod
+    def _merge(best: dict, work: list, func: _Func,
+               statics: frozenset) -> None:
+        key = func.key()
+        if key in best:
+            merged = best[key] & statics
+            if merged == best[key]:
+                return
+            best[key] = merged
+            work.append((func, merged))
+        else:
+            best[key] = statics
+            work.append((func, statics))
+
+    # -- shape-literal drift -------------------------------------------------
+
+    _CANONICAL_OWNER = "automerge_tpu/engine/pack.py"
+    _OWNED_LITERALS = {22528: "ROWS_VMEM_BUDGET"}
+
+    def _check_shape_drift(self, unit: SourceUnit, findings: set) -> None:
+        if unit.rel == self._CANONICAL_OWNER:
+            return
+        for node in ast.walk(unit.tree):
+            # ((n + 127) // 128): open-coded lane padding
+            if isinstance(node, ast.BinOp) \
+                    and isinstance(node.op, ast.FloorDiv) \
+                    and isinstance(node.right, ast.Constant) \
+                    and node.right.value == 128 \
+                    and isinstance(node.left, ast.BinOp) \
+                    and isinstance(node.left.op, ast.Add) \
+                    and isinstance(node.left.right, ast.Constant) \
+                    and node.left.right.value == 127:
+                findings.add(Finding(
+                    rule="jit-shape-drift", path=unit.rel,
+                    line=node.lineno, col=node.col_offset,
+                    severity="warning",
+                    message=("open-coded lane-pad arithmetic "
+                             "((n + 127) // 128); use "
+                             "engine.pack.pad_to_lanes/LANE so every "
+                             "layer pads the docs axis identically")))
+            elif isinstance(node, ast.Constant) \
+                    and node.value in self._OWNED_LITERALS:
+                findings.add(Finding(
+                    rule="jit-shape-drift", path=unit.rel,
+                    line=node.lineno, col=node.col_offset,
+                    severity="warning",
+                    message=(f"literal {node.value} duplicates "
+                             f"engine.pack."
+                             f"{self._OWNED_LITERALS[node.value]}; "
+                             "import the constant")))

@@ -130,7 +130,7 @@ def plan_for(doc_changes: list, passes: int = 1) -> Plan:
     """Plan (no execution) for a concrete from-scratch batch: estimates the
     wire from the same padded dims pack.py will use, and prices the host
     side per document with apply_host's actual bulk/interpretive predicate."""
-    from .pack import rows_count
+    from .pack import pad_to_lanes, rows_count
 
     def _pad(n, minimum=8):
         p = minimum
@@ -158,7 +158,7 @@ def plan_for(doc_changes: list, passes: int = 1) -> Plan:
             max_ins = doc_ins
     ops_pad = _pad(max_ops)
     ins_pad = _pad(max_ins)
-    d_pad = ((len(doc_changes) + 127) // 128) * 128  # pack.py's lane pad
+    d_pad = pad_to_lanes(len(doc_changes))  # pack.py's canonical lane pad
     wire_bytes = (rows_count(ops_pad, max(len(actors), 1), ins_pad)
                   * d_pad * 4)
 

@@ -26,6 +26,18 @@ FIELDS = ("op_mask", "action", "fid", "actor", "seq", "change_idx", "value",
           "ins_actor", "ins_parent", "ins_fid", "ins_pos", "list_obj",
           "list_obj_hash", "actor_hash")
 
+# TPU lane width: the docs axis of every docs-minor layout pads to a
+# multiple of this. THE canonical constant — every layer that pads the
+# docs axis must go through pad_to_lanes (the graftlint jit-shape-drift
+# rule flags open-coded `((n + 127) // 128) * 128` elsewhere; two layers
+# disagreeing about padding is a shape-mismatch crash at dispatch time).
+LANE = 128
+
+
+def pad_to_lanes(n: int) -> int:
+    """Round a doc count up to the TPU lane width (docs-minor layouts)."""
+    return ((n + LANE - 1) // LANE) * LANE
+
 
 def pack_batch(batch: dict) -> tuple[np.ndarray, tuple]:
     """Flatten a stacked batch into (flat int32 buffer, static meta).
@@ -160,7 +172,7 @@ def pack_rows(batch: dict, max_fids: int) -> tuple[np.ndarray, tuple, int]:
     d, i = batch["op_mask"].shape
     c, a = batch["clock"].shape[1:]
     l, e = batch["ins_mask"].shape[1:]
-    d_pad = ((d + 127) // 128) * 128
+    d_pad = pad_to_lanes(d)
 
     def rowify(arr, fill=0):
         """[d, ...] -> [prod(...), d_pad] int32, docs minor."""

@@ -30,12 +30,11 @@ import jax.numpy as jnp
 
 from .encode import _pad_to, content_hash
 from .resident import ResidentDocSet
+from .pack import pad_to_lanes
 from .pallas_kernels import reconcile_rows_hash
 from ..utils import flightrec, metrics
 
 
-def _ceil128(n: int) -> int:
-    return ((n + 127) // 128) * 128
 
 
 class DeviceDispatchError(RuntimeError):
@@ -104,7 +103,7 @@ class ResidentRowsDocSet(ResidentDocSet):
         # tables stay authoritative; otherwise the Python _encode_delta path
         # runs. Mixing encoders on one instance would desync interning state.
         super().__init__(doc_ids, native=native)
-        self.n_pad = _ceil128(max(len(self.doc_ids), 1))
+        self.n_pad = pad_to_lanes(max(len(self.doc_ids), 1))
         # per-doc: list_row -> [(slot, elem, arank, parent_slot), ...]
         self.ins_log: list[dict[int, list[tuple]]] = [
             {} for _ in self.doc_ids]
@@ -248,7 +247,7 @@ class ResidentRowsDocSet(ResidentDocSet):
                 [self.op_count, np.zeros(k, np.int64)])
             self.change_count = np.concatenate(
                 [self.change_count, np.zeros(k, np.int64)])
-        new_pad = _ceil128(n)
+        new_pad = pad_to_lanes(n)
         if new_pad > self.n_pad:
             b = self._bases()
             grown = np.zeros((b["rows"], new_pad), np.int32)

@@ -59,13 +59,23 @@ def shard_batch(batch: dict, mesh: Mesh):
     return {k: jax.device_put(np.asarray(v), sharding) for k, v in batch.items()}
 
 
+_SHARDED_APPLY_CACHE: dict = {}
+
+
 def sharded_apply(arrays: dict, max_fids: int, mesh: Mesh):
     """The batched reconcile kernel jitted over the mesh: inputs arrive
-    sharded over docs, outputs stay sharded over docs."""
+    sharded over docs, outputs stay sharded over docs. The jitted wrapper
+    is cached per (mesh, max_fids) — a fresh jax.jit per call would drop
+    its compile cache on the floor and retrace every time (the graftlint
+    jit-retrace rule; the rows/bytes builders below always cached)."""
     from ..engine.kernels import apply_doc
-    out_sharding = NamedSharding(mesh, P(DOCS_AXIS))
-    fn = jax.jit(lambda b: apply_doc(b, max_fids, host_order=True),
-                 out_shardings=out_sharding)
+    key = (mesh, max_fids)
+    fn = _SHARDED_APPLY_CACHE.get(key)
+    if fn is None:
+        out_sharding = NamedSharding(mesh, P(DOCS_AXIS))
+        fn = jax.jit(lambda b: apply_doc(b, max_fids, host_order=True),
+                     out_shardings=out_sharding)
+        _SHARDED_APPLY_CACHE[key] = fn
     return fn(arrays)
 
 

@@ -169,7 +169,10 @@ class _Peer:
         self.sock = sock
         self._send_lock = threading.Lock()
         self.connection = LockedConnection(doc_set, self._send, wire=wire)
-        self._thread = threading.Thread(target=self._read_loop, daemon=True)
+        # named so flight-recorder event tails and watchdog span stacks
+        # attribute socket work to the right peer reader (not "Thread-3")
+        self._thread = threading.Thread(target=self._read_loop, daemon=True,
+                                        name=f"amtpu-tcp-read-{id(sock):x}")
         self.closed = threading.Event()
 
     def _send(self, msg: dict) -> None:
@@ -217,7 +220,9 @@ class TcpSyncServer:
         self.host, self.port = self._listener.getsockname()[:2]
         self.peers: list[_Peer] = []
         self._accept_thread = threading.Thread(target=self._accept_loop,
-                                               daemon=True)
+                                               daemon=True,
+                                               name=f"amtpu-tcp-accept-"
+                                                    f"{self.port}")
         self._closed = threading.Event()
 
     def start(self) -> "TcpSyncServer":

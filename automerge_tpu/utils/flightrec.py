@@ -52,6 +52,38 @@ _seq = 0
 _dump_count = 0
 _last_dump_path: str | None = None
 
+# Event-kind registry: every `record(kind, ...)` call site in the package
+# must use a kind declared here (enforced statically — the graftlint
+# registry pass, automerge_tpu/analysis/registry.py — the same way metric
+# names are pinned to metrics.REGISTRY). Post-mortem readers can only
+# interpret documented kinds; an undeclared kind is a breadcrumb nobody
+# can follow. Extension code registers its kinds by inserting here (or
+# suppresses the lint with a justification).
+EVENT_KINDS: dict[str, str] = {
+    "frame_send": "one protocol message written to a TCP socket "
+                  "(sync/tcp.py; kind/doc/bytes)",
+    "frame_recv": "one protocol message read from a TCP socket",
+    "round_flush": "a coalesced service round entering the engine "
+                   "(sync/service.py; shard/round/docs/ops)",
+    "hash_read": "per-node converged hash-table read served "
+                 "(sync/service.py; shard/docs)",
+    "hash_shard": "sharded hash fan-out reaching shard k "
+                  "(sync/sharded_service.py; the stall-progress trail)",
+    "hash_fanout_done": "sharded hash fan-out completed (round/shards/docs)",
+    "engine_hash_readback": "docs-major engine device->host hash readback "
+                            "barrier (engine/resident.py)",
+    "rows_hash_readback": "rows engine device->host hash readback barrier "
+                          "(engine/resident_rows.py)",
+    "dispatch": "one jitted kernel dispatch (metrics.dispatch_jit; "
+                "kernel, retraced flag)",
+    "watchdog_fire": "a stall watchdog fired (metrics.watchdog; "
+                     "name/budget_s)",
+    "audit_state": "a convergence-audit digest round compared "
+                   "(sync/audit.py; shards/mismatched)",
+    "divergence": "a convergence divergence isolated to one doc "
+                  "(sync/audit.py; shard/doc)",
+}
+
 
 def enabled() -> bool:
     return _ENABLED

@@ -1,8 +1,13 @@
 #!/usr/bin/env bash
 # verify.sh — the one command a builder runs before claiming "tier-1 green".
 #
-# Stage 1: the metrics-name lint (fast fail: an unregistered or retired
-#          metric name is a doc-rot bug regardless of what else passes).
+# Stage 1: static analysis (fast fail): graftlint runs the registry,
+#          jit-hygiene, and lock-discipline passes against the committed
+#          analysis_baseline.json (docs/ANALYSIS.md). A new finding — an
+#          unregistered metric/span/event name, a host sync or retrace
+#          hazard in jit-reachable code, a lock-order inversion or a
+#          blocking call under a lock — fails the build regardless of
+#          what else passes.
 # Stage 2: the tier-1 pytest line EXACTLY as ROADMAP.md specifies it,
 #          including the DOTS_PASSED count the driver compares against the
 #          seed. Keep this in sync with ROADMAP.md "Tier-1 verify".
@@ -11,9 +16,8 @@
 set -u
 cd "$(dirname "$0")/.."
 
-echo "== stage 1/2: metrics-name lint =="
-JAX_PLATFORMS=cpu python -m pytest tests/test_metrics_lint.py -q \
-    -p no:cacheprovider || exit $?
+echo "== stage 1/2: static analysis (graftlint) =="
+JAX_PLATFORMS=cpu python -m automerge_tpu.analysis || exit $?
 
 echo "== stage 2/2: tier-1 suite (ROADMAP.md) =="
 set -o pipefail

@@ -1,0 +1,258 @@
+"""graftlint framework tests: suppression comments, baseline round-trip
+and drift-tolerance, the registry pass on fixtures, and the CLI contract
+(exit 0 on the repo with the committed baseline; non-zero when a hazard
+is introduced)."""
+
+import json
+import pathlib
+import textwrap
+
+import pytest
+
+from automerge_tpu.analysis import (
+    Baseline, Finding, load_project, run_passes)
+from automerge_tpu.analysis.__main__ import main as cli_main
+from automerge_tpu.analysis.core import (
+    BASELINE_NAME, apply_suppressions, parse_source)
+from automerge_tpu.analysis.registry import RegistryConformancePass
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _mini_repo(tmp_path, rel, source):
+    """A throwaway project holding one fixture module at `rel`."""
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source))
+    return load_project(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# registry pass on fixtures (positive + negative per rule)
+
+
+def test_registry_flags_unregistered_and_fstring_names(tmp_path):
+    proj = _mini_repo(tmp_path, "automerge_tpu/sync/fix.py", '''\
+        from ..utils import metrics
+
+        def good():
+            metrics.bump("sync_frames_sent")          # registered: ok
+
+        def typo():
+            metrics.bump("sync_frames_snet")          # unregistered
+
+        def indirect():
+            name = "sync_frames_received"
+            metrics.bump(name)                        # resolves: ok
+
+        def fstring(kind):
+            metrics.bump(f"sync_{kind}_sent")         # computed: dynamic
+        ''')
+    rules = {}
+    for f in RegistryConformancePass().run(proj):
+        rules.setdefault(f.rule, []).append(f)
+    assert len(rules.get("metric-unregistered", [])) == 1
+    assert "sync_frames_snet" in rules["metric-unregistered"][0].message
+    assert len(rules.get("metric-dynamic", [])) == 1
+
+
+def test_registry_flags_kind_mismatch_and_retired(tmp_path):
+    proj = _mini_repo(tmp_path, "automerge_tpu/sync/fix.py", '''\
+        from ..utils import metrics
+
+        def wrong_kind():
+            with metrics.trace("sync_frames_sent"):   # a COUNTER traced
+                pass
+
+        def retired():
+            metrics.bump("changes_applied")           # pre-rename name
+        ''')
+    rules = {f.rule for f in RegistryConformancePass().run(proj)}
+    assert "metric-kind" in rules
+    assert "metric-retired" in rules
+
+
+def test_registry_checks_flightrec_kinds_and_bare_imports(tmp_path):
+    proj = _mini_repo(tmp_path, "automerge_tpu/sync/fix.py", '''\
+        from ..utils import flightrec
+        from ..utils.metrics import bump
+
+        def ok():
+            flightrec.record("frame_send", n=1)       # declared kind
+            bump("sync_frames_sent")                  # bare import: checked
+
+        def bad():
+            flightrec.record("frme_send", n=1)        # typo kind
+            bump("sync_frames_snet")                  # typo name
+        ''')
+    rules = {}
+    for f in RegistryConformancePass().run(proj):
+        rules.setdefault(f.rule, []).append(f)
+    assert len(rules.get("flightrec-kind", [])) == 1
+    assert len(rules.get("metric-unregistered", [])) == 1
+
+
+def test_registry_module_constant_survives_local_rebind(tmp_path):
+    """A function-local rebind of a name must not clobber the
+    module-level constant other functions resolve through."""
+    proj = _mini_repo(tmp_path, "automerge_tpu/sync/fix.py", '''\
+        from ..utils import metrics
+
+        NAME = "sync_frames_sent"
+
+        def unrelated():
+            NAME = compute()     # local shadow, different scope
+
+        def uses_constant():
+            metrics.bump(NAME)   # resolves to the module constant: ok
+        ''')
+    assert RegistryConformancePass().run(proj) == []
+
+
+def test_registry_skips_wrapper_parameter_forwarding(tmp_path):
+    proj = _mini_repo(tmp_path, "automerge_tpu/sync/fix.py", '''\
+        from ..utils import metrics
+
+        def wrapper(name):
+            metrics.bump(name)      # plumbing: call sites are checked
+        ''')
+    assert RegistryConformancePass().run(proj) == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+
+
+def test_suppression_comment_silences_rule(tmp_path):
+    proj = _mini_repo(tmp_path, "automerge_tpu/sync/fix.py", '''\
+        from ..utils import metrics
+
+        def a():
+            metrics.bump("not_a_name")  # graftlint: disable=metric-unregistered
+
+        def b():
+            # graftlint: disable=metric-unregistered
+            metrics.bump("also_not_a_name")
+
+        def c():
+            metrics.bump("still_not_a_name")   # NOT suppressed
+        ''')
+    findings = run_passes(proj, [RegistryConformancePass()])
+    assert len(findings) == 1
+    assert "still_not_a_name" in findings[0].message
+
+
+def test_skip_file_marker(tmp_path):
+    proj = _mini_repo(tmp_path, "automerge_tpu/sync/fix.py", '''\
+        # graftlint: skip-file
+        from ..utils import metrics
+
+        def a():
+            metrics.bump("not_a_name")
+        ''')
+    assert run_passes(proj, [RegistryConformancePass()]) == []
+
+
+def test_suppression_only_silences_named_rule(tmp_path):
+    unit = parse_source(tmp_path / "x.py", "x.py",
+                        'a = 1  # graftlint: disable=other-rule\n')
+    proj = load_project(tmp_path)
+    proj.units.append(unit)
+    f = Finding(rule="my-rule", path="x.py", line=1, col=0,
+                severity="error", message="m")
+    assert apply_suppressions(proj, [f]) == [f]
+
+
+# ---------------------------------------------------------------------------
+# baseline
+
+
+def _f(rule="r", path="p.py", line=3, message="m"):
+    return Finding(rule=rule, path=path, line=line, col=0,
+                   severity="error", message=message)
+
+
+def test_baseline_round_trip(tmp_path):
+    findings = [_f(), _f(message="m2"), _f(message="m2")]
+    b = Baseline.from_findings(findings)
+    out = tmp_path / BASELINE_NAME
+    b.save(out)
+    b2 = Baseline.load(out)
+    assert b2.entries == b.entries
+    assert b2.entries[("r", "p.py", "m2")]["count"] == 2
+    grandfathered, new, stale = b2.split(findings)
+    assert (len(grandfathered), new, stale) == (3, [], [])
+
+
+def test_baseline_tolerates_line_drift_but_not_new_findings():
+    b = Baseline.from_findings([_f(line=3)])
+    drifted = _f(line=300)                       # same finding, moved
+    grand, new, stale = b.split([drifted])
+    assert grand == [drifted] and not new and not stale
+    extra = _f(message="brand new")
+    grand, new, stale = b.split([drifted, extra])
+    assert new == [extra]
+
+
+def test_baseline_reports_stale_entries():
+    b = Baseline.from_findings([_f(), _f(message="gone")])
+    grand, new, stale = b.split([_f()])
+    assert ("r", "p.py", "gone") in stale
+
+
+def test_baseline_rewrite_preserves_justifications(tmp_path):
+    out = tmp_path / BASELINE_NAME
+    b = Baseline.from_findings([_f()])
+    b.entries[("r", "p.py", "m")]["justification"] = "deliberate: why"
+    b.save(out)
+    regen = Baseline.from_findings([_f(line=99)], old=Baseline.load(out))
+    assert regen.entries[("r", "p.py", "m")]["justification"] \
+        == "deliberate: why"
+
+
+# ---------------------------------------------------------------------------
+# CLI contract (the acceptance criterion)
+
+
+def test_cli_exits_zero_on_repo_with_committed_baseline(capsys):
+    rc = cli_main(["--root", str(ROOT)])
+    out = capsys.readouterr().out
+    assert rc == 0, f"graftlint is red on the repo:\n{out}"
+    assert "stale baseline" not in out, (
+        f"baseline has stale entries — shrink it:\n{out}")
+
+
+def test_cli_exits_nonzero_when_hazard_introduced(tmp_path, capsys):
+    """A fresh mini-repo with one of each fixture hazard and no baseline:
+    the CLI must fail. With a --write-baseline pass first, it must then
+    exit 0 (the grandfathering workflow)."""
+    src = tmp_path / "automerge_tpu" / "engine" / "hazard.py"
+    src.parent.mkdir(parents=True)
+    src.write_text(textwrap.dedent('''\
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x)          # host sync under jit
+        '''))
+    rc = cli_main(["--root", str(tmp_path)])
+    assert rc == 1
+    assert "jit-host-sync" in capsys.readouterr().out
+    assert cli_main(["--root", str(tmp_path), "--write-baseline"]) == 0
+    assert (tmp_path / BASELINE_NAME).exists()
+    assert cli_main(["--root", str(tmp_path)]) == 0
+
+
+def test_cli_list_shows_grandfathered(tmp_path, capsys):
+    rc = cli_main(["--root", str(ROOT), "--list"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "[baselined]" in out     # the committed deliberate holds show
+
+
+def test_committed_baseline_entries_all_have_justifications():
+    doc = json.loads((ROOT / BASELINE_NAME).read_text())
+    assert doc["version"] == 1
+    for e in doc["findings"]:
+        assert e["justification"].strip(), (
+            f"baseline entry without a justification: {e}")

@@ -1,0 +1,291 @@
+"""jit-hygiene pass tests: fixture snippets per rule, positive and
+negative — host syncs on tracers, branches on tracers, static-argument
+propagation through call edges, retrace hazards, shape-literal drift —
+plus the no-new-findings check against the real repo (everything the pass
+reports there must be either fixed or baselined)."""
+
+import pathlib
+import textwrap
+
+import pytest
+
+from automerge_tpu.analysis import load_project
+from automerge_tpu.analysis.jit_hygiene import JitHygienePass
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _run(tmp_path, source, rel="automerge_tpu/engine/fix.py"):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source))
+    return JitHygienePass().run(load_project(tmp_path))
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# host-sync hazards
+
+
+def test_item_and_scalar_casts_on_tracer_flagged(tmp_path):
+    findings = _run(tmp_path, '''\
+        import jax
+
+        @jax.jit
+        def f(x):
+            a = x.sum()
+            b = a.item()              # host sync
+            c = float(x)              # host sync
+            return b + c
+        ''')
+    assert _rules(findings).count("jit-host-sync") == 2
+
+
+def test_np_asarray_of_tracer_flagged_but_static_ok(tmp_path):
+    findings = _run(tmp_path, '''\
+        from functools import partial
+        import numpy as np
+        import jax
+
+        @partial(jax.jit, static_argnames=("meta",))
+        def f(x, meta):
+            shape = np.asarray(meta)      # static arg: fine
+            y = np.asarray(x)             # tracer readback: flagged
+            return y.reshape(shape)
+        ''')
+    assert _rules(findings).count("jit-host-sync") == 1
+
+
+def test_block_until_ready_in_jit_reachable_code_flagged(tmp_path):
+    findings = _run(tmp_path, '''\
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.block_until_ready()
+        ''')
+    assert "jit-host-sync" in _rules(findings)
+
+
+def test_host_sync_found_through_call_graph(tmp_path):
+    """The hazard sits in a helper that is only reachable FROM a jit
+    root — the reachability walk must still find it."""
+    findings = _run(tmp_path, '''\
+        import jax
+
+        def helper(v):
+            return int(v)             # host sync, but only under jit
+
+        @jax.jit
+        def f(x):
+            return helper(x + 1)
+        ''')
+    assert "jit-host-sync" in _rules(findings)
+
+
+def test_unreachable_helper_not_flagged(tmp_path):
+    findings = _run(tmp_path, '''\
+        import jax
+
+        def host_only(v):
+            return int(v)             # never called from traced code
+
+        @jax.jit
+        def f(x):
+            return x + 1
+        ''')
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# tracer branching
+
+
+def test_branch_on_tracer_flagged_static_branch_ok(tmp_path):
+    findings = _run(tmp_path, '''\
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnames=("flag",))
+        def f(x, flag):
+            if flag:                  # static: fine
+                x = x + 1
+            if x > 0:                 # tracer: flagged
+                x = x - 1
+            return x
+        ''')
+    assert _rules(findings).count("jit-tracer-branch") == 1
+
+
+def test_shape_reads_and_len_are_static(tmp_path):
+    findings = _run(tmp_path, '''\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            if x.shape[0] > 4:        # shapes are python values
+                x = x[:4]
+            n = len(x)
+            for i in range(n):        # static loop
+                x = x + i
+            return jnp.where(x > 0, x, -x)   # device select: fine
+        ''')
+    assert findings == []
+
+
+def test_static_propagates_through_call_edge(tmp_path):
+    """A param that only ever receives static values at call sites from
+    traced code is static in the callee; one traced call site makes it
+    traced."""
+    findings = _run(tmp_path, '''\
+        from functools import partial
+        import jax
+
+        def helper(v, mode):
+            if mode:                  # static at every call site: fine
+                return v + 1
+            return v - 1
+
+        def helper2(v, w):
+            if w:                     # w receives a tracer below: flagged
+                return v
+            return -v
+
+        @partial(jax.jit, static_argnames=("mode",))
+        def f(x, mode):
+            return helper(x, mode) + helper2(x, x * 2)
+        ''')
+    rules = _rules(findings)
+    assert rules.count("jit-tracer-branch") == 1
+
+
+# ---------------------------------------------------------------------------
+# retrace hazards
+
+
+def test_jit_wrapped_inside_function_flagged(tmp_path):
+    findings = _run(tmp_path, '''\
+        import jax
+
+        def apply(arrays):
+            fn = jax.jit(lambda b: b + 1)     # fresh cache per call
+            return fn(arrays)
+        ''')
+    assert "jit-retrace" in _rules(findings)
+
+
+def test_cached_wrapper_builder_not_flagged(tmp_path):
+    findings = _run(tmp_path, '''\
+        import jax
+
+        _CACHE = {}
+
+        def builder(key):
+            fn = _CACHE.get(key)
+            if fn is None:
+                fn = jax.jit(lambda b: b + 1)
+                _CACHE[key] = fn              # memoized: cache survives
+            return fn
+        ''')
+    assert "jit-retrace" not in _rules(findings)
+
+
+def test_module_level_jit_wrap_not_flagged(tmp_path):
+    findings = _run(tmp_path, '''\
+        import jax
+
+        def _impl(b):
+            return b + 1
+
+        f = jax.jit(_impl)
+        ''')
+    assert "jit-retrace" not in _rules(findings)
+
+
+def test_jit_call_expression_honors_static_argnums(tmp_path):
+    """`jax.jit(f, static_argnums=1)` at module level: parameter 1 of f
+    is static, so branching on it is fine (the argnums->name mapping
+    needs the resolved target, not the jit call alone)."""
+    findings = _run(tmp_path, '''\
+        import jax
+
+        def f(x, n):
+            if n > 3:                 # static via static_argnums: fine
+                return x + n
+            return x
+
+        g = jax.jit(f, static_argnums=1)
+        ''')
+    assert "jit-tracer-branch" not in _rules(findings)
+
+
+def test_static_argnames_typo_flagged(tmp_path):
+    findings = _run(tmp_path, '''\
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnames=("metaa",))
+        def f(x, meta):
+            return x
+        ''')
+    assert "jit-retrace" in _rules(findings)
+
+
+# ---------------------------------------------------------------------------
+# shape-literal drift
+
+
+def test_lane_pad_literal_flagged_outside_pack(tmp_path):
+    findings = _run(tmp_path, '''\
+        def pad(n):
+            return ((n + 127) // 128) * 128
+        ''')
+    assert "jit-shape-drift" in _rules(findings)
+
+
+def test_vmem_budget_literal_flagged(tmp_path):
+    findings = _run(tmp_path, '''\
+        BUDGET = 22528
+        ''')
+    assert "jit-shape-drift" in _rules(findings)
+
+
+def test_pack_itself_owns_the_constants(tmp_path):
+    findings = _run(tmp_path, '''\
+        LANE = 128
+        ROWS_VMEM_BUDGET = 22528
+
+        def pad_to_lanes(n):
+            return ((n + LANE - 1) // LANE) * LANE
+        ''', rel="automerge_tpu/engine/pack.py")
+    assert findings == []
+
+
+def test_out_of_scope_modules_ignored(tmp_path):
+    findings = _run(tmp_path, '''\
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x)
+        ''', rel="automerge_tpu/sync/fix.py")
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# the real repo: everything is fixed or baselined
+
+
+def test_repo_jit_findings_are_all_baselined():
+    from automerge_tpu.analysis import Baseline
+    from automerge_tpu.analysis.core import BASELINE_NAME, run_passes
+    proj = load_project(ROOT)
+    findings = run_passes(proj, [JitHygienePass()])
+    baseline = Baseline.load(ROOT / BASELINE_NAME)
+    _, new, _ = baseline.split(findings)
+    assert not new, "new jit-hygiene findings:\n" + "\n".join(
+        f.render() for f in new)

@@ -1,0 +1,364 @@
+"""lock-discipline pass tests: ABBA inversion detection, blocking calls
+under a lock (direct, transitive through methods, and duck-typed engine
+readbacks), the cv.wait exemption, and thread-spawn hygiene — positive
+and negative fixtures, plus the no-new-findings check on the repo."""
+
+import pathlib
+import textwrap
+
+from automerge_tpu.analysis import load_project
+from automerge_tpu.analysis.lock_discipline import LockDisciplinePass
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _run(tmp_path, source, rel="automerge_tpu/sync/fix.py"):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source))
+    return LockDisciplinePass().run(load_project(tmp_path))
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# lock ordering
+
+
+def test_abba_inversion_flagged(tmp_path):
+    findings = _run(tmp_path, '''\
+        import threading
+
+        class Node:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._log_lock = threading.Lock()
+
+            def a_then_b(self):
+                with self._lock:
+                    with self._log_lock:
+                        pass
+
+            def b_then_a(self):
+                with self._log_lock:
+                    with self._lock:
+                        pass
+        ''')
+    assert _rules(findings).count("lock-order") == 1
+    assert "inversion" in findings[_rules(findings).index("lock-order")] \
+        .message
+
+
+def test_consistent_order_not_flagged(tmp_path):
+    findings = _run(tmp_path, '''\
+        import threading
+
+        class Node:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._log_lock = threading.Lock()
+
+            def a_then_b(self):
+                with self._lock:
+                    with self._log_lock:
+                        pass
+
+            def also_a_then_b(self):
+                with self._lock, self._log_lock:
+                    pass
+        ''')
+    assert "lock-order" not in _rules(findings)
+
+
+def test_inversion_found_through_method_call(tmp_path):
+    """b_then_a never syntactically nests the withs — the inner lock is
+    taken by a method it calls while holding the outer."""
+    findings = _run(tmp_path, '''\
+        import threading
+
+        class Node:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._log_lock = threading.Lock()
+
+            def _append(self):
+                with self._lock:
+                    pass
+
+            def a_then_b(self):
+                with self._lock:
+                    with self._log_lock:
+                        pass
+
+            def b_then_a(self):
+                with self._log_lock:
+                    self._append()
+        ''')
+    assert "lock-order" in _rules(findings)
+
+
+# ---------------------------------------------------------------------------
+# blocking under a lock
+
+
+def test_socket_recv_under_lock_flagged(tmp_path):
+    findings = _run(tmp_path, '''\
+        import threading
+
+        class Peer:
+            def __init__(self, sock):
+                self._lock = threading.Lock()
+                self.sock = sock
+
+            def pump(self):
+                with self._lock:
+                    return self.sock.recv(4096)
+        ''')
+    assert "block-under-lock" in _rules(findings)
+
+
+def test_recv_outside_lock_not_flagged(tmp_path):
+    findings = _run(tmp_path, '''\
+        import threading
+
+        class Peer:
+            def __init__(self, sock):
+                self._lock = threading.Lock()
+                self.sock = sock
+
+            def pump(self):
+                data = self.sock.recv(4096)
+                with self._lock:
+                    self.buf = data
+        ''')
+    assert "block-under-lock" not in _rules(findings)
+
+
+def test_thread_join_under_lock_flagged_str_join_not(tmp_path):
+    findings = _run(tmp_path, '''\
+        import threading
+
+        class Owner:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="w")
+
+            def stop(self):
+                with self._lock:
+                    self._thread.join()         # blocking under lock
+                    return ", ".join(["a"])     # string join: fine
+        ''')
+    assert _rules(findings).count("block-under-lock") == 1
+
+
+def test_cv_wait_on_held_condition_exempt_event_wait_not(tmp_path):
+    findings = _run(tmp_path, '''\
+        import threading
+
+        class Monitor:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._stop = threading.Event()
+                self._lock = threading.Lock()
+
+            def park(self):
+                with self._cv:
+                    self._cv.wait(timeout=1.0)   # releases _cv: fine
+
+            def bad(self):
+                with self._lock:
+                    self._stop.wait(1.0)         # holds _lock: flagged
+        ''')
+    assert _rules(findings).count("block-under-lock") == 1
+
+
+def test_device_readback_under_lock_flagged(tmp_path):
+    """The r5 stall class: a duck-typed engine hash read under the
+    service lock."""
+    findings = _run(tmp_path, '''\
+        import threading
+
+        class Service:
+            def __init__(self, engine):
+                self._lock = threading.Lock()
+                self._engine = engine
+
+            def hash_table(self):
+                with self._lock:
+                    return self._engine.hashes()
+        ''')
+    assert "block-under-lock" in _rules(findings)
+    msg = findings[_rules(findings).index("block-under-lock")].message
+    assert "r5" in msg
+
+
+def test_transitive_block_through_module_function(tmp_path):
+    findings = _run(tmp_path, '''\
+        import threading
+
+        def push(sock, data):
+            sock.sendall(data)
+
+        class Peer:
+            def __init__(self, sock):
+                self._send_lock = threading.Lock()
+                self.sock = sock
+
+            def send(self, data):
+                with self._send_lock:
+                    push(self.sock, data)
+        ''')
+    assert "block-under-lock" in _rules(findings)
+
+
+def test_super_call_reaches_base_class_footprint(tmp_path):
+    """super().m() must resolve to the BASE method (the override calling
+    it would be skipped by Python too) — the LockedConnection pattern:
+    a lock wrapper holding its lock across the base implementation."""
+    findings = _run(tmp_path, '''\
+        import threading
+        import time
+
+        class Base:
+            def step(self):
+                time.sleep(1.0)
+
+        class Locked(Base):
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def step(self):
+                with self._lock:
+                    super().step()
+        ''')
+    assert "block-under-lock" in _rules(findings)
+
+
+def test_nested_thread_target_not_attributed_to_spawner(tmp_path):
+    """A closure spawned as a Thread target runs on ANOTHER thread: its
+    blocking calls must not make the spawning method look blocking to
+    callers that hold a lock."""
+    findings = _run(tmp_path, '''\
+        import threading
+
+        class Owner:
+            def __init__(self, sock):
+                self._lock = threading.Lock()
+                self.sock = sock
+
+            def spawn(self):
+                def worker():
+                    self.sock.recv(4096)     # runs on the worker thread
+                t = threading.Thread(target=worker, daemon=True, name="w")
+                t.start()
+
+            def guarded(self):
+                with self._lock:
+                    self.spawn()             # spawn itself never blocks
+        ''')
+    assert "block-under-lock" not in _rules(findings)
+
+
+def test_audit_serving_readback_is_engine_read(tmp_path):
+    findings = _run(tmp_path, '''\
+        import threading
+
+        class Conn:
+            def __init__(self, ds):
+                self._lock = threading.Lock()
+                self.ds = ds
+
+            def serve(self, msg):
+                with self._lock:
+                    return self.ds.audit_state()   # full hash fan-out
+        ''')
+    assert "block-under-lock" in _rules(findings)
+
+
+# ---------------------------------------------------------------------------
+# thread hygiene
+
+
+def test_thread_without_daemon_or_name_flagged(tmp_path):
+    findings = _run(tmp_path, '''\
+        import threading
+
+        def spawn():
+            t = threading.Thread(target=print)
+            t.start()
+            return t
+        ''')
+    rules = _rules(findings)
+    assert "thread-daemon" in rules
+    assert "thread-name" in rules
+
+
+def test_named_daemon_thread_clean(tmp_path):
+    findings = _run(tmp_path, '''\
+        import threading
+
+        def spawn():
+            t = threading.Thread(target=print, daemon=True, name="amtpu-x")
+            t.start()
+            return t
+        ''')
+    assert findings == []
+
+
+def test_nondaemon_thread_needs_a_join(tmp_path):
+    flagged = _run(tmp_path, '''\
+        import threading
+
+        def spawn():
+            t = threading.Thread(target=print, daemon=False, name="x")
+            t.start()
+        ''')
+    assert "thread-join" in _rules(flagged)
+
+
+def test_nondaemon_thread_with_join_clean(tmp_path):
+    findings = _run(tmp_path, '''\
+        import threading
+
+        def spawn_and_wait():
+            t = threading.Thread(target=print, daemon=False, name="x")
+            t.start()
+            t.join()
+        ''')
+    assert "thread-join" not in _rules(findings)
+
+
+def test_out_of_scope_modules_ignored(tmp_path):
+    findings = _run(tmp_path, '''\
+        import threading
+
+        def spawn():
+            threading.Thread(target=print).start()
+        ''', rel="automerge_tpu/engine/fix.py")
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# the real repo: everything is fixed or baselined
+
+
+def test_repo_lock_findings_are_all_baselined():
+    from automerge_tpu.analysis import Baseline
+    from automerge_tpu.analysis.core import BASELINE_NAME, run_passes
+    proj = load_project(ROOT)
+    findings = run_passes(proj, [LockDisciplinePass()])
+    baseline = Baseline.load(ROOT / BASELINE_NAME)
+    _, new, _ = baseline.split(findings)
+    assert not new, "new lock-discipline findings:\n" + "\n".join(
+        f.render() for f in new)
+
+
+def test_repo_tcp_threads_are_named():
+    """The PR's triage fixes stay fixed: the tcp reader/accept threads
+    carry amtpu- names the flight recorder can key on."""
+    src = (ROOT / "automerge_tpu" / "sync" / "tcp.py").read_text()
+    assert "amtpu-tcp-read" in src
+    assert "amtpu-tcp-accept" in src

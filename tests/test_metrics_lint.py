@@ -1,77 +1,66 @@
-"""Metric-name lint: every literal metric name used by the package must be
-declared in the utils.metrics registries (REGISTRY or ALIASES). An
-unregistered name is a typo or a naming-scheme violation — either way it
-produces a series nobody can find in docs/OBSERVABILITY.md, which is how
-instrumentation rots. Runs as an ordinary tier-1 test (cheap: one regex
-pass over the source tree, no jax work)."""
+"""Metric-name lint: every metric/span name used by the package must be
+declared in the utils.metrics registries, and every flight-recorder event
+kind in flightrec.EVENT_KINDS. An unregistered name is a typo or a
+naming-scheme violation — either way it produces a series nobody can find
+in docs/OBSERVABILITY.md, which is how instrumentation rots.
+
+This used to be one regex pass; it is now the AST-based registry pass of
+the graftlint suite (automerge_tpu/analysis/registry.py), which also
+catches what the regex could not: f-string names, variable indirection,
+bare `bump()` calls in modules importing it directly, and KIND mismatches
+(a counter name handed to trace()). The test names/IDs are unchanged so
+tier-1 history stays comparable. Runs as an ordinary tier-1 test (cheap:
+one AST pass over the source tree, no jax dispatch work)."""
 
 import pathlib
-import re
 
+import pytest
+
+from automerge_tpu.analysis import load_project
+from automerge_tpu.analysis.registry import (
+    RETIRED_METRIC_NAMES, RegistryConformancePass, extract_uses,
+    registry_scheme_problems)
 from automerge_tpu.utils import metrics
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 
-# metrics.bump("name"...), metrics.trace("name"...), metrics.gauge(...),
-# metrics.observe(...), metrics.watchdog(...), metrics.dispatch_jit("kernel"
-# is a label, not a metric name, so it is not matched here.
-_CALL = re.compile(
-    r"metrics\.(?:bump|trace|gauge|observe|watchdog)\(\s*\n?\s*"
-    r"[\"']([A-Za-z0-9_]+)[\"']")
 
-_SOURCES = [ROOT / "bench.py", *sorted(
-    (ROOT / "automerge_tpu").rglob("*.py"))]
-
-_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
-_LAYERS = ("core_", "engine_", "rows_", "sync_", "obs_")
+@pytest.fixture(scope="module")
+def project():
+    return load_project(ROOT)
 
 
-def _used_names():
-    out = []
-    for path in _SOURCES:
-        for m in _CALL.finditer(path.read_text()):
-            out.append((path.relative_to(ROOT), m.group(1)))
-    return out
+@pytest.fixture(scope="module")
+def findings(project):
+    return RegistryConformancePass().run(project)
 
 
-def test_package_metric_names_are_registered():
-    used = _used_names()
-    assert used, "lint regex matched nothing — did the call syntax change?"
-    known = set(metrics.REGISTRY) | set(metrics.ALIASES)
-    unknown = [(str(p), n) for p, n in used if n not in known]
-    assert not unknown, (
-        f"unregistered metric names {unknown}: declare them in "
+def test_package_metric_names_are_registered(project, findings):
+    used = extract_uses(project)
+    assert used, "the lint extracted no call sites — did the API change?"
+    bad = [f.render() for f in findings
+           if f.rule in ("metric-unregistered", "metric-kind",
+                         "flightrec-kind", "metric-dynamic",
+                         "flightrec-dynamic")]
+    assert not bad, (
+        "unregistered/misdeclared observability names — declare them in "
         "automerge_tpu/utils/metrics.py (COUNTERS/GAUGES/HISTOGRAMS/SPANS) "
-        "per the <layer>_<noun>_<verb> scheme in docs/OBSERVABILITY.md")
+        "or flightrec.EVENT_KINDS per docs/OBSERVABILITY.md:\n"
+        + "\n".join(bad))
 
 
-# The pre-scheme names retired by the rename (and their one-release alias
-# window, now closed). A call site reintroducing one would silently mint a
-# fresh series nobody reads.
-_RETIRED = {
-    "changes_applied", "ops_applied", "diffs_emitted",
-    "bulkload_fallback_keyerror", "host_bulk_built", "rows_compacted",
-    "rows_rebuilt_from_log", "rows_poisoned", "log_horizon_truncations",
-    "wire_frames_received", "log_archive_cold_reads",
-    "log_archived_changes", "log_archive_torn_tail_repaired",
-    "log_archive_torn_tail_skipped",
-}
-
-
-def test_package_call_sites_use_canonical_names():
+def test_package_call_sites_use_canonical_names(findings):
     """The alias window is over: no call site may use a retired pre-rename
     name (or anything left in the — now empty — compat ALIASES table)."""
-    bad = _RETIRED | set(metrics.ALIASES)
-    stale = [(str(p), n) for p, n in _used_names() if n in bad]
-    assert not stale, f"call sites on retired pre-rename names: {stale}"
+    stale = [f.render() for f in findings if f.rule == "metric-retired"]
+    assert not stale, ("call sites on retired pre-rename names:\n"
+                       + "\n".join(stale))
+    # the retired set is still what the migration retired, and any compat
+    # alias points at a registered canonical name
+    assert "changes_applied" in RETIRED_METRIC_NAMES
+    for old, new in metrics.ALIASES.items():
+        assert new in metrics.REGISTRY, (old, new)
 
 
 def test_registry_names_follow_scheme():
-    for name in metrics.REGISTRY:
-        assert _NAME_RE.match(name), f"invalid metric name {name!r}"
-        assert name.startswith(_LAYERS), (
-            f"{name!r} lacks a layer prefix {_LAYERS} "
-            "(<layer>_<noun>_<verb>, docs/OBSERVABILITY.md)")
-    # aliases point at registered canonical names
-    for old, new in metrics.ALIASES.items():
-        assert new in metrics.REGISTRY, (old, new)
+    assert registry_scheme_problems() == []
