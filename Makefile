@@ -1,7 +1,7 @@
 # Convenience targets; the source of truth for the tier-1 line is
 # ROADMAP.md ("Tier-1 verify"), mirrored in scripts/verify.sh.
 
-.PHONY: verify analyze lint test bench
+.PHONY: verify analyze lint test bench perfcheck perfreport
 
 # The pre-merge gate: static analysis + the full tier-1 suite with the
 # DOTS_PASSED count the driver compares against the seed.
@@ -26,3 +26,13 @@ test:
 # The benchmark harness (never crashes; one FINAL JSON line).
 bench:
 	python bench.py
+
+# The perf regression gate: the latest bench_history.jsonl record vs the
+# rolling same-backend median. Nonzero exit on throughput regression or
+# compile-count growth (docs/OBSERVABILITY.md "Performance plane").
+perfcheck:
+	JAX_PLATFORMS=cpu python -m automerge_tpu.perf check
+
+# The bench-history trajectory + latest compile telemetry, human-readable.
+perfreport:
+	JAX_PLATFORMS=cpu python -m automerge_tpu.perf report

@@ -47,9 +47,15 @@ from dataclasses import dataclass
 
 from ..utils import flightrec as _flightrec
 from ..utils import metrics as _metrics
+from ..utils import perfscope as _perfscope
 from .core import Finding, Project, SourceUnit, dotted_name
 
 METRIC_FUNCS = ("bump", "gauge", "observe", "trace", "watchdog", "add_time")
+
+# perfscope phase-attribution call forms (ctx manager + decorator); names
+# are checked against perfscope.PHASES the same way metric names are
+# checked against metrics.REGISTRY
+PHASE_FUNCS = ("phase", "phased")
 
 _KIND_TABLE = {
     "bump": ("counter", lambda m: m.COUNTERS),
@@ -62,6 +68,7 @@ _KIND_TABLE = {
 
 _METRICS_MODULE = "automerge_tpu.utils.metrics"
 _FLIGHTREC_MODULE = "automerge_tpu.utils.flightrec"
+_PERFSCOPE_MODULE = "automerge_tpu.utils.perfscope"
 
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 LAYER_PREFIXES = ("core_", "engine_", "rows_", "sync_", "obs_")
@@ -216,12 +223,14 @@ def extract_uses(project: Project) -> list[MetricUse]:
         enclosing = _enclosing_func_map(unit)
         is_metrics_mod = unit.modname == _METRICS_MODULE
         is_flightrec_mod = unit.modname == _FLIGHTREC_MODULE
+        is_perfscope_mod = unit.modname == _PERFSCOPE_MODULE
 
         for node in ast.walk(unit.tree):
             if not isinstance(node, ast.Call) or not node.args:
                 continue
             api = _classify_call(node, aliases,
-                                 is_metrics_mod, is_flightrec_mod)
+                                 is_metrics_mod, is_flightrec_mod,
+                                 is_perfscope_mod)
             if api is None:
                 continue
             host = enclosing.get(id(node))
@@ -250,10 +259,10 @@ def extract_uses(project: Project) -> list[MetricUse]:
 
 
 def _classify_call(node: ast.Call, aliases: dict[str, str],
-                   is_metrics_mod: bool, is_flightrec_mod: bool
-                   ) -> str | None:
+                   is_metrics_mod: bool, is_flightrec_mod: bool,
+                   is_perfscope_mod: bool = False) -> str | None:
     """"bump"/"trace"/... for a metrics call, "record" for a flightrec
-    call, None otherwise."""
+    call, "phase" for a perfscope phase/phased call, None otherwise."""
     fn = node.func
     if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
         target = aliases.get(fn.value.id, fn.value.id)
@@ -265,6 +274,10 @@ def _classify_call(node: ast.Call, aliases: dict[str, str],
                 target == _FLIGHTREC_MODULE or target == "flightrec"
                 or target.endswith(".flightrec")):
             return "record"
+        if fn.attr in PHASE_FUNCS and (
+                target == _PERFSCOPE_MODULE or target == "perfscope"
+                or target.endswith(".perfscope")):
+            return "phase"
         return None
     if isinstance(fn, ast.Name):
         target = aliases.get(fn.id)
@@ -276,6 +289,10 @@ def _classify_call(node: ast.Call, aliases: dict[str, str],
                 is_flightrec_mod
                 or (target or "") == _FLIGHTREC_MODULE + ".record"):
             return "record"
+        if fn.id in PHASE_FUNCS and (
+                is_perfscope_mod
+                or (target or "").startswith(_PERFSCOPE_MODULE + ".")):
+            return "phase"
     return None
 
 
@@ -304,11 +321,14 @@ class RegistryConformancePass:
         known = set(_metrics.REGISTRY) | set(_metrics.ALIASES)
         event_kinds = set(getattr(_flightrec, "EVENT_KINDS", ()))
 
+        phases = set(getattr(_perfscope, "PHASES", ()))
+
         for use in extract_uses(project):
             if use.name is None:
                 if use.dynamic_reason is None:
                     continue
                 rule = ("flightrec-dynamic" if use.api == "record"
+                        else "phase-dynamic" if use.api == "phase"
                         else "metric-dynamic")
                 findings.append(Finding(
                     rule=rule, path=use.path, line=use.line, col=use.col,
@@ -317,6 +337,17 @@ class RegistryConformancePass:
                              f"statically: {use.dynamic_reason} (use a "
                              "registered literal, or suppress with a "
                              "justification)")))
+                continue
+            if use.api == "phase":
+                if use.name not in phases:
+                    findings.append(Finding(
+                        rule="phase-unregistered", path=use.path,
+                        line=use.line, col=use.col, severity="error",
+                        message=(f"phase name {use.name!r} is not "
+                                 "declared in perfscope.PHASES — the "
+                                 "cross-layer wall-time rollup can only "
+                                 "be read against documented phases "
+                                 "(docs/OBSERVABILITY.md)")))
                 continue
             if use.api == "record":
                 if use.name not in event_kinds:

@@ -660,6 +660,10 @@ class OpSet:
         # causal-queue depth after the batch: a growing gauge means peers
         # are delivering out of causal order (or a dep will never arrive)
         metrics.gauge("core_queue_depth", len(b.queue))
+        # coarse host-object estimate (change header + per-op records);
+        # exact sizeof walks would cost more than the queue is worth
+        metrics.gauge("core_queue_bytes",
+                      sum(120 + 80 * len(c.ops) for c in b.queue))
         return self.freeze(b), diffs
 
     # -- change-graph queries (op_set.js:299-330) ---------------------------

@@ -20,6 +20,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..utils import perfscope
+
 # field order is the wire contract
 FIELDS = ("op_mask", "action", "fid", "actor", "seq", "change_idx", "value",
           "fid_hash", "value_hash", "clock", "ins_mask", "ins_elem",
@@ -39,6 +41,7 @@ def pad_to_lanes(n: int) -> int:
     return ((n + LANE - 1) // LANE) * LANE
 
 
+@perfscope.phased("pack")
 def pack_batch(batch: dict) -> tuple[np.ndarray, tuple]:
     """Flatten a stacked batch into (flat int32 buffer, static meta).
 
@@ -159,6 +162,7 @@ def rows_eligible(batch: dict, max_fids: int) -> bool:
     return rows_dims_eligible_xl(i, a, l * e)
 
 
+@perfscope.phased("pack")
 def pack_rows(batch: dict, max_fids: int) -> tuple[np.ndarray, tuple, int]:
     """Repack a stacked batch (docs-major dict) into the docs-minor
     [ROWS, D_pad] int32 row buffer + static dims for reconcile_rows_hash.
@@ -383,6 +387,7 @@ def apply_rows_hash_compact(b8, b16, b32, meta: tuple, dims: tuple,
     return reconcile_rows_hash.__wrapped__(rows, dims, interpret)
 
 
+@perfscope.phased("pack")
 def pack_rows_bytes(batch: dict, max_fids: int):
     """The compact wire as ONE contiguous uint8 buffer (the three dtype
     groups back to back, row-major). A multi-pass timed region can then

@@ -35,7 +35,7 @@ import jax.numpy as jnp
 
 from ..core.change import Change
 from ..core.ids import ROOT_ID, HEAD, make_elem_id
-from ..utils import flightrec, metrics
+from ..utils import flightrec, metrics, perfscope
 from .encode import (A_DEL, A_INS, A_LINK, A_MAKE_LIST, A_MAKE_MAP,
                      A_MAKE_TEXT, A_SET, ASSIGN_CODES, _ACTION_CODE,
                      ValueTable, content_hash, value_hash_of, _pad_to)
@@ -854,7 +854,18 @@ class ResidentDocSet:
             # breadcrumb before the readback barrier (see rows engine)
             flightrec.record("engine_hash_readback",
                              docs=len(self.doc_ids))
-            return np.asarray(self._out["hash"])[:len(self.doc_ids)]
+            metrics.gauge("engine_resident_bytes", self.resident_bytes())
+            with perfscope.phase("readback"):
+                return np.asarray(self._out["hash"])[:len(self.doc_ids)]
+
+    def resident_bytes(self) -> int:
+        """Footprint of the docs-major resident state tables (bytes). Set
+        as the `engine_resident_bytes` gauge at each reconcile so flight-
+        recorder post-mortems carry the memory picture."""
+        total = 0
+        for v in self.state.values():
+            total += int(getattr(v, "nbytes", 0) or 0)
+        return total
 
     def hashes(self) -> np.ndarray:
         """Per-doc state hashes, reusing the cached reconcile output when no

@@ -32,7 +32,7 @@ from .encode import _pad_to, content_hash
 from .resident import ResidentDocSet
 from .pack import pad_to_lanes
 from .pallas_kernels import reconcile_rows_hash
-from ..utils import flightrec, metrics
+from ..utils import flightrec, metrics, perfscope
 
 
 
@@ -950,7 +950,8 @@ class ResidentRowsDocSet(ResidentDocSet):
             "scan_rounds", _scan_rounds,
             self.rows_dev, self._to_dev(stacked), self.dims(), interpret)
         self._hash_handle = hashes[-1]
-        return np.asarray(hashes)[:, :len(self.doc_ids)]
+        with perfscope.phase("readback"):
+            return np.asarray(hashes)[:, :len(self.doc_ids)]
 
     # ------------------------------------------------------------------
     # native columnar ingress
@@ -1753,7 +1754,20 @@ class ResidentRowsDocSet(ResidentDocSet):
             # already show this thread entered the readback
             flightrec.record("rows_hash_readback", docs=len(self.doc_ids),
                              cached=cached)
-            return np.asarray(h)[:len(self.doc_ids)]
+            metrics.gauge("rows_resident_bytes", self.resident_bytes())
+            with perfscope.phase("readback"):
+                return np.asarray(h)[:len(self.doc_ids)]
+
+    def resident_bytes(self) -> int:
+        """Footprint of this engine's resident state: the host row mirror,
+        the device buffer (same layout), and the per-doc admission
+        counters. The memory gauge (`rows_resident_bytes`) and flight-
+        recorder post-mortems carry this number."""
+        total = int(self.rows_host.nbytes)
+        if self.rows_dev is not None:
+            total += int(self.rows_host.nbytes)   # device copy, same layout
+        total += int(self.op_count.nbytes) + int(self.change_count.nbytes)
+        return total
 
     def compact(self, floors: dict[str, dict[str, int]],
                 pins: dict[str, set] | None = None) -> dict[str, dict]:

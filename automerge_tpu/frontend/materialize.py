@@ -14,6 +14,7 @@ from typing import Any
 from ..core import opset as O
 from ..core.ids import ROOT_ID
 from ..core.opset import Link, OpSet
+from ..utils import perfscope
 from .snapshots import DocState, FrozenList, FrozenMap, RootMap
 from .text import Text
 
@@ -144,15 +145,16 @@ def apply_changes_to_doc(doc, opset: OpSet, changes, incremental: bool,
     are diff-driven, op_set.js:105-129)."""
     if not emit_diffs and incremental:
         raise ValueError("emit_diffs=False requires incremental=False")
-    new_opset, diffs = opset.add_changes(changes, emit_diffs=emit_diffs)
-    if getattr(doc._doc, "frontend", "frozen") == "immutable":
-        # The immutable-view frontend re-instantiates from the opset (the
-        # reference's ImmutableAPI likewise refreshes rather than patches,
-        # immutable_api.js:45-50).
-        from .immutable_view import materialize_immutable_root
-        return materialize_immutable_root(doc._doc.actor_id, new_opset)
-    if incremental:
-        cache = update_cache(new_opset, diffs, doc._doc.cache)
-    else:
-        cache = {}
-    return build_root(doc._doc.actor_id, new_opset, cache)
+    with perfscope.phase("host_materialize"):
+        new_opset, diffs = opset.add_changes(changes, emit_diffs=emit_diffs)
+        if getattr(doc._doc, "frontend", "frozen") == "immutable":
+            # The immutable-view frontend re-instantiates from the opset
+            # (the reference's ImmutableAPI likewise refreshes rather than
+            # patches, immutable_api.js:45-50).
+            from .immutable_view import materialize_immutable_root
+            return materialize_immutable_root(doc._doc.actor_id, new_opset)
+        if incremental:
+            cache = update_cache(new_opset, diffs, doc._doc.cache)
+        else:
+            cache = {}
+        return build_root(doc._doc.actor_id, new_opset, cache)

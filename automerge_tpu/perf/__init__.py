@@ -1,0 +1,23 @@
+"""automerge_tpu.perf — the performance plane's tooling package.
+
+`python -m automerge_tpu.perf {report,check,roofline,resident}`:
+
+- `report`   — print the bench-history trajectory (`bench_history.jsonl`)
+               plus the latest run's perf telemetry when available.
+- `check`    — the regression gate: current run vs the rolling
+               same-backend median; nonzero exit on throughput regression
+               or compile-count growth (history.py).
+- `roofline` — HBM-roofline probe for the rows megakernel (the former
+               repo-root `profile_roofline.py`, now packaged; the script
+               remains as a thin shim).
+- `resident` — stage breakdown of the round-frame resident ingress (the
+               former `profile_resident.py`, likewise packaged).
+
+The runtime half of the performance plane (compile telemetry, phase
+attribution, memory gauges) lives in `automerge_tpu/utils/perfscope.py`;
+this package is the offline/CLI half. `history` is deliberately
+pure-stdlib so `bench.py`'s jax-free parent process can load it by file
+path. See docs/OBSERVABILITY.md "Performance plane".
+"""
+
+from . import history  # noqa: F401  (stdlib-only; safe to import eagerly)

@@ -1,0 +1,136 @@
+"""CLI for the performance plane: `python -m automerge_tpu.perf
+{report,check,roofline,resident}` (docs/OBSERVABILITY.md "Performance
+plane").
+
+Exit codes: 0 = ok (including a gracefully skipped check), 1 = the
+regression gate tripped, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import history
+
+
+def _cmd_check(argv) -> int:
+    ap = argparse.ArgumentParser(prog="automerge_tpu.perf check")
+    ap.add_argument("--history", default=None,
+                    help="path to bench_history.jsonl "
+                         "(default: repo root)")
+    ap.add_argument("--record", default=None,
+                    help="judge this JSON record file instead of the last "
+                         "history entry (it is compared against the whole "
+                         "file)")
+    ap.add_argument("--window", type=int, default=history.DEFAULT_WINDOW)
+    ap.add_argument("--threshold-pct", type=float,
+                    default=history.DEFAULT_THRESHOLD_PCT,
+                    help="fail when throughput drops below "
+                         "(1 - pct/100) x rolling median")
+    ap.add_argument("--compile-growth-pct", type=float,
+                    default=history.DEFAULT_COMPILE_GROWTH_PCT,
+                    help="fail when total compiles exceed the rolling "
+                         "median by more than pct (+2 absolute slack)")
+    ap.add_argument("--no-backfill", action="store_true",
+                    help="do not create the history file from the "
+                         "committed BENCH_r0*.json captures when missing")
+    args = ap.parse_args(argv)
+
+    path = args.history or history.history_path()
+    if not args.no_backfill and not os.path.exists(path):
+        n = history.ensure_backfilled(path=path)
+        if n:
+            print(f"perf check: backfilled {n} records from committed "
+                  f"BENCH_r0*.json captures -> {path}")
+    record = None
+    if args.record:
+        try:
+            with open(args.record) as f:
+                record = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"perf check: cannot read --record {args.record}: {e}",
+                  file=sys.stderr)
+            return 2
+        if "schema" not in record:   # a raw bench final/compact record
+            record = history.record_from_bench(record, source=args.record)
+    rc, lines = history.check(
+        path=path, record=record, window=args.window,
+        threshold_pct=args.threshold_pct,
+        compile_growth_pct=args.compile_growth_pct)
+    print("\n".join(lines))
+    print("PERFCHECK", "FAIL" if rc else "OK")
+    return rc
+
+
+def _cmd_report(argv) -> int:
+    ap = argparse.ArgumentParser(prog="automerge_tpu.perf report")
+    ap.add_argument("--history", default=None)
+    ap.add_argument("--no-backfill", action="store_true")
+    args = ap.parse_args(argv)
+    path = args.history or history.history_path()
+    if not args.no_backfill and not os.path.exists(path):
+        history.ensure_backfilled(path=path)
+    records = history.load(path)
+    if not records:
+        print("perf report: no history "
+              f"({path} is missing or empty; run bench.py)")
+        return 0
+    print(f"# bench history — {len(records)} records ({path})")
+    print(f"{'#':>3} {'source':<28} {'backend':<8} "
+          f"{'ops/sec':>12} {'vs_base':>8}  configs(speedup)")
+    for i, r in enumerate(records):
+        cfgs = r.get("configs") or {}
+        cfg_s = " ".join(
+            f"{c}:{(cfgs[c] or {}).get('speedup')}"
+            for c in sorted(cfgs, key=lambda c: (len(c), c))
+            if (cfgs[c] or {}).get("speedup") is not None)
+        value = r.get("value")
+        print(f"{i:>3} {str(r.get('source', '?'))[:28]:<28} "
+              f"{str(r.get('backend', '?')):<8} "
+              f"{value if value is not None else '-':>12} "
+              f"{str(r.get('vs_baseline', '-')):>8}  {cfg_s}")
+    last = records[-1]
+    perf = last.get("perf")
+    if perf:
+        print(f"# latest perf: {perf.get('compiles_total')} compiles "
+              f"across {len(perf.get('kernels') or {})} kernels: "
+              + ", ".join(f"{k}={v}"
+                          for k, v in sorted(
+                              (perf.get("kernels") or {}).items())))
+    # the in-repo detail sidecar, when the last bench run left one
+    detail = os.path.join(os.path.dirname(path), "BENCH_DETAIL.json")
+    if os.path.exists(detail):
+        print(f"# full per-config breakdown: {detail}")
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    commands = {
+        "check": _cmd_check,
+        "report": _cmd_report,
+    }
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0 if argv else 2
+    cmd, rest = argv[0], argv[1:]
+    if cmd in commands:
+        return commands[cmd](rest)
+    if cmd == "roofline":
+        from . import roofline
+        roofline.main(rest)
+        return 0
+    if cmd == "resident":
+        from . import resident
+        resident.main(rest)
+        return 0
+    print(f"unknown command {cmd!r}; expected one of "
+          "report, check, roofline, resident", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

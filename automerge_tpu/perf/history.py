@@ -1,0 +1,321 @@
+"""Bench-history ledger + regression gate (`bench_history.jsonl`).
+
+The committed `BENCH_r0*.json` files are a performance trajectory nothing
+compares against — a throughput regression ships silently as long as the
+suite stays green. This module gives the trajectory a durable, append-only
+home and a gate:
+
+- **`bench_history.jsonl`** (repo root): one JSON record per bench run,
+  appended by `bench.py` after every complete invocation. Backfilled once
+  from the committed `BENCH_r0*.json` driver captures (`ensure_backfilled`)
+  so the gate has a baseline from day one.
+- **`python -m automerge_tpu.perf check`**: compares the most recent run
+  against the rolling median of prior runs **on the same backend** (a CPU
+  fallback run must never be judged against TPU history — the
+  backend-labeling rule, docs/OBSERVABILITY.md "Performance plane") and
+  exits nonzero on a throughput regression or compile-count growth.
+
+Record schema (one line of `bench_history.jsonl`, schema 1):
+
+    {
+      "schema": 1,
+      "at": <epoch seconds>,
+      "source": "bench.py" | "backfill:BENCH_r04.json",
+      "backend": "cpu" | "tpu" | "none",
+      "headline_config": "5",   # which config produced `value` (partial
+                                # runs fall back to another config; the
+                                # gate only compares like with like)
+      "value": <headline engine ops/sec (config 5)>,
+      "unit": "ops/sec",
+      "vs_baseline": <headline speedup>,
+      "configs": {"<cfg>": {"speedup": .., "engine_ops_per_s": ..}},
+      "perf": {"compiles_total": <n>, "kernels": {"<kernel>": <compiles>}},
+      "metrics": {<bench _metrics_rollup, when available>}
+    }
+
+Backfilled records carry whatever the driver capture preserved (compact
+records have per-config speedups only; no `perf` section), and the gate
+skips any comparison whose inputs are missing on either side — it never
+invents a baseline.
+
+IMPORTANT: this module must stay pure-stdlib and free of package-relative
+imports. `bench.py`'s parent process loads it by file path
+(importlib.util.spec_from_file_location) because importing the
+`automerge_tpu` package initializes jax, which the parent must never do
+(the tunneled backend can hang during init).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import statistics
+import time
+
+SCHEMA = 1
+HISTORY_BASENAME = "bench_history.jsonl"
+
+#: gate defaults (docs/OBSERVABILITY.md "Performance plane"). A fresh run
+#: fails when its throughput drops below (1 - threshold/100) x the rolling
+#: same-backend median — 35% absorbs the measured run-to-run jitter of the
+#: CPU fallback records while a 2x regression (ratio 0.5) still trips —
+#: or when its total compile count exceeds the median by more than
+#: growth/100 (+2 absolute slack for one-off warmup variance).
+DEFAULT_WINDOW = 8
+DEFAULT_THRESHOLD_PCT = 35.0
+DEFAULT_COMPILE_GROWTH_PCT = 50.0
+
+
+def repo_root() -> str:
+    """The repo root this module is installed under (…/automerge_tpu/perf/
+    history.py -> three levels up)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def history_path(root: str | None = None) -> str:
+    return os.path.join(root or repo_root(), HISTORY_BASENAME)
+
+
+def load(path: str | None = None) -> list[dict]:
+    """All parseable records, file order (oldest first). Unparseable lines
+    are skipped — a torn tail from a killed run must not wedge the gate."""
+    path = path or history_path()
+    out: list[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        return []
+    return out
+
+
+def append(record: dict, path: str | None = None) -> str:
+    path = path or history_path()
+    with open(path, "a") as f:
+        f.write(json.dumps(record, sort_keys=True) + "\n")
+    return path
+
+
+# ---------------------------------------------------------------------------
+# record construction
+
+
+def _norm_configs(raw) -> dict:
+    """Normalize a bench record's `configs` section: full records map each
+    config to a dict, compact/driver records to a bare speedup float."""
+    out: dict = {}
+    if not isinstance(raw, dict):
+        return out
+    for cfg, v in raw.items():
+        if isinstance(v, dict):
+            entry = {k: v[k] for k in ("speedup", "engine_ops_per_s",
+                                       "device_speedup", "backend")
+                     if isinstance(v.get(k), (int, float, str))}
+        elif isinstance(v, (int, float)):
+            entry = {"speedup": v}
+        else:
+            entry = {}
+        out[str(cfg)] = entry
+    return out
+
+
+def _headline_config(configs: dict, value) -> str | None:
+    """Which config produced the record's headline `value`. A full run's
+    headline is config 5; a partial run falls back to whatever config
+    produced throughput (bench._final_record) — the gate must never judge
+    one against the other. Matched by ops/sec when the per-config numbers
+    are present, else by the headline config's presence."""
+    if isinstance(value, (int, float)):
+        for cfg, v in configs.items():
+            if (v or {}).get("engine_ops_per_s") == value:
+                return cfg
+    if "5" in configs:
+        return "5"
+    return ",".join(sorted(configs, key=lambda c: (len(c), c))) or None
+
+
+def _perf_from_configs(raw_configs) -> dict | None:
+    """Aggregate per-kernel compile counts out of the per-config metrics
+    snapshots a full bench record carries (`configs.<n>.metrics.perf`)."""
+    kernels: dict[str, int] = {}
+    if not isinstance(raw_configs, dict):
+        return None
+    for v in raw_configs.values():
+        perf = (((v or {}).get("metrics") or {}).get("perf")
+                if isinstance(v, dict) else None)
+        for k, st in ((perf or {}).get("kernels") or {}).items():
+            c = st.get("compiles") if isinstance(st, dict) else None
+            if isinstance(c, int):
+                kernels[k] = kernels.get(k, 0) + c
+    if not kernels:
+        return None
+    return {"compiles_total": sum(kernels.values()), "kernels": kernels}
+
+
+def record_from_bench(rec: dict, source: str = "bench.py",
+                      at: float | None = None,
+                      metrics_rollup: dict | None = None) -> dict:
+    """Build one history record from a bench final record (full `rec` from
+    bench._final_record, or a compact/driver-captured record)."""
+    configs = _norm_configs(rec.get("configs"))
+    out = {
+        "schema": SCHEMA,
+        "at": time.time() if at is None else at,
+        "source": source,
+        "backend": rec.get("backend") or "none",
+        "headline_config": _headline_config(configs, rec.get("value")),
+        "value": rec.get("value"),
+        "unit": rec.get("unit", "ops/sec"),
+        "vs_baseline": rec.get("vs_baseline"),
+        "configs": configs,
+    }
+    perf = _perf_from_configs(rec.get("configs"))
+    if perf:
+        out["perf"] = perf
+    if metrics_rollup:
+        out["metrics"] = metrics_rollup
+    return out
+
+
+# ---------------------------------------------------------------------------
+# backfill from the committed BENCH_r0*.json driver captures
+
+
+def backfill_records(root: str | None = None) -> list[dict]:
+    """History records synthesized from the committed `BENCH_r0*.json`
+    driver captures, filename order (the round number is chronological).
+    Captures without a parsed final record (crashed rounds) are skipped."""
+    root = root or repo_root()
+    out: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r0*.json"))):
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = data.get("parsed") if isinstance(data, dict) else None
+        if not isinstance(parsed, dict):
+            continue
+        value = parsed.get("value")
+        if not isinstance(value, (int, float)) or value <= 0:
+            continue
+        rec = record_from_bench(
+            parsed, source=f"backfill:{os.path.basename(path)}",
+            at=os.path.getmtime(path))
+        out.append(rec)
+    return out
+
+
+def ensure_backfilled(root: str | None = None,
+                      path: str | None = None) -> int:
+    """Create `bench_history.jsonl` from the committed BENCH captures when
+    it does not exist yet. Returns the number of records written (0 when
+    the file already exists — backfill never rewrites history)."""
+    root = root or repo_root()
+    path = path or history_path(root)
+    if os.path.exists(path):
+        return 0
+    records = backfill_records(root)
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+    return len(records)
+
+
+# ---------------------------------------------------------------------------
+# the regression gate
+
+
+def check(path: str | None = None, record: dict | None = None,
+          window: int = DEFAULT_WINDOW,
+          threshold_pct: float = DEFAULT_THRESHOLD_PCT,
+          compile_growth_pct: float = DEFAULT_COMPILE_GROWTH_PCT,
+          ) -> tuple[int, list[str]]:
+    """Compare the current run against the rolling same-backend median.
+
+    `record=None` judges the LAST history record against the ones before
+    it; an explicit `record` (e.g. a freshly parsed bench line not yet
+    appended) is judged against the whole file. Returns (exit_code,
+    report_lines): 0 = ok or gracefully skipped (no comparable history),
+    1 = throughput regression or compile-count growth.
+    """
+    lines: list[str] = []
+    records = load(path)
+    if record is None:
+        if not records:
+            return 0, ["perf check: SKIP (empty history — run bench.py "
+                       "or backfill first)"]
+        current, prior_pool = records[-1], records[:-1]
+    else:
+        current, prior_pool = record, records
+
+    backend = current.get("backend") or "none"
+    headline = current.get("headline_config")
+    value = current.get("value")
+    prior = [r for r in prior_pool
+             if (r.get("backend") or "none") == backend
+             and r.get("headline_config") == headline
+             and isinstance(r.get("value"), (int, float))
+             and r["value"] > 0][-window:]
+    lines.append(f"perf check: current={current.get('source', '?')} "
+                 f"backend={backend} headline_config={headline} "
+                 f"value={value} (history: {len(prior)} comparable of "
+                 f"{len(prior_pool)} prior)")
+    if not isinstance(value, (int, float)) or value <= 0:
+        lines.append("perf check: SKIP (current run has no headline "
+                     "throughput — partial/errored bench)")
+        return 0, lines
+    if not prior:
+        lines.append(f"perf check: SKIP (no prior {backend} history with "
+                     f"headline config {headline!r} to compare against)")
+        return 0, lines
+
+    rc = 0
+    med = statistics.median(r["value"] for r in prior)
+    ratio = value / med
+    floor = 1.0 - threshold_pct / 100.0
+    verdict = "OK" if ratio >= floor else "REGRESSION"
+    lines.append(f"  throughput: {value:.0f} vs rolling median {med:.0f} "
+                 f"(x{ratio:.2f}, floor x{floor:.2f}) -> {verdict}")
+    if ratio < floor:
+        rc = 1
+
+    # per-config detail (informational: config mix varies across rounds)
+    cur_cfgs = current.get("configs") or {}
+    for cfg in sorted(cur_cfgs, key=lambda c: (len(c), c)):
+        cv = (cur_cfgs[cfg] or {}).get("engine_ops_per_s")
+        pv = [((r.get("configs") or {}).get(cfg) or {})
+              .get("engine_ops_per_s") for r in prior]
+        pv = [x for x in pv if isinstance(x, (int, float)) and x > 0]
+        if isinstance(cv, (int, float)) and cv > 0 and pv:
+            m = statistics.median(pv)
+            flag = "" if cv / m >= floor else "  <-- below floor"
+            lines.append(f"  config {cfg}: {cv:.0f} vs median {m:.0f} "
+                         f"(x{cv / m:.2f}){flag}")
+
+    cur_c = (current.get("perf") or {}).get("compiles_total")
+    prior_c = [(r.get("perf") or {}).get("compiles_total") for r in prior]
+    prior_c = [c for c in prior_c if isinstance(c, int)]
+    if isinstance(cur_c, int) and prior_c:
+        med_c = statistics.median(prior_c)
+        allowed = med_c * (1.0 + compile_growth_pct / 100.0) + 2
+        verdict = "OK" if cur_c <= allowed else "COMPILE GROWTH"
+        lines.append(f"  compiles: {cur_c} vs rolling median {med_c:.0f} "
+                     f"(allowed <= {allowed:.0f}) -> {verdict}")
+        if cur_c > allowed:
+            rc = 1
+    elif isinstance(cur_c, int):
+        lines.append(f"  compiles: {cur_c} (no prior compile telemetry — "
+                     "comparison starts next run)")
+    return rc, lines

@@ -1,0 +1,135 @@
+"""Stage breakdown of the round-frame resident ingress
+(`ResidentRowsDocSet.apply_round_frames`): how much of a streamed sync
+round goes to actor registration, precheck, admission encode, capacity
+growth, triplet build, dispatch enqueue, frame decode, and the final
+readback. The former repo-root `profile_resident.py` dev tool, packaged
+(`python -m automerge_tpu.perf resident`; the script remains as a shim).
+
+Prints one JSON object: per-stage milliseconds per round plus the
+accounted total. Dev tool — timings are meaningful relative to each
+other, not as absolute throughput claims.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run(n_docs: int = 2000, n_rounds: int = 12, n_batches: int = 4,
+        fraction: float = 0.2, seed: int = 3) -> dict:
+    if _ROOT not in sys.path:
+        sys.path.insert(0, _ROOT)
+    import numpy as np
+
+    import bench
+    bench._load_package()
+    am = bench.am
+
+    import jax
+    print("backend:", jax.default_backend(), file=sys.stderr)
+
+    from automerge_tpu.engine.resident_rows import ResidentRowsDocSet
+    from automerge_tpu.frontend.materialize import apply_changes_to_doc
+    from automerge_tpu.sync.frames import (decode_round_frame,
+                                           encode_round_frame)
+
+    rng = random.Random(seed)
+    doc_changes = bench.gen_docset(n_docs)
+    doc_ids = [f"d{i}" for i in range(n_docs)]
+
+    docs = []
+    for changes in doc_changes:
+        d = am.init("bench")
+        d = apply_changes_to_doc(d, d._doc.opset, changes,
+                                 incremental=False)
+        docs.append(d)
+
+    total_rounds = n_rounds * (1 + n_batches)
+    rset = ResidentRowsDocSet(doc_ids)
+    rset.apply_rounds([{doc_ids[i]: doc_changes[i] for i in range(n_docs)}],
+                      interpret=False)
+    rset.reserve(
+        ops_per_doc=int(rset.op_count.max()) + total_rounds + 1,
+        changes_per_doc=int(rset.change_count.max()) + total_rounds + 1)
+
+    changed = rng.sample(range(n_docs), max(1, int(n_docs * fraction)))
+    rounds = []
+    for rnd in range(total_rounds):
+        deltas = {}
+        for i in changed:
+            prev = docs[i]
+            new = am.change(prev, lambda d, rnd=rnd, i=i: d.__setitem__(
+                "n", rnd * 1000 + i))
+            deltas[doc_ids[i]] = new._doc.opset.get_missing_changes(
+                prev._doc.opset.clock)
+            docs[i] = new
+        rounds.append(deltas)
+    wire = [encode_round_frame(r) for r in rounds]
+
+    stage: dict[str, float] = {}
+
+    def timed(name, fn):
+        def wrap(*a, **k):
+            t0 = time.perf_counter()
+            out = fn(*a, **k)
+            stage[name] = stage.get(name, 0.0) + time.perf_counter() - t0
+            return out
+        return wrap
+
+    rset._register_round_actors = timed("register",
+                                        rset._register_round_actors)
+    rset._precheck_round_frames = timed("precheck",
+                                        rset._precheck_round_frames)
+    rset._encode_round_frame = timed("encode_admit",
+                                     rset._encode_round_frame)
+    rset._grow_for_rounds = timed("grow", rset._grow_for_rounds)
+    rset._cols_triplets = timed("triplets", rset._cols_triplets)
+    rset._dispatch_final = timed("dispatch_enqueue", rset._dispatch_final)
+
+    # warm
+    np.asarray(rset.apply_round_frames(wire[:n_rounds], interpret=False))
+    stage.clear()
+
+    t0 = time.perf_counter()
+    h = None
+    for b in range(n_batches):
+        tD = time.perf_counter()
+        frames = [decode_round_frame(f)
+                  for f in wire[n_rounds * (1 + b):n_rounds * (2 + b)]]
+        stage["frame_decode"] = stage.get("frame_decode", 0.0) \
+            + time.perf_counter() - tD
+        h = rset.apply_round_frames(frames, interpret=False)
+    tR = time.perf_counter()
+    np.asarray(h)
+    stage["final_readback"] = time.perf_counter() - tR
+    total = time.perf_counter() - t0
+
+    nt = n_rounds * n_batches
+    return {"total_ms_per_round": round(total / nt * 1000, 3),
+            "stages_ms_per_round": {k: round(v / nt * 1000, 3)
+                                    for k, v in stage.items()},
+            "accounted": round(sum(stage.values()) / nt * 1000, 3)}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="automerge_tpu.perf resident")
+    ap.add_argument("--docs", type=int, default=2000)
+    ap.add_argument("--rounds", type=int, default=12)
+    ap.add_argument("--batches", type=int, default=4)
+    ap.add_argument("--fraction", type=float, default=0.2)
+    args = ap.parse_args(argv)
+    print(json.dumps(run(n_docs=args.docs, n_rounds=args.rounds,
+                         n_batches=args.batches, fraction=args.fraction),
+                     indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -48,6 +48,7 @@ import struct
 import numpy as np
 
 from ..core.change import Change
+from ..utils import perfscope
 from ..native.wire import WireColumns, changes_to_columns  # noqa: F401
 # changes_to_columns is re-exported: it lives beside WireColumns so the
 # engine can use it without importing the sync package.
@@ -177,10 +178,12 @@ def bytes_to_columns(data: bytes) -> WireColumns:
     return cols
 
 
+@perfscope.phased("sync_wire")
 def encode_frame(changes: list[Change]) -> bytes:
     return columns_to_bytes(changes_to_columns(changes))
 
 
+@perfscope.phased("sync_wire")
 def decode_frame(data: bytes) -> WireColumns:
     return bytes_to_columns(data)
 
@@ -212,6 +215,7 @@ class RoundColumns:
                 for k, d in enumerate(self.doc_ids)}
 
 
+@perfscope.phased("sync_wire")
 def encode_round_frame(deltas: dict[str, list[Change]]) -> bytes:
     """Serialize one sync round — {doc_id: [Change]} — as a single frame.
     This is the natural wire for a DocSet sync service: the per-op JSON the
@@ -262,6 +266,7 @@ def round_from_parts(doc_parts: dict[str, list]) -> RoundColumns:
     return RoundColumns(doc_ids, off, merged)
 
 
+@perfscope.phased("sync_wire")
 def decode_round_frame(data: bytes) -> RoundColumns:
     if data[:4] != ROUND_MAGIC:
         raise ValueError("not a round frame (bad magic)")

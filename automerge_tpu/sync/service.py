@@ -745,6 +745,10 @@ class EngineDocSet:
             raise
         self._drain_admitted()
         flightrec.record("hash_read", shard=self._shard, docs=len(out))
+        rb = getattr(self._resident, "resident_bytes", None)
+        if callable(rb):    # per-shard memory footprint for post-mortems
+            metrics.gauge("sync_shard_resident_bytes", rb(),
+                          shard=str(self._shard))
         return out
 
     # -- convergence audit surface (sync/audit.py) ----------------------------

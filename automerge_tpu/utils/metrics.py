@@ -51,7 +51,10 @@ Usage:
         ...
     with metrics.watchdog("sync_hashes_fanout", budget_s=120.0):
         h = svc.hashes()
-    metrics.snapshot()      # flat JSON-able dict (canonical keys only)
+    metrics.snapshot()      # flat JSON-able dict (canonical keys only;
+                            # plus ONE nested "perf" section when the
+                            # performance plane recorded anything —
+                            # numeric-delta consumers must skip dicts)
     metrics.prometheus()    # text exposition
     with metrics.adopt_context({"tid": ..., "sid": ...}):   # join a
         ...                 # remote peer's trace (sync/connection.py)
@@ -129,6 +132,24 @@ COUNTERS: dict[str, str] = {
 
 GAUGES: dict[str, str] = {
     "core_queue_depth": "causal queue depth after the latest apply batch",
+    "core_queue_bytes":
+        "approximate host bytes held by the causal queue {estimate}",
+    # perfscope compile telemetry (utils/perfscope.py): XLA's answer per
+    # compiled kernel variant, refreshed on each one-time analysis
+    "engine_kernel_flops": "XLA cost_analysis flops {kernel=...}",
+    "engine_kernel_bytes_accessed":
+        "XLA cost_analysis bytes accessed {kernel=...}",
+    "engine_kernel_hbm_bytes":
+        "XLA memory_analysis section bytes {kernel=...,section="
+        "argument|output|temp|alias|code}",
+    # resident-state footprints (the memory picture a post-mortem needs)
+    "engine_resident_bytes": "docs-major resident-state footprint (bytes)",
+    "rows_resident_bytes": "rows-engine resident-state footprint (bytes)",
+    "sync_shard_resident_bytes":
+        "per-shard resident-state footprint {shard=...}",
+    "obs_live_arrays_bytes": "sampled live jax-array footprint (bytes)",
+    "obs_live_arrays_peak_bytes":
+        "high-water mark of the live jax-array footprint since reset",
 }
 
 HISTOGRAMS: dict[str, str] = {
@@ -147,6 +168,9 @@ SPANS: dict[str, str] = {
     "sync_hashes_fanout": "sharded service hash fan-out over all shards",
     "sync_msg_send": "one outgoing protocol message (trace-context root)",
     "sync_msg_serve": "serving one received protocol message",
+    "engine_kernel_compile":
+        "attributed jit lower+compile wall time {kernel=...} "
+        "(perfscope listener; timer-only, no span records)",
 }
 
 # The pre-rename alias names ("changes_applied", "wire_frames_received", …)
@@ -442,7 +466,20 @@ def add_time(_name: str, _seconds: float, **labels) -> None:
 
 
 def snapshot() -> dict:
-    return _global.snapshot()
+    """Flat metrics view plus — when the performance plane has recorded
+    anything since the last reset — a nested `"perf"` section
+    (utils/perfscope.py: per-kernel compile telemetry, phase rollup,
+    memory footprint). The perf attach happens OUTSIDE the metrics lock:
+    perfscope has its own lock and the two must never nest."""
+    out = _global.snapshot()
+    try:
+        from . import perfscope
+        perf = perfscope.perf_snapshot()
+    except Exception:
+        perf = None
+    if perf:
+        out["perf"] = perf
+    return out
 
 
 def prometheus(prefix: str = "amtpu_") -> str:
@@ -451,6 +488,11 @@ def prometheus(prefix: str = "amtpu_") -> str:
 
 def reset() -> None:
     _global.reset()
+    try:
+        from . import perfscope
+        perfscope.reset()
+    except Exception:
+        pass
 
 
 def recent_spans() -> list[dict]:
@@ -756,33 +798,30 @@ def watchdog(name: str, budget_s: float, logger=None,
 # jit dispatch accounting
 
 
-def _cache_size(fn):
-    m = getattr(fn, "_cache_size", None)
-    if not callable(m):
-        return None
-    try:
-        return m()
-    except Exception:
-        return None
-
-
 def dispatch_jit(kernel: str, fn, *args, **kwargs):
     """Call a jitted function, counting the dispatch under
-    `engine_kernels_dispatched{kernel=...}` and — via the jit compile-cache
-    size delta — any retrace/compile-cache miss under
-    `engine_kernels_retraced{kernel=...}`. A retrace storm on a hot kernel
-    is the classic silent TPU perf cliff; this makes it a counter. Each
-    dispatch also lands in the flight recorder's event ring, so a
-    post-mortem dump shows the last kernels every thread pushed at the
-    device before the hang."""
-    before = _cache_size(fn)
+    `engine_kernels_dispatched{kernel=...}` and any compile-cache miss
+    under `engine_kernels_retraced{kernel=...}`. A retrace storm on a hot
+    kernel is the classic silent TPU perf cliff; this makes it a counter.
+
+    Miss detection is exact since the perfscope rework: a jax.monitoring
+    listener observes `/jax/core/compile/*` duration events and attributes
+    them to this dispatch through a thread-local marker
+    (utils/perfscope.py) — the old jit cache-size delta was thread-racy
+    and misattributed concurrent dispatches. The same window records
+    per-kernel compile wall time (`engine_kernel_compile{kernel=...}_s`)
+    and triggers the one-time XLA cost/memory analysis per new kernel
+    signature. Each dispatch also lands in the flight recorder's event
+    ring, so a post-mortem dump shows the last kernels every thread
+    pushed at the device before the hang."""
+    from . import perfscope
+    marker = perfscope.dispatch_begin(kernel, fn, args, kwargs)
     try:
-        return fn(*args, **kwargs)
+        with perfscope.phase("dispatch"):
+            return fn(*args, **kwargs)
     finally:
+        retraced = perfscope.dispatch_end(marker)
         bump("engine_kernels_dispatched", kernel=kernel)
-        after = _cache_size(fn)
-        retraced = (before is not None and after is not None
-                    and after > before)
         if retraced:
             bump("engine_kernels_retraced", kernel=kernel)
         try:

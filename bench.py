@@ -605,6 +605,7 @@ def run_engine(doc_changes, repeat=None):
                                            rows_count, rows_eligible)
     from automerge_tpu.engine.pallas_kernels import (HAVE_PALLAS,
                                                      reconcile_rows_hash)
+    from automerge_tpu.utils import perfscope
 
     _eng_t0 = time.perf_counter()
 
@@ -787,7 +788,8 @@ def run_engine(doc_changes, repeat=None):
         arrs = ship(stacked)
     else:
         arrs = [jnp.asarray(b) for b in buffers]
-    jax.block_until_ready(arrs)
+    with perfscope.phase("device_wait"):
+        jax.block_until_ready(arrs)
     t_shipped = time.perf_counter()
     all_hashes = np.asarray(dispatch(arrs))
     if owner is not None:
@@ -1836,8 +1838,32 @@ def parent_main(args, passthrough: list[str]):
         # never point at a stale previous run's sidecar
         compact["detail"] = None
         compact["detail_error"] = repr(e)[:120]
+    _append_bench_history(rec, compact)
     print(json.dumps(compact))
     sys.exit(0)
+
+
+def _append_bench_history(rec: dict, compact: dict) -> None:
+    """Append this run to bench_history.jsonl (the perf regression gate's
+    ledger — `python -m automerge_tpu.perf check`). The history module is
+    loaded BY FILE PATH, not as a package import: `import automerge_tpu`
+    initializes jax, and this parent process must never touch jax (the
+    tunneled backend can hang during init). Best-effort — a broken ledger
+    must not break the never-crash bench contract."""
+    try:
+        import importlib.util
+        root = os.path.dirname(os.path.abspath(__file__))
+        hpath = os.path.join(root, "automerge_tpu", "perf", "history.py")
+        spec = importlib.util.spec_from_file_location(
+            "_amtpu_perf_history", hpath)
+        history = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(history)
+        history.ensure_backfilled(root)
+        record = history.record_from_bench(
+            rec, metrics_rollup=compact.get("metrics"))
+        history.append(record, history.history_path(root))
+    except Exception as e:
+        print(f"# bench-history append failed: {e!r}", file=sys.stderr)
 
 
 def main():

@@ -1,99 +1,15 @@
-"""Round-3 profiling: stage breakdown of the round-frame resident ingress
-(apply_round_frames). Dev tool, not part of the package."""
-import json
+"""Shim: the resident-ingress stage profiler now lives in
+`automerge_tpu.perf.resident` (run `python -m automerge_tpu.perf
+resident`). Same defaults and output shape as the old script."""
+
+from __future__ import annotations
+
+import os
 import sys
-import time
 
-sys.path.insert(0, ".")
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)) or ".")
 
-import bench
-bench._load_package()
-am = bench.am
+from automerge_tpu.perf.resident import main  # noqa: E402
 
-import jax
-print("backend:", jax.default_backend(), file=sys.stderr)
-
-from automerge_tpu.engine.resident_rows import ResidentRowsDocSet
-from automerge_tpu.sync.frames import decode_round_frame, encode_round_frame
-
-import random
-rng = random.Random(3)
-
-N = 2000
-doc_changes = bench.gen_docset(N)
-doc_ids = [f"d{i}" for i in range(N)]
-
-docs = []
-from automerge_tpu.frontend.materialize import apply_changes_to_doc
-for changes in doc_changes:
-    d = am.init("bench")
-    d = apply_changes_to_doc(d, d._doc.opset, changes, incremental=False)
-    docs.append(d)
-
-n_rounds, n_batches = 12, 4
-total_rounds = n_rounds * (1 + n_batches)
-rset = ResidentRowsDocSet(doc_ids)
-rset.apply_rounds([{doc_ids[i]: doc_changes[i] for i in range(N)}],
-                  interpret=False)
-rset.reserve(
-    ops_per_doc=int(rset.op_count.max()) + total_rounds + 1,
-    changes_per_doc=int(rset.change_count.max()) + total_rounds + 1)
-
-changed = rng.sample(range(N), max(1, int(N * 0.2)))
-rounds = []
-for rnd in range(total_rounds):
-    deltas = {}
-    for i in changed:
-        prev = docs[i]
-        new = am.change(prev, lambda d, rnd=rnd, i=i: d.__setitem__(
-            "n", rnd * 1000 + i))
-        deltas[doc_ids[i]] = new._doc.opset.get_missing_changes(
-            prev._doc.opset.clock)
-        docs[i] = new
-    rounds.append(deltas)
-wire = [encode_round_frame(r) for r in rounds]
-
-stage = {}
-
-
-def timed(name, fn):
-    def wrap(*a, **k):
-        t0 = time.perf_counter()
-        out = fn(*a, **k)
-        stage[name] = stage.get(name, 0.0) + time.perf_counter() - t0
-        return out
-    return wrap
-
-
-rset._register_round_actors = timed("register", rset._register_round_actors)
-rset._precheck_round_frames = timed("precheck", rset._precheck_round_frames)
-rset._encode_round_frame = timed("encode_admit", rset._encode_round_frame)
-rset._grow_for_rounds = timed("grow", rset._grow_for_rounds)
-rset._cols_triplets = timed("triplets", rset._cols_triplets)
-rset._dispatch_final = timed("dispatch_enqueue", rset._dispatch_final)
-
-# warm
-np.asarray(rset.apply_round_frames(wire[:n_rounds], interpret=False))
-stage.clear()
-
-t0 = time.perf_counter()
-h = None
-for b in range(n_batches):
-    tD = time.perf_counter()
-    frames = [decode_round_frame(f)
-              for f in wire[n_rounds * (1 + b):n_rounds * (2 + b)]]
-    stage["frame_decode"] = stage.get("frame_decode", 0.0) \
-        + time.perf_counter() - tD
-    h = rset.apply_round_frames(frames, interpret=False)
-tR = time.perf_counter()
-np.asarray(h)
-stage["final_readback"] = time.perf_counter() - tR
-total = time.perf_counter() - t0
-
-NT = n_rounds * n_batches
-per_round = {k: round(v / NT * 1000, 3) for k, v in stage.items()}
-print(json.dumps({"total_ms_per_round": round(total / NT * 1000, 3),
-                  "stages_ms_per_round": per_round,
-                  "accounted": round(sum(stage.values()) / NT * 1000, 3),
-                  }, indent=1))
+if __name__ == "__main__":
+    main()

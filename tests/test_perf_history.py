@@ -1,0 +1,209 @@
+"""bench_history.jsonl + the perf regression gate
+(automerge_tpu/perf/history.py and the `python -m automerge_tpu.perf`
+CLI contract). Pure host tests — no jax dispatch work."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from automerge_tpu.perf import history
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _rec(value, backend="cpu", source="test", compiles=None, configs=None):
+    out = {"schema": 1, "at": 0.0, "source": source, "backend": backend,
+           "value": value, "unit": "ops/sec", "vs_baseline": 1.0,
+           "configs": configs or {}}
+    if compiles is not None:
+        out["perf"] = {"compiles_total": compiles, "kernels": {}}
+    return out
+
+
+def _write(path, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+# -- ledger -----------------------------------------------------------------
+
+
+def test_append_load_roundtrip_tolerates_torn_tail(tmp_path):
+    p = str(tmp_path / "h.jsonl")
+    history.append(_rec(100), p)
+    history.append(_rec(110), p)
+    with open(p, "a") as f:
+        f.write('{"torn": ')        # a killed run's partial line
+    recs = history.load(p)
+    assert [r["value"] for r in recs] == [100, 110]
+
+
+def test_backfill_from_committed_bench_captures(tmp_path):
+    """The committed BENCH_r0*.json trajectory seeds the ledger: captures
+    with a parsed final record become history records (backend-labeled),
+    crashed rounds are skipped."""
+    recs = history.backfill_records(str(ROOT))
+    assert len(recs) >= 3
+    assert all(r["source"].startswith("backfill:BENCH_r0") for r in recs)
+    assert all(r["value"] > 0 for r in recs)
+    assert {"cpu", "tpu"} >= {r["backend"] for r in recs}
+    # per-config speedups normalize to dicts for both record shapes
+    some = [r for r in recs if r["configs"]]
+    assert some and all(
+        isinstance(v, dict) for r in some for v in r["configs"].values())
+
+    p = str(tmp_path / "h.jsonl")
+    n = history.ensure_backfilled(str(ROOT), p)
+    assert n == len(recs) == len(history.load(p))
+    # a second call never rewrites existing history
+    assert history.ensure_backfilled(str(ROOT), p) == 0
+
+
+def test_record_from_bench_aggregates_compile_counts():
+    rec = {"backend": "cpu", "value": 5000, "unit": "ops/sec",
+           "vs_baseline": 2.0,
+           "configs": {
+               "1": {"speedup": 1.2, "engine_ops_per_s": 900,
+                     "metrics": {"perf": {"kernels": {
+                         "apply_final": {"dispatches": 4, "compiles": 2},
+                         "scan_rounds": {"dispatches": 1, "compiles": 1},
+                     }}}},
+               "5": {"speedup": 2.0, "engine_ops_per_s": 5000,
+                     "metrics": {"perf": {"kernels": {
+                         "apply_final": {"dispatches": 2, "compiles": 1},
+                     }}}}}}
+    out = history.record_from_bench(rec)
+    assert out["value"] == 5000 and out["backend"] == "cpu"
+    assert out["configs"]["5"]["engine_ops_per_s"] == 5000
+    assert out["perf"]["compiles_total"] == 4
+    assert out["perf"]["kernels"] == {"apply_final": 3, "scan_rounds": 1}
+
+
+# -- the gate ---------------------------------------------------------------
+
+
+def test_check_empty_history_skips_cleanly(tmp_path):
+    rc, lines = history.check(path=str(tmp_path / "missing.jsonl"))
+    assert rc == 0
+    assert any("SKIP" in ln for ln in lines)
+
+
+def test_check_identical_rerun_passes(tmp_path):
+    p = str(tmp_path / "h.jsonl")
+    _write(p, [_rec(1000, compiles=10), _rec(1000, compiles=10),
+               _rec(1000, compiles=10, source="rerun")])
+    rc, lines = history.check(path=p)
+    assert rc == 0, lines
+
+
+def test_check_flags_2x_throughput_regression(tmp_path):
+    p = str(tmp_path / "h.jsonl")
+    _write(p, [_rec(1000), _rec(1050), _rec(500, source="regressed")])
+    rc, lines = history.check(path=p)
+    assert rc == 1
+    assert any("REGRESSION" in ln for ln in lines)
+
+
+def test_check_flags_compile_count_growth(tmp_path):
+    p = str(tmp_path / "h.jsonl")
+    _write(p, [_rec(1000, compiles=10), _rec(1000, compiles=10),
+               _rec(1000, compiles=40, source="retrace-storm")])
+    rc, lines = history.check(path=p)
+    assert rc == 1
+    assert any("COMPILE GROWTH" in ln for ln in lines)
+
+
+def test_check_never_compares_across_backends(tmp_path):
+    """Backend-labeling rule: a CPU fallback run is judged only against
+    CPU history — TPU numbers are an order of magnitude apart and would
+    make the gate either blind or permanently red."""
+    p = str(tmp_path / "h.jsonl")
+    _write(p, [_rec(100000, backend="tpu"), _rec(120000, backend="tpu"),
+               _rec(1000, backend="cpu", source="cpu-fallback")])
+    rc, lines = history.check(path=p)
+    assert rc == 0
+    assert any("SKIP" in ln for ln in lines)
+
+
+def test_check_never_compares_across_headline_configs(tmp_path):
+    """A partial run (--config 1) falls back to a different headline
+    config than a full run; judging its value against full-run history
+    would be a guaranteed false alarm."""
+    p = str(tmp_path / "h.jsonl")
+    full = history.record_from_bench(
+        {"backend": "cpu", "value": 14000000,
+         "configs": {"1": 1.2, "5": 90.0}})
+    partial = history.record_from_bench(
+        {"backend": "cpu", "value": 47000, "configs": {"1": 1.1}},
+        source="partial")
+    assert full["headline_config"] == "5"
+    assert partial["headline_config"] == "1"
+    _write(p, [full, full, partial])
+    rc, lines = history.check(path=p)
+    assert rc == 0
+    assert any("SKIP" in ln for ln in lines)
+
+
+def test_check_explicit_record_against_whole_file(tmp_path):
+    p = str(tmp_path / "h.jsonl")
+    _write(p, [_rec(1000), _rec(1000)])
+    rc, _ = history.check(path=p, record=_rec(980, source="candidate"))
+    assert rc == 0
+    rc, _ = history.check(path=p, record=_rec(400, source="candidate"))
+    assert rc == 1
+
+
+# -- CLI contract -----------------------------------------------------------
+
+
+def _cli(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "automerge_tpu.perf", *args],
+        capture_output=True, text=True, cwd=str(ROOT), env=env,
+        timeout=120)
+
+
+def test_cli_check_exit_codes(tmp_path):
+    p = str(tmp_path / "h.jsonl")
+    _write(p, [_rec(1000), _rec(1000), _rec(1000, source="rerun")])
+    out = _cli("check", "--history", p, "--no-backfill")
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "PERFCHECK OK" in out.stdout
+
+    _write(p, [_rec(1000), _rec(1000), _rec(500, source="regressed")])
+    out = _cli("check", "--history", p, "--no-backfill")
+    assert out.returncode == 1
+    assert "PERFCHECK FAIL" in out.stdout
+
+    out = _cli("check", "--history", str(tmp_path / "none.jsonl"),
+               "--no-backfill")
+    assert out.returncode == 0
+    assert "SKIP" in out.stdout
+
+
+def test_cli_check_backfills_missing_history(tmp_path):
+    p = str(tmp_path / "h.jsonl")
+    out = _cli("check", "--history", p)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "backfilled" in out.stdout
+    assert len(history.load(p)) >= 3
+
+
+def test_cli_report_renders_trajectory(tmp_path):
+    p = str(tmp_path / "h.jsonl")
+    _write(p, [_rec(1000, source="one"), _rec(2000, source="two")])
+    out = _cli("report", "--history", p, "--no-backfill")
+    assert out.returncode == 0
+    assert "bench history — 2 records" in out.stdout
+    assert "one" in out.stdout and "two" in out.stdout
+
+
+def test_cli_rejects_unknown_command():
+    out = _cli("frobnicate")
+    assert out.returncode == 2
