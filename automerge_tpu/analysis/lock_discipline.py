@@ -78,6 +78,8 @@ _BLOCKING_ATTRS = {
 # audit pull on a transport reader thread is the documented caveat in
 # sync/audit.py's "Thread-cost note")
 _ENGINE_READ_ATTRS = {"hashes": "device-readback",
+                      "hashes_for": "device-readback",
+                      "hashes_snapshot": "device-readback",
                       "materialize": "device-readback",
                       "audit_state": "device-readback",
                       "audit_shard_state": "device-readback"}

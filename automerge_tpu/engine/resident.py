@@ -186,6 +186,19 @@ class ResidentDocSet:
         # admission paths just mark, the consumer refreshes lazily
         self._cache_dirty: set[int] = set()
 
+        # Incremental hash plane (the r5 config-8 fix): a host-side mirror
+        # of the last per-doc hash readback plus the doc indices whose
+        # state changed since. hashes()/hashes_for() reconcile ONLY dirty
+        # docs (gathered into a narrow sub-batch) and serve everything
+        # else from the mirror, so a clean convergence read costs zero
+        # device work. hash_epoch is the monotonic invalidation counter
+        # the sync layers key their per-shard caches on: it bumps on
+        # EVERY hash-affecting mutation (admission, compaction, rebuild,
+        # doc creation, actor remap), never on reads.
+        self._hash_mirror: np.ndarray | None = None
+        self._doc_dirty: set[int] = set(range(n))
+        self.hash_epoch = 0
+
         self.state: dict[str, jnp.ndarray] = {}
         self._alloc()
         self._out = None
@@ -226,7 +239,10 @@ class ResidentDocSet:
         }
 
     def _grow(self, **caps):
-        """Grow capacities; pad resident arrays in place (device-side)."""
+        """Grow capacities; pad resident arrays in place (device-side).
+        Padding preserves per-doc hashes, but the mirror goes conservative
+        across any re-layout (growth events are rare and amortized)."""
+        self._mark_all_hash_dirty()
         old = dict(cap_ops=self.cap_ops, cap_changes=self.cap_changes,
                    cap_lists=self.cap_lists, cap_elems=self.cap_elems,
                    cap_actors=self.cap_actors)
@@ -268,10 +284,14 @@ class ResidentDocSet:
         fresh = [d for d in new_ids if d not in self.doc_index]
         if not fresh:
             return
+        first_new = len(self.doc_ids)
         for d in fresh:
             self.doc_index[d] = len(self.doc_ids)
             self.doc_ids.append(d)
             self.tables.append(DocTables())
+        # fresh docs have no mirror entry yet (their empty-doc hash still
+        # needs one reconcile); existing docs stay clean
+        self._mark_hash_dirty(range(first_new, len(self.doc_ids)))
         if len(self.doc_ids) <= self.cap_docs:
             self._out = None
             return
@@ -335,6 +355,11 @@ class ResidentDocSet:
             self._grow(cap_actors=_pad_to(len(self.actors), 2))
         if not old_actors:
             return
+        # hash VALUES survive the remap (content hashes, never ranks), but
+        # the mirror stays conservative across a whole-state rewrite —
+        # remaps are rare after warmup, so the one full re-read is cheap
+        # insurance against a remap bug silently serving stale hashes
+        self._mark_all_hash_dirty()
         # remap resident actor columns + clock matrix columns
         perm = np.array([self.actor_rank[a] for a in old_actors], dtype=np.int32)
         inv = np.full(self.cap_actors, -1, dtype=np.int32)
@@ -545,6 +570,7 @@ class ResidentDocSet:
     def _build_delta_arrays(self, changes_by_doc: dict[str, list[Change]]):
         n = self.cap_docs
         deltas = [Delta() for _ in range(n)]
+        self._mark_hash_dirty(self.doc_index[d] for d in changes_by_doc)
         self.last_admitted = {}
         for doc_id, changes in changes_by_doc.items():
             i = self.doc_index[doc_id]
@@ -619,6 +645,7 @@ class ResidentDocSet:
         the encoder input, so ingest pays no Python-side merge or re-blob."""
         n = self.cap_docs
         deltas = [Delta() for _ in range(n)]
+        self._mark_hash_dirty(self.doc_index[d] for d in cols_by_doc)
         self.last_admitted = {}
 
         def on_admitted(i, t, ready):
@@ -772,7 +799,9 @@ class ResidentDocSet:
                     "scatter_and_apply", _scatter_and_apply,
                     self.state, flat, meta, max_fids=self.cap_fids)
             self._out = out
-            return np.asarray(out["hash"])[:len(self.doc_ids)]
+            vals = np.asarray(out["hash"])[:len(self.doc_ids)]
+            self._adopt_full_hashes(vals)   # flush-time capture
+            return vals
         prev = self._prev_for_diffs()
         prev_vis_host, prev_rank_host = self._prev_host_for_diffs()
         actor_hashes = jnp.asarray(
@@ -794,7 +823,9 @@ class ResidentDocSet:
         records = decode_round_diffs(self, np.asarray(chg_fid),
                                      np.asarray(chg_elem),
                                      prev_vis_host, prev_rank_host)
-        return np.asarray(out["hash"])[:len(self.doc_ids)], records
+        vals = np.asarray(out["hash"])[:len(self.doc_ids)]
+        self._adopt_full_hashes(vals)   # flush-time capture
+        return vals, records
 
     def _prev_for_diffs(self):
         """The last diff round's converged state padded to current
@@ -844,6 +875,71 @@ class ResidentDocSet:
             rank = np.pad(rank, pads, constant_values=-1)
         return vis, rank
 
+    # -- incremental hash plane (shared vocabulary with the rows engine) ---
+
+    def _mark_hash_dirty(self, idxs) -> None:
+        """Record a hash-affecting mutation for specific docs. The epoch
+        bumps even when every doc was already dirty — epoch equality is
+        the sync layers' "nothing changed since my cached read" test, so
+        every mutation must advance it."""
+        self._doc_dirty.update(int(i) for i in idxs)
+        self.hash_epoch += 1
+
+    def _mark_all_hash_dirty(self) -> None:
+        self._doc_dirty.update(range(len(self.doc_ids)))
+        self.hash_epoch += 1
+
+    def _ensure_hash_mirror(self) -> np.ndarray:
+        n = len(self.doc_ids)
+        mirror = self._hash_mirror
+        if mirror is None or len(mirror) < n:
+            grown = np.zeros(max(self.cap_docs, n), np.uint32)
+            if mirror is not None:
+                grown[:len(mirror)] = mirror
+            self._hash_mirror = mirror = grown
+        return mirror
+
+    def _adopt_full_hashes(self, row: np.ndarray) -> None:
+        """Adopt a full per-doc hash readback (flush-time capture): the
+        mirror becomes current and every doc goes clean."""
+        n = len(self.doc_ids)
+        self._ensure_hash_mirror()[:n] = np.asarray(row)[:n]
+        self._doc_dirty.clear()
+
+    @property
+    def hashes_clean(self) -> bool:
+        """True iff hashes() would serve entirely from the host mirror
+        (zero dispatches, zero device readbacks)."""
+        n = len(self.doc_ids)
+        return ((n == 0 or (self._hash_mirror is not None
+                            and len(self._hash_mirror) >= n))
+                and not any(i < n for i in self._doc_dirty))
+
+    def _reconcile_partial(self, idxs: list[int]) -> None:
+        """Reconcile ONLY the given docs: gather their rows out of the
+        resident state (leading-axis gather per array), run the same
+        reconcile kernel on the narrow sub-batch, and scatter the hashes
+        into the mirror. Device work is O(len(idxs)), independent of the
+        fleet size; the sub-batch doc count pads to a power-of-two-ish
+        step so recompiles stay bounded."""
+        with metrics.trace("engine_hashes"):
+            self._ensure_actor_hash_state()
+            k = len(idxs)
+            pad = _pad_to(k, 8)
+            # padded rows repeat the last dirty doc (any valid doc works;
+            # the extra hashes are discarded below)
+            sel = jnp.asarray(idxs + [idxs[-1]] * (pad - k), jnp.int32)
+            sub = {name: jnp.take(arr, sel, axis=0)
+                   for name, arr in self.state.items()}
+            out = metrics.dispatch_jit("apply_doc", apply_doc,
+                                       sub, self.cap_fids)
+            flightrec.record("engine_hash_readback", docs=k)
+            with perfscope.phase("readback"):
+                vals = np.asarray(out["hash"])
+            self._ensure_hash_mirror()[np.asarray(idxs, np.int64)] = \
+                vals[:k].astype(np.uint32)
+            self._doc_dirty.difference_update(idxs)
+
     def reconcile(self):
         """Run the reconcile kernel over resident state; returns per-doc
         uint32 hashes (numpy, aligned with doc_ids)."""
@@ -856,7 +952,9 @@ class ResidentDocSet:
                              docs=len(self.doc_ids))
             metrics.gauge("engine_resident_bytes", self.resident_bytes())
             with perfscope.phase("readback"):
-                return np.asarray(self._out["hash"])[:len(self.doc_ids)]
+                vals = np.asarray(self._out["hash"])[:len(self.doc_ids)]
+            self._adopt_full_hashes(vals)
+            return vals
 
     def resident_bytes(self) -> int:
         """Footprint of the docs-major resident state tables (bytes). Set
@@ -868,12 +966,52 @@ class ResidentDocSet:
         return total
 
     def hashes(self) -> np.ndarray:
-        """Per-doc state hashes, reusing the cached reconcile output when no
-        delta has been applied since (a polling consumer should not pay a
-        device dispatch per read)."""
-        if self._out is None:
-            return self.reconcile()
-        return np.asarray(self._out["hash"])[:len(self.doc_ids)]
+        """Per-doc state hashes, O(dirty) not O(fleet): served from the
+        host hash mirror; only docs whose state changed since the last
+        read are re-reconciled (narrow sub-batch dispatch). A clean read
+        performs zero dispatches and zero readbacks; a read after a fused
+        apply reuses the flush-time hashes (`self._out`) with one cheap
+        readback and no reconcile."""
+        n = len(self.doc_ids)
+        mirror = self._hash_mirror
+        if mirror is not None and len(mirror) >= n \
+                and not any(i < n for i in self._doc_dirty):
+            return mirror[:n].copy()
+        if self._out is not None:
+            # flush-time hashes from the last fused apply dispatch cover
+            # every doc: one readback, no reconcile
+            with perfscope.phase("readback"):
+                vals = np.asarray(self._out["hash"])[:n]
+            self._adopt_full_hashes(vals)
+            return vals.copy()
+        dirty = sorted(i for i in self._doc_dirty if i < n)
+        if self._hash_mirror is None or 2 * len(dirty) >= n:
+            return self.reconcile().copy()
+        self._reconcile_partial(dirty)
+        return self._hash_mirror[:n].copy()
+
+    def hashes_for(self, idxs) -> np.ndarray:
+        """Hashes for a subset of docs (indices into doc_ids) WITHOUT
+        reconciling untouched docs: device work is O(requested ∩ dirty).
+        Returns uint32 hashes aligned with idxs."""
+        idxs = [int(i) for i in idxs]
+        if not idxs:
+            return np.zeros(0, np.uint32)
+        n = len(self.doc_ids)
+        if self._out is not None and self._hash_mirror is None:
+            # cheaper than a partial dispatch: the fused-apply output
+            # already holds every hash
+            return self.hashes()[np.asarray(idxs, np.int64)].copy()
+        mirror = self._ensure_hash_mirror()
+        want = set(idxs)
+        dirty = sorted(i for i in self._doc_dirty if i < n and i in want)
+        if dirty:
+            if self._out is not None:
+                with perfscope.phase("readback"):
+                    self._adopt_full_hashes(np.asarray(self._out["hash"]))
+            else:
+                self._reconcile_partial(dirty)
+        return mirror[np.asarray(idxs, np.int64)].copy()
 
     def materialize(self, doc_id: str) -> Any:
         """Decode one document from resident state + reconcile outputs."""

@@ -164,7 +164,13 @@ class ResidentRowsDocSet(ResidentDocSet):
         self._alloc_rows()
         self.rows_dev = None
         self._dirty = True
-        self._hash_handle = None  # device hashes of the last dispatch
+        # Device hashes of the last dispatch (full fleet, unread while the
+        # pipeline is async). The incremental hash plane sits on top: the
+        # base class's _hash_mirror/_doc_dirty/hash_epoch (resident.py)
+        # track which LANES changed since the last readback, so hashes()/
+        # hashes_for() reconcile only dirty lanes (narrow [ROWS, k_pad]
+        # gather + the same fused kernel) and a clean read is free.
+        self._hash_handle = None
         # dense admission cache (vectorized round-frame fast path): per-doc
         # clock rows + single-head frontier summary. Rebuilt lazily from the
         # authoritative DocTables dicts for docs in _cache_dirty.
@@ -228,6 +234,7 @@ class ResidentRowsDocSet(ResidentDocSet):
         if not fresh:
             return
         old_cap_docs = self.cap_docs
+        first_new = len(self.doc_ids)
         for d in fresh:
             self.doc_index[d] = len(self.doc_ids)
             self.doc_ids.append(d)
@@ -239,6 +246,9 @@ class ResidentRowsDocSet(ResidentDocSet):
             self.ghost_eids.append(set())
             self.change_log.append([])
             self.log_horizon.append({})
+        # fresh lanes need one reconcile for their empty-doc hash;
+        # existing lanes stay clean
+        self._mark_hash_dirty(range(first_new, len(self.doc_ids)))
         n = len(self.doc_ids)
         if n > self.cap_docs:
             k = _pad_to(n, 8) - self.cap_docs
@@ -309,6 +319,8 @@ class ResidentRowsDocSet(ResidentDocSet):
         # ah band is likewise re-filled from the actor table
         self._refill_actor_hash_band()
         self._dirty = True
+        # re-layout preserves hashes but rewrites every lane: conservative
+        self._mark_all_hash_dirty()
 
     # _register_actors/_register_actors_cols are inherited from the base
     # class; only the remap sink differs (host rows mirror vs device state).
@@ -481,6 +493,9 @@ class ResidentRowsDocSet(ResidentDocSet):
                              for (s, e, a, p) in entries]
         self._refill_actor_hash_band()
         self._dirty = True
+        # rank remap rewrites every lane's act/co rows; hash values are
+        # preserved (content hashes), mirror stays conservative anyway
+        self._mark_all_hash_dirty()
 
     # ------------------------------------------------------------------
     # delta encoding to scatter triplets
@@ -817,9 +832,14 @@ class ResidentRowsDocSet(ResidentDocSet):
             raise
         fresh._rebuilding = False
         gen = getattr(self, "_rebuild_gen", 0)
+        # the hash epoch must stay monotonic ACROSS the rebuild: a sync
+        # layer holding a pre-rebuild epoch must see every post-rebuild
+        # read as dirty (the fresh instance restarts its counter at 0)
+        epoch = max(self.hash_epoch, fresh.hash_epoch) + 1
         self.__dict__.clear()
         self.__dict__.update(fresh.__dict__)
         self._rebuild_gen = gen + 1
+        self.hash_epoch = epoch
 
     def _replay_chunked(self, fresh: "ResidentRowsDocSet", round_: dict,
                         chunk: int = 256) -> None:
@@ -936,6 +956,14 @@ class ResidentRowsDocSet(ResidentDocSet):
             return jax.device_put(arr, self.device)
         return jnp.asarray(arr)
 
+    def _mark_trips_dirty(self, trip_list) -> None:
+        """Hash invalidation for the lanes a batch of scatter triplets
+        touches (BEFORE the dispatch: a failed dispatch leaves host truth
+        updated, so these lanes must re-reconcile either way)."""
+        touched = {int(d) for t in trip_list for d in np.unique(t[:, 1])}
+        if touched:
+            self._mark_hash_dirty(touched)
+
     def _dispatch_rounds(self, trip_list, pre_rows, interpret):
         p = _pad_to(max((len(t) for t in trip_list), default=1), 8)
         oob = self._bases()["rows"]  # out-of-range row => dropped by scatter
@@ -943,15 +971,20 @@ class ResidentRowsDocSet(ResidentDocSet):
         for k, t in enumerate(trip_list):
             stacked[k, :len(t)] = t
             stacked[k, len(t):, 0] = oob
+        self._mark_trips_dirty(trip_list)
         if pre_rows is not None:
             self.rows_dev = self._to_dev(pre_rows)
             self._dirty = False
         self.rows_dev, hashes = metrics.dispatch_jit(
             "scan_rounds", _scan_rounds,
             self.rows_dev, self._to_dev(stacked), self.dims(), interpret)
-        self._hash_handle = hashes[-1]
+        self._hash_handle = None
         with perfscope.phase("readback"):
-            return np.asarray(hashes)[:, :len(self.doc_ids)]
+            vals = np.asarray(hashes)
+        # the FINAL round's row is the canonical post-batch hash table:
+        # adopt it so the next hashes() read is free (flush-time capture)
+        self._adopt_full_hashes(vals[-1])
+        return vals[:, :len(self.doc_ids)]
 
     # ------------------------------------------------------------------
     # native columnar ingress
@@ -1695,9 +1728,12 @@ class ResidentRowsDocSet(ResidentDocSet):
         over rounds collapses into a single gather-free scatter. Returns
         the device hash array without reading it back (None under
         lazy_dispatch — the next hashes() read reconciles)."""
+        self._mark_trips_dirty(trip_list)
         if self.lazy_dispatch:
             # _cols_triplets already committed the round to the host
-            # mirror; defer upload + reconcile to the next hash read
+            # mirror; defer upload + reconcile to the next hash read —
+            # which, with the dirty lanes just marked, reconciles ONLY
+            # this round's docs (O(changes)), not the fleet
             self.rows_dev = None
             self._dirty = True
             self._hash_handle = None
@@ -1726,10 +1762,106 @@ class ResidentRowsDocSet(ResidentDocSet):
         self._hash_handle = h  # polling hashes() between deltas is free
         return h
 
+    @property
+    def hashes_clean(self) -> bool:
+        """True iff hashes() would serve entirely from the host hash
+        mirror: zero dispatches, zero readbacks, no unconsumed flush-time
+        device handle."""
+        n = len(self.doc_ids)
+        return ((n == 0 or (self._hash_mirror is not None
+                            and len(self._hash_mirror) >= n))
+                and not any(i < n for i in self._doc_dirty)
+                and self._hash_handle is None
+                and getattr(self, "_poisoned", None) is None)
+
+    def _refresh_hash_mirror(self, want, interpret) -> None:
+        """Bring the host hash mirror current for `want` (doc indices;
+        None = every doc), doing the minimum device work:
+
+        - an unconsumed flush-time device handle covers every lane: ONE
+          readback refreshes the whole mirror, no reconcile dispatch;
+        - otherwise only lanes in `want` that are dirty reconcile, via the
+          narrow gathered sub-buffer (_reconcile_lanes), UNLESS a majority
+          of the fleet is dirty — then the classic full-buffer reconcile
+          is cheaper (and re-primes the device copy).
+        """
+        n = len(self.doc_ids)
+        mirror = self._ensure_hash_mirror()
+        if self._hash_handle is not None \
+                and (self._dirty or self.rows_dev is None):
+            # the handle predates a re-layout/invalidation (add_docs pad
+            # growth, _grow, remap): it can never be consumed — drop it,
+            # or hashes_clean would stay False forever and the sharded
+            # cache would re-read this shard on every fleet read
+            self._hash_handle = None
+        if self._hash_handle is not None and not self._dirty \
+                and self.rows_dev is not None:
+            # breadcrumb BEFORE the readback barrier: a tunnel hang
+            # surfaces at np.asarray below, and the flight recorder must
+            # already show this thread entered the readback
+            flightrec.record("rows_hash_readback", docs=n, cached=True)
+            with perfscope.phase("readback"):
+                vals = np.asarray(self._hash_handle)
+            mirror[:n] = vals[:n]
+            self._hash_handle = None   # consumed into the mirror
+            self._doc_dirty.clear()
+            return
+        dirty = sorted(i for i in self._doc_dirty if i < n
+                       and (want is None or i in want))
+        if not dirty:
+            return
+        if 2 * len(dirty) >= n:
+            # majority dirty: the narrow gather would copy most of the
+            # buffer anyway — run the full-buffer reconcile (one kernel
+            # shape for the steady fleet, device copy re-primed)
+            if self.rows_dev is None or self._dirty:
+                self.rows_dev = self._to_dev(self.rows_host)
+                self._dirty = False
+            h = metrics.dispatch_jit(
+                "reconcile_rows_hash", reconcile_rows_hash,
+                self.rows_dev, self.dims(), interpret)
+            flightrec.record("rows_hash_readback", docs=n, cached=False)
+            with perfscope.phase("readback"):
+                vals = np.asarray(h)
+            mirror[:n] = vals[:n]
+            self._hash_handle = None
+            self._doc_dirty.clear()
+            return
+        self._reconcile_lanes(dirty, interpret)
+
+    def _reconcile_lanes(self, idxs: list[int], interpret) -> None:
+        """Reconcile ONLY the given doc lanes: gather their columns from
+        the host row mirror into a narrow [ROWS, k_pad] buffer and run the
+        SAME fused kernel on it (dims carry no lane count, so the kernel
+        is reused across fleets; k_pad quantizes to the 128 lane width, so
+        recompiles are bounded by the dirty-set size distribution, not its
+        values). Dispatch + readback cost is O(dirty), independent of
+        fleet size — the difference between a convergence read that scales
+        and the r5 O(fleet) stall."""
+        k = len(idxs)
+        k_pad = pad_to_lanes(k)
+        # padding lanes must be VALID doc columns (a zero column is not:
+        # empty lanes carry -1 in the ac/fid/if/io bands); repeat the last
+        # dirty lane — its extra hashes are discarded below
+        sel = np.asarray(idxs + [idxs[-1]] * (k_pad - k), np.int64)
+        with perfscope.phase("pack"):
+            sub = np.ascontiguousarray(self.rows_host[:, sel])
+        h = metrics.dispatch_jit(
+            "reconcile_rows_hash", reconcile_rows_hash,
+            self._to_dev(sub), self.dims(), interpret)
+        flightrec.record("rows_hash_readback", docs=k, cached=False)
+        with perfscope.phase("readback"):
+            vals = np.asarray(h)
+        self._hash_mirror[np.asarray(idxs, np.int64)] = vals[:k]
+        self._doc_dirty.difference_update(idxs)
+
     def hashes(self, interpret: bool | None = None) -> np.ndarray:
-        """Current per-doc state hashes from resident state. Cached between
-        deltas: every apply path ends in a dispatch that already computed
-        them, so polling this does not re-dispatch the reconcile kernel."""
+        """Current per-doc state hashes from resident state, O(dirty) not
+        O(fleet): served from the host hash mirror; only lanes whose rows
+        changed since the last read are gathered and reconciled. A clean
+        read performs zero dispatches and zero readbacks; a read right
+        after a pipelined apply consumes the flush-time device hashes with
+        one readback and no reconcile."""
         self._check_poisoned()
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
@@ -1738,25 +1870,25 @@ class ResidentRowsDocSet(ResidentDocSet):
         # same recovery applies — the host mirror is authoritative, so drop
         # the buffer, mark dirty, and let the next call re-upload + retry.
         with metrics.trace("rows_hashes"), self._dispatch_guard():
-            if self.rows_dev is None or self._dirty:
-                self.rows_dev = self._to_dev(self.rows_host)
-                self._dirty = False
-                self._hash_handle = None
-            h = getattr(self, "_hash_handle", None)
-            cached = h is not None
-            if h is None:
-                h = metrics.dispatch_jit(
-                    "reconcile_rows_hash", reconcile_rows_hash,
-                    self.rows_dev, self.dims(), interpret)
-                self._hash_handle = h
-            # breadcrumb BEFORE the readback barrier: a tunnel hang
-            # surfaces at np.asarray below, and the flight recorder must
-            # already show this thread entered the readback
-            flightrec.record("rows_hash_readback", docs=len(self.doc_ids),
-                             cached=cached)
+            self._refresh_hash_mirror(None, interpret)
             metrics.gauge("rows_resident_bytes", self.resident_bytes())
-            with perfscope.phase("readback"):
-                return np.asarray(h)[:len(self.doc_ids)]
+            return self._hash_mirror[:len(self.doc_ids)].copy()
+
+    def hashes_for(self, idxs,
+                   interpret: bool | None = None) -> np.ndarray:
+        """Hashes for a subset of docs (indices into doc_ids) WITHOUT
+        reconciling untouched docs: device work is O(requested ∩ dirty).
+        Returns uint32 hashes aligned with idxs (the partial convergence
+        read the auditor's doc-level bisect uses)."""
+        self._check_poisoned()
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        idxs = [int(i) for i in idxs]
+        if not idxs:
+            return np.zeros(0, np.uint32)
+        with metrics.trace("rows_hashes"), self._dispatch_guard():
+            self._refresh_hash_mirror(set(idxs), interpret)
+            return self._hash_mirror[np.asarray(idxs, np.int64)].copy()
 
     def resident_bytes(self) -> int:
         """Footprint of this engine's resident state: the host row mirror,
@@ -1778,7 +1910,17 @@ class ResidentRowsDocSet(ResidentDocSet):
         doc_id -> anchor element ids of known-but-unadmitted changes that
         must keep their slots. Returns per-doc reclaim stats."""
         from .compaction import compact as _compact
-        return _compact(self, floors, pins)
+        stats = _compact(self, floors, pins)
+        # compaction preserves hashes BY DESIGN, but the mirror must not
+        # be the thing that hides a compaction bug: every doc whose slots
+        # actually moved re-reads through the kernel once
+        moved = [self.doc_index[d] for d, s in stats.items()
+                 if d in self.doc_index
+                 and (s["ops_after"] < s["ops_before"]
+                      or s["elems_after"] < s["elems_before"])]
+        if moved:
+            self._mark_hash_dirty(moved)
+        return stats
 
     def materialize(self, doc_id: str):
         """Snapshot one document by replaying its admitted change log

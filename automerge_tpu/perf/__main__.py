@@ -34,6 +34,11 @@ def _cmd_check(argv) -> int:
                     default=history.DEFAULT_COMPILE_GROWTH_PCT,
                     help="fail when total compiles exceed the rolling "
                          "median by more than pct (+2 absolute slack)")
+    ap.add_argument("--hash-growth-pct", type=float,
+                    default=history.DEFAULT_HASH_GROWTH_PCT,
+                    help="fail when the clean-fleet convergence read "
+                         "(fleet_hashes_s) exceeds the rolling median by "
+                         "more than pct (+0.25s absolute slack)")
     ap.add_argument("--no-backfill", action="store_true",
                     help="do not create the history file from the "
                          "committed BENCH_r0*.json captures when missing")
@@ -55,11 +60,17 @@ def _cmd_check(argv) -> int:
                   file=sys.stderr)
             return 2
         if "schema" not in record:   # a raw bench final/compact record
-            record = history.record_from_bench(record, source=args.record)
+            # stamp_host=False: the capture's provenance is whatever the
+            # record itself says (bench stamps `host` at run time) — the
+            # CHECKING machine's identity must not be invented onto a
+            # record produced elsewhere
+            record = history.record_from_bench(record, source=args.record,
+                                               stamp_host=False)
     rc, lines = history.check(
         path=path, record=record, window=args.window,
         threshold_pct=args.threshold_pct,
-        compile_growth_pct=args.compile_growth_pct)
+        compile_growth_pct=args.compile_growth_pct,
+        hash_growth_pct=args.hash_growth_pct)
     print("\n".join(lines))
     print("PERFCHECK", "FAIL" if rc else "OK")
     return rc

@@ -30,8 +30,28 @@ Record schema (one line of `bench_history.jsonl`, schema 1):
       "vs_baseline": <headline speedup>,
       "configs": {"<cfg>": {"speedup": .., "engine_ops_per_s": ..}},
       "perf": {"compiles_total": <n>, "kernels": {"<kernel>": <compiles>}},
-      "metrics": {<bench _metrics_rollup, when available>}
+      "metrics": {<bench _metrics_rollup, when available>},
+      "host": {"cpus": <n>, "machine": "x86_64"},   # additive (r6):
+                                 # the gate only compares same-host-class
+                                 # records (raw ops/sec is ~10x apart
+                                 # between a 2-core container and a big
+                                 # runner on identical code)
+      "fleet": {                 # additive (r6) — present when config 8 ran
+        "fleet_hashes_s": <clean-fleet hashes() wall seconds>,
+        "fleet_hashes_first_s": <all-dirty first read>,
+        "fleet_hashes_clean_shards": <n>, "fleet_hashes_dirty_shards": <n>,
+        "round_cost_scaling": <full/quarter round-cost ratio>,
+        "round_max_s": <max round>
+      }
     }
+
+The `fleet` section feeds the convergence-read gate: `perf check` fails
+when the clean-fleet `fleet_hashes_s` grows past the rolling same-backend
+median by more than `--hash-growth-pct` (+0.25s absolute slack for timer
+jitter on sub-second reads) — the regression it guards against is the
+exact r5 stall class (a convergence read silently going O(fleet) again).
+Same skip-clean semantics as the throughput gate: records missing the
+section on either side are never compared, and no baseline is invented.
 
 Backfilled records carry whatever the driver capture preserved (compact
 records have per-config speedups only; no `perf` section), and the gate
@@ -50,6 +70,7 @@ from __future__ import annotations
 import glob
 import json
 import os
+import platform
 import statistics
 import time
 
@@ -65,6 +86,16 @@ HISTORY_BASENAME = "bench_history.jsonl"
 DEFAULT_WINDOW = 8
 DEFAULT_THRESHOLD_PCT = 35.0
 DEFAULT_COMPILE_GROWTH_PCT = 50.0
+#: convergence-read gate: fail when the clean-fleet hashes() read exceeds
+#: the rolling same-backend median by more than this (+ the absolute
+#: slack, which absorbs timer jitter on reads that are milliseconds).
+DEFAULT_HASH_GROWTH_PCT = 100.0
+HASH_ABS_SLACK_S = 0.25
+
+#: config-8 fields copied into the history record's `fleet` section
+FLEET_KEYS = ("fleet_hashes_s", "fleet_hashes_first_s",
+              "fleet_hashes_clean_shards", "fleet_hashes_dirty_shards",
+              "round_cost_scaling", "round_max_s")
 
 
 def repo_root() -> str:
@@ -163,11 +194,34 @@ def _perf_from_configs(raw_configs) -> dict | None:
     return {"compiles_total": sum(kernels.values()), "kernels": kernels}
 
 
+def _fleet_from_configs(raw_configs) -> dict | None:
+    """The config-8 convergence-read numbers (the hash-gate inputs) out of
+    a full bench record's configs section. Compact/driver records and runs
+    without config 8 yield None — the gate then skips cleanly."""
+    if not isinstance(raw_configs, dict):
+        return None
+    v = raw_configs.get("8")
+    if not isinstance(v, dict):
+        return None
+    out = {k: v[k] for k in FLEET_KEYS
+           if isinstance(v.get(k), (int, float))}
+    return out or None
+
+
 def record_from_bench(rec: dict, source: str = "bench.py",
                       at: float | None = None,
-                      metrics_rollup: dict | None = None) -> dict:
+                      metrics_rollup: dict | None = None,
+                      stamp_host: bool = True) -> dict:
     """Build one history record from a bench final record (full `rec` from
-    bench._final_record, or a compact/driver-captured record)."""
+    bench._final_record, or a compact/driver-captured record).
+
+    Host identity: the bench record's own `host` field wins (the host is a
+    property of the RUN, stamped by bench.py at run time); otherwise the
+    current machine is stamped only when `stamp_host` is True (a live
+    append from this machine's own run). Backfills from captures that
+    predate host-stamping pass stamp_host=False — inventing a host for a
+    record of unknown provenance would put it in the wrong comparison
+    pool."""
     configs = _norm_configs(rec.get("configs"))
     out = {
         "schema": SCHEMA,
@@ -183,8 +237,23 @@ def record_from_bench(rec: dict, source: str = "bench.py",
     perf = _perf_from_configs(rec.get("configs"))
     if perf:
         out["perf"] = perf
+    fleet = _fleet_from_configs(rec.get("configs"))
+    if fleet:
+        out["fleet"] = fleet
     if metrics_rollup:
         out["metrics"] = metrics_rollup
+    # Host identity (r6): raw ops/sec is meaningless across machines — a
+    # 2-core container and a 32-core runner differ ~10x on the same code
+    # (the per-config SPEEDUP ratios, engine vs oracle on the same host,
+    # barely move). The gate compares a host-stamped record only against
+    # records from the SAME host class; see check().
+    rec_host = rec.get("host")
+    if isinstance(rec_host, dict) and "cpus" in rec_host:
+        out["host"] = {"cpus": rec_host.get("cpus"),
+                       "machine": rec_host.get("machine")}
+    elif stamp_host:
+        out["host"] = {"cpus": os.cpu_count() or 0,
+                       "machine": platform.machine()}
     return out
 
 
@@ -212,7 +281,7 @@ def backfill_records(root: str | None = None) -> list[dict]:
             continue
         rec = record_from_bench(
             parsed, source=f"backfill:{os.path.basename(path)}",
-            at=os.path.getmtime(path))
+            at=os.path.getmtime(path), stamp_host=False)
         out.append(rec)
     return out
 
@@ -241,6 +310,7 @@ def check(path: str | None = None, record: dict | None = None,
           window: int = DEFAULT_WINDOW,
           threshold_pct: float = DEFAULT_THRESHOLD_PCT,
           compile_growth_pct: float = DEFAULT_COMPILE_GROWTH_PCT,
+          hash_growth_pct: float = DEFAULT_HASH_GROWTH_PCT,
           ) -> tuple[int, list[str]]:
     """Compare the current run against the rolling same-backend median.
 
@@ -248,7 +318,8 @@ def check(path: str | None = None, record: dict | None = None,
     it; an explicit `record` (e.g. a freshly parsed bench line not yet
     appended) is judged against the whole file. Returns (exit_code,
     report_lines): 0 = ok or gracefully skipped (no comparable history),
-    1 = throughput regression or compile-count growth.
+    1 = throughput regression, compile-count growth, or convergence-read
+    (fleet_hashes_s) cost growth.
     """
     lines: list[str] = []
     records = load(path)
@@ -263,59 +334,109 @@ def check(path: str | None = None, record: dict | None = None,
     backend = current.get("backend") or "none"
     headline = current.get("headline_config")
     value = current.get("value")
+    # Host-scoping (r6): a host-stamped record compares only against
+    # records stamped with the SAME host class — raw throughput across
+    # machines differs ~10x on identical code, so a cross-host compare is
+    # either blind or permanently red (same reasoning as the backend
+    # rule). Un-stamped records (pre-r6 backfills) are excluded from a
+    # stamped record's pool; a record with no stamp keeps the old
+    # behavior.
+    cur_host = current.get("host")
+
+    def _host_ok(r: dict) -> bool:
+        return cur_host is None or r.get("host") == cur_host
+
     prior = [r for r in prior_pool
              if (r.get("backend") or "none") == backend
              and r.get("headline_config") == headline
+             and _host_ok(r)
              and isinstance(r.get("value"), (int, float))
              and r["value"] > 0][-window:]
+    host_note = "" if cur_host is None else \
+        f" host={cur_host.get('machine')}/{cur_host.get('cpus')}cpu"
     lines.append(f"perf check: current={current.get('source', '?')} "
                  f"backend={backend} headline_config={headline} "
-                 f"value={value} (history: {len(prior)} comparable of "
-                 f"{len(prior_pool)} prior)")
-    if not isinstance(value, (int, float)) or value <= 0:
-        lines.append("perf check: SKIP (current run has no headline "
-                     "throughput — partial/errored bench)")
-        return 0, lines
-    if not prior:
-        lines.append(f"perf check: SKIP (no prior {backend} history with "
-                     f"headline config {headline!r} to compare against)")
-        return 0, lines
-
+                 f"value={value}{host_note} (history: {len(prior)} "
+                 f"comparable of {len(prior_pool)} prior)")
     rc = 0
-    med = statistics.median(r["value"] for r in prior)
-    ratio = value / med
-    floor = 1.0 - threshold_pct / 100.0
-    verdict = "OK" if ratio >= floor else "REGRESSION"
-    lines.append(f"  throughput: {value:.0f} vs rolling median {med:.0f} "
-                 f"(x{ratio:.2f}, floor x{floor:.2f}) -> {verdict}")
-    if ratio < floor:
-        rc = 1
-
-    # per-config detail (informational: config mix varies across rounds)
-    cur_cfgs = current.get("configs") or {}
-    for cfg in sorted(cur_cfgs, key=lambda c: (len(c), c)):
-        cv = (cur_cfgs[cfg] or {}).get("engine_ops_per_s")
-        pv = [((r.get("configs") or {}).get(cfg) or {})
-              .get("engine_ops_per_s") for r in prior]
-        pv = [x for x in pv if isinstance(x, (int, float)) and x > 0]
-        if isinstance(cv, (int, float)) and cv > 0 and pv:
-            m = statistics.median(pv)
-            flag = "" if cv / m >= floor else "  <-- below floor"
-            lines.append(f"  config {cfg}: {cv:.0f} vs median {m:.0f} "
-                         f"(x{cv / m:.2f}){flag}")
-
-    cur_c = (current.get("perf") or {}).get("compiles_total")
-    prior_c = [(r.get("perf") or {}).get("compiles_total") for r in prior]
-    prior_c = [c for c in prior_c if isinstance(c, int)]
-    if isinstance(cur_c, int) and prior_c:
-        med_c = statistics.median(prior_c)
-        allowed = med_c * (1.0 + compile_growth_pct / 100.0) + 2
-        verdict = "OK" if cur_c <= allowed else "COMPILE GROWTH"
-        lines.append(f"  compiles: {cur_c} vs rolling median {med_c:.0f} "
-                     f"(allowed <= {allowed:.0f}) -> {verdict}")
-        if cur_c > allowed:
+    # Throughput + compile gates: skipped (never failed) without a
+    # headline value or comparable history. These skips must NOT return
+    # early — the convergence-read gate below has its own comparison pool
+    # (config 8 carries its own numbers; the headline-config restriction
+    # does not apply to it) and must still run.
+    if not isinstance(value, (int, float)) or value <= 0:
+        lines.append("perf check: SKIP throughput (current run has no "
+                     "headline throughput — partial/errored bench)")
+    elif not prior:
+        lines.append(f"perf check: SKIP throughput (no prior {backend} "
+                     f"history with headline config {headline!r} to "
+                     f"compare against)")
+    else:
+        med = statistics.median(r["value"] for r in prior)
+        ratio = value / med
+        floor = 1.0 - threshold_pct / 100.0
+        verdict = "OK" if ratio >= floor else "REGRESSION"
+        lines.append(f"  throughput: {value:.0f} vs rolling median "
+                     f"{med:.0f} (x{ratio:.2f}, floor x{floor:.2f}) "
+                     f"-> {verdict}")
+        if ratio < floor:
             rc = 1
-    elif isinstance(cur_c, int):
-        lines.append(f"  compiles: {cur_c} (no prior compile telemetry — "
-                     "comparison starts next run)")
+
+        # per-config detail (informational: config mix varies per round)
+        cur_cfgs = current.get("configs") or {}
+        for cfg in sorted(cur_cfgs, key=lambda c: (len(c), c)):
+            cv = (cur_cfgs[cfg] or {}).get("engine_ops_per_s")
+            pv = [((r.get("configs") or {}).get(cfg) or {})
+                  .get("engine_ops_per_s") for r in prior]
+            pv = [x for x in pv if isinstance(x, (int, float)) and x > 0]
+            if isinstance(cv, (int, float)) and cv > 0 and pv:
+                m = statistics.median(pv)
+                flag = "" if cv / m >= floor else "  <-- below floor"
+                lines.append(f"  config {cfg}: {cv:.0f} vs median {m:.0f} "
+                             f"(x{cv / m:.2f}){flag}")
+
+        cur_c = (current.get("perf") or {}).get("compiles_total")
+        prior_c = [(r.get("perf") or {}).get("compiles_total")
+                   for r in prior]
+        prior_c = [c for c in prior_c if isinstance(c, int)]
+        if isinstance(cur_c, int) and prior_c:
+            med_c = statistics.median(prior_c)
+            allowed = med_c * (1.0 + compile_growth_pct / 100.0) + 2
+            verdict = "OK" if cur_c <= allowed else "COMPILE GROWTH"
+            lines.append(f"  compiles: {cur_c} vs rolling median "
+                         f"{med_c:.0f} (allowed <= {allowed:.0f}) "
+                         f"-> {verdict}")
+            if cur_c > allowed:
+                rc = 1
+        elif isinstance(cur_c, int):
+            lines.append(f"  compiles: {cur_c} (no prior compile "
+                         "telemetry — comparison starts next run)")
+
+    # convergence-read gate (r6): the clean-fleet hashes() read must stay
+    # O(dirty) — a regression back to O(fleet) is the r5 stall class.
+    # Same skip-clean semantics as the throughput gate: only same-backend
+    # same-host records carrying the fleet section are compared (filter
+    # FIRST, then window — fleet-less runs in between must not consume
+    # window slots and blind the gate).
+    cur_h = (current.get("fleet") or {}).get("fleet_hashes_s")
+    prior_h = [(r.get("fleet") or {}).get("fleet_hashes_s")
+               for r in prior_pool
+               if (r.get("backend") or "none") == backend
+               and _host_ok(r)]
+    prior_h = [h for h in prior_h
+               if isinstance(h, (int, float)) and h > 0][-window:]
+    if isinstance(cur_h, (int, float)) and prior_h:
+        med_h = statistics.median(prior_h)
+        allowed_h = med_h * (1.0 + hash_growth_pct / 100.0) \
+            + HASH_ABS_SLACK_S
+        verdict = "OK" if cur_h <= allowed_h else "HASH-READ GROWTH"
+        lines.append(
+            f"  fleet_hashes_s: {cur_h:.4f} vs rolling median "
+            f"{med_h:.4f} (allowed <= {allowed_h:.4f}) -> {verdict}")
+        if cur_h > allowed_h:
+            rc = 1
+    elif isinstance(cur_h, (int, float)):
+        lines.append(f"  fleet_hashes_s: {cur_h:.4f} (no prior "
+                     "convergence-read telemetry — comparison starts "
+                     "next run)")
     return rc, lines

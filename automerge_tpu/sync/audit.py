@@ -208,11 +208,17 @@ class ConvergenceAuditor:
     def on_peer_shard(self, conn, msg: dict) -> None:
         peer_hashes = msg.get("hashes") or {}
         peer_clocks = msg.get("clocks") or {}
-        # compare against the local FULL doc table, not the same-label
-        # local shard: with differing shard counts the peer's shard k
-        # holds a different doc subset than ours, and a label-for-label
-        # compare would silently skip exactly the diverged doc
-        local_h = self.doc_set.hashes()   # cached between deltas
+        # compare against the local doc table, not the same-label local
+        # shard: with differing shard counts the peer's shard k holds a
+        # different doc subset than ours, and a label-for-label compare
+        # would silently skip exactly the diverged doc. The read is
+        # PARTIAL (hashes_for): only the docs the peer actually reported
+        # — reconciling untouched docs on the transport reader thread is
+        # exactly the O(fleet) cost the incremental plane removed
+        if hasattr(self.doc_set, "hashes_for"):
+            local_h = self.doc_set.hashes_for(sorted(peer_hashes))
+        else:
+            local_h = self.doc_set.hashes()   # interpretive doc sets
         for d in sorted(set(local_h) & set(peer_hashes)):
             lc, pc = self.doc_set.clock_of(d), peer_clocks.get(d)
             if lc != pc:

@@ -731,13 +731,22 @@ class EngineDocSet:
     # -- engine reads ---------------------------------------------------------
 
     def hashes(self) -> dict[str, int]:
-        """Converged per-doc state hashes (cached between deltas — polling
-        this does not re-dispatch the reconcile kernel)."""
+        """Converged per-doc state hashes, O(dirty) not O(fleet): the
+        engine serves clean docs from its host hash mirror and reconciles
+        only docs touched since the last read (engine/resident_rows.py
+        `_reconcile_lanes`); a clean read does zero device work."""
+        return self.hashes_snapshot()[0]
+
+    def hashes_snapshot(self) -> tuple[dict[str, int], int]:
+        """hashes() plus the engine hash epoch the result corresponds to —
+        the pair ShardedEngineDocSet caches per shard: the cached dict
+        stays servable while `hashes_dirty_since(epoch)` is False."""
         try:
             with metrics.trace("sync_hashes", **self._metric_labels()), \
                     self._lock:
                 self._maybe_flush_locked()
                 h = self._resident.hashes()
+                epoch = self._resident.hash_epoch
                 out = {d: int(h[i])
                        for d, i in self._resident.doc_index.items()}
         except BaseException:
@@ -749,6 +758,35 @@ class EngineDocSet:
         if callable(rb):    # per-shard memory footprint for post-mortems
             metrics.gauge("sync_shard_resident_bytes", rb(),
                           shard=str(self._shard))
+        return out, epoch
+
+    def hashes_dirty_since(self, epoch: int) -> bool:
+        """True when a hashes() read could differ from one taken at
+        `epoch`: either the engine mutated since (admission, compaction,
+        rebuild, new docs — engine.hash_epoch moved) or coalesced ingress
+        is pending (a read flushes it first)."""
+        with self._lock:
+            return bool(self._pending) \
+                or self._resident.hash_epoch != epoch
+
+    def hashes_for(self, doc_ids) -> dict[str, int]:
+        """Partial convergence read: hashes for ONLY the named docs,
+        reconciling nothing else (engine hashes_for is O(requested ∩
+        dirty)). Unknown ids are silently absent from the result — the
+        auditor compares the shared-doc intersection anyway."""
+        try:
+            with metrics.trace("sync_hashes", **self._metric_labels()), \
+                    self._lock:
+                self._maybe_flush_locked()
+                rset = self._resident
+                known = [d for d in doc_ids if d in rset.doc_index]
+                vals = rset.hashes_for([rset.doc_index[d] for d in known])
+                out = {d: int(v) for d, v in zip(known, vals)}
+        except BaseException:
+            self._drain_admitted_shielded()
+            raise
+        self._drain_admitted()
+        flightrec.record("hash_read", shard=self._shard, docs=len(out))
         return out
 
     # -- convergence audit surface (sync/audit.py) ----------------------------

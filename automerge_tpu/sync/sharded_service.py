@@ -21,10 +21,11 @@ from __future__ import annotations
 
 import contextlib
 import os
+import threading
 import zlib
 from typing import Callable
 
-from ..utils import flightrec, metrics
+from ..utils import flightrec, metrics, perfscope
 from .service import EngineDocSet
 
 # Stall-watchdog budget for the hash fan-out (the r5 config-8 hang site:
@@ -65,6 +66,18 @@ class ShardedEngineDocSet:
         # the flight-recorder progress events, so a post-mortem names which
         # round stalled and how far the fan-out got before stalling
         self._hash_round = 0
+        # per-shard dirty epochs (the incremental convergence plane): each
+        # entry caches (engine hash epoch, per-doc hash dict) from that
+        # shard's last read. hashes() fans out ONLY to shards whose state
+        # moved since (hashes_dirty_since) and serves the rest from the
+        # cache, so a clean-fleet read touches no engine at all. Guarded
+        # by _hash_cache_lock (reads can race ingress threads).
+        self._hash_cache: list[tuple[int, dict] | None] = [None] * n_shards
+        self._hash_cache_lock = threading.Lock()
+        # clean/dirty split of the most recent fan-out (bench/ops surface;
+        # also exported as the sync_hashes_{clean,dirty}_shards gauges)
+        self.last_hashes_clean_shards = 0
+        self.last_hashes_dirty_shards = n_shards
         for d in doc_ids or []:
             self.add_doc(d)
 
@@ -147,20 +160,93 @@ class ShardedEngineDocSet:
                                                      drain=drain)
 
     def hashes(self) -> dict[str, int]:
-        out: dict[str, int] = {}
+        """Fleet convergence read, O(dirty shards) not O(fleet): shards
+        untouched since their last read serve straight from the per-shard
+        hash cache (validated by the engine's hash epoch — zero engine
+        work, zero locks beyond the epoch check); dirty shards are read
+        CONCURRENTLY (dispatch all, then barrier) instead of serially, so
+        the wall cost is the slowest dirty shard, and each shard's own
+        read is O(its dirty docs) via the engine's lane-partial
+        reconcile. This is the r5 config-8 fix: the 100K-doc fleet's
+        180s+ serial full-fleet reconcile becomes a sub-second cache read
+        when nothing changed."""
         self._hash_round += 1
         rnd = self._hash_round
+        with self._hash_cache_lock:
+            cache = list(self._hash_cache)
+        clean: list[int] = []
+        dirty: list[int] = []
+        results: dict[int, tuple[dict, int]] = {}
+        failures: list[tuple[int, BaseException]] = []
+
+        def _read(k: int) -> None:
+            # per-shard progress breadcrumbs: if the fan-out stalls, the
+            # flight-recorder dump shows exactly how many shards answered
+            # before the stall — the diagnosis the r5 config-8 hang never
+            # produced
+            flightrec.record("hash_shard", shard=str(k), round=rnd)
+            try:
+                results[k] = self.shards[k].hashes_snapshot()
+            except BaseException as e:  # re-raised on the calling thread
+                failures.append((k, e))
+
+        # The epoch classification takes each shard's engine lock, so it
+        # runs INSIDE the watchdog too: a shard wedged by a hung apply
+        # must produce the watchdog diagnosis + flightrec breadcrumb, not
+        # a silent pre-fan-out block.
         with metrics.watchdog("sync_hashes_fanout", STALL_WATCHDOG_S,
-                              tags={"round": rnd}):
+                              tags={"round": rnd}), \
+                perfscope.phase("fleet_hashes"):
             for k, s in enumerate(self.shards):
-                # per-shard progress breadcrumbs: if the fan-out stalls,
-                # the flight-recorder dump shows exactly how many shards
-                # answered before the stall — the diagnosis the r5
-                # config-8 hang never produced
-                flightrec.record("hash_shard", shard=str(k), round=rnd)
-                out.update(s.hashes())
+                flightrec.record("hash_epoch_check", shard=str(k),
+                                 round=rnd)
+                c = cache[k]
+                if c is not None and not s.hashes_dirty_since(c[0]):
+                    clean.append(k)
+                else:
+                    dirty.append(k)
+            if len(dirty) <= 1:
+                for k in dirty:
+                    _read(k)
+            else:
+                threads = [threading.Thread(
+                    target=_read, args=(k,),
+                    name=f"amtpu-hashfan-{k}", daemon=False)
+                    for k in dirty]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+        with self._hash_cache_lock:
+            for k, (d, ep) in results.items():
+                self._hash_cache[k] = (ep, d)
+        if failures:
+            raise failures[0][1]
+        self.last_hashes_clean_shards = len(clean)
+        self.last_hashes_dirty_shards = len(dirty)
+        metrics.gauge("sync_hashes_clean_shards", len(clean))
+        metrics.gauge("sync_hashes_dirty_shards", len(dirty))
+        out: dict[str, int] = {}
+        for k in clean:
+            out.update(cache[k][1])
+        for k, (d, _ep) in results.items():
+            out.update(d)
         flightrec.record("hash_fanout_done", round=rnd,
-                         shards=self.n_shards, docs=len(out))
+                         shards=self.n_shards, docs=len(out),
+                         clean=len(clean), dirty=len(dirty))
+        return out
+
+    def hashes_for(self, doc_ids) -> dict[str, int]:
+        """Partial convergence read routed per shard: each owning shard
+        reconciles only its requested ∩ dirty docs (EngineDocSet
+        .hashes_for); untouched shards are never contacted."""
+        by_shard: dict[int, list[str]] = {}
+        for d in doc_ids:
+            by_shard.setdefault(
+                zlib.crc32(d.encode()) % self.n_shards, []).append(d)
+        out: dict[str, int] = {}
+        for k, ds in sorted(by_shard.items()):
+            out.update(self.shards[k].hashes_for(ds))
         return out
 
     def materialize(self, doc_id: str):

@@ -69,6 +69,9 @@ EVENT_KINDS: dict[str, str] = {
                  "(sync/service.py; shard/docs)",
     "hash_shard": "sharded hash fan-out reaching shard k "
                   "(sync/sharded_service.py; the stall-progress trail)",
+    "hash_epoch_check": "sharded fan-out probing shard k's dirty epoch "
+                        "(takes the shard lock — a wedged shard stalls "
+                        "HERE, inside the watchdog)",
     "hash_fanout_done": "sharded hash fan-out completed (round/shards/docs)",
     "engine_hash_readback": "docs-major engine device->host hash readback "
                             "barrier (engine/resident.py)",

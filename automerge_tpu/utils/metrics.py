@@ -147,6 +147,10 @@ GAUGES: dict[str, str] = {
     "rows_resident_bytes": "rows-engine resident-state footprint (bytes)",
     "sync_shard_resident_bytes":
         "per-shard resident-state footprint {shard=...}",
+    "sync_hashes_clean_shards":
+        "shards served from the hash cache on the last fleet hash read",
+    "sync_hashes_dirty_shards":
+        "shards re-read (dirty since epoch) on the last fleet hash read",
     "obs_live_arrays_bytes": "sampled live jax-array footprint (bytes)",
     "obs_live_arrays_peak_bytes":
         "high-water mark of the live jax-array footprint since reset",

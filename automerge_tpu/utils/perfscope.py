@@ -72,6 +72,9 @@ PHASES: dict[str, str] = {
     "host_materialize": "interpretive apply + snapshot materialization "
                         "(frontend/materialize.py)",
     "sync_wire": "wire encode/decode of sync frames (sync/frames.py)",
+    "fleet_hashes": "fleet-wide convergence reads: the sharded hash "
+                    "fan-out incl. per-shard dirty-lane reconciles "
+                    "(sync/sharded_service.py)",
 }
 
 #: seconds between jax.live_arrays() footprint samples (the walk is

@@ -58,6 +58,10 @@ def _inject_divergence(svc: EngineDocSet, doc_id: str) -> None:
     rset.rows_host[b["vh"], i] ^= 0x5A5A   # poke the op's value hash
     rset._dirty = True
     rset._hash_handle = None
+    # out-of-band mutation must also invalidate the incremental hash
+    # plane (engine/resident_rows.py): the mirror would otherwise keep
+    # serving the pre-corruption hash for this doc
+    rset._mark_hash_dirty([i])
 
 
 def test_audit_state_digest_matches_between_converged_replicas():

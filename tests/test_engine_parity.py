@@ -227,8 +227,10 @@ class TestFuzzConvergence:
 
 
 class TestDensePathParity:
-    """The dense docs-minor kernel and the vmapped segment kernel must agree
-    bit for bit; the DENSE_BUDGET heuristic only picks which one runs."""
+    """The EXPERIMENTAL dense one-hot kernel (demoted out of the product
+    dispatch in r6 — engine/experimental_dense.py) must still agree bit
+    for bit with the shipped segment kernel: this parity pin is what keeps
+    it eligible for a hardware A/B when a TPU window arrives."""
 
     def _workload(self):
         docs = []
@@ -243,28 +245,20 @@ class TestDensePathParity:
             docs.append(am.merge(s1, s2)._doc.opset.get_missing_changes({}))
         return docs
 
-    def test_dense_matches_segment(self, monkeypatch):
-        from automerge_tpu.engine import kernels
+    def test_dense_matches_segment(self):
+        import numpy as np
+
+        from automerge_tpu.engine import experimental_dense as xd
 
         docs = self._workload()
-
-        def run():
-            # distinct capacity per run defeats apply_doc's jit cache keyed
-            # only on (max_fids, host_order) + shapes
-            _, _, out = apply_batch(docs)
-            import numpy as np
-            return {k: np.asarray(v) for k, v in out.items()}
-
-        monkeypatch.setattr(kernels, "FORCE_DENSE", True)
-        monkeypatch.setattr(kernels, "DENSE_BUDGET", 1 << 60)
-        dense = run()
-        monkeypatch.setattr(kernels, "FORCE_DENSE", False)
-        monkeypatch.setattr(kernels, "DENSE_BUDGET", -1)
-        kernels.apply_doc.clear_cache()
-        segment = run()
-        kernels.apply_doc.clear_cache()
-
-        import numpy as np
+        # product path: apply_batch routes through kernels.apply_doc
+        # (segment formulation on every backend since the r6 demotion)
+        _, batch, out = apply_batch(docs)
+        segment = {k: np.asarray(v) for k, v in out.items()}
+        max_fids = segment["present"].shape[1]
+        dense = {k: np.asarray(v) for k, v in
+                 xd.reconcile_dense(batch, max_fids,
+                                    host_order=True).items()}
         assert set(dense) == set(segment)
         for k in dense:
             assert np.array_equal(dense[k], segment[k]), k

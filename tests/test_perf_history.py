@@ -24,6 +24,15 @@ def _rec(value, backend="cpu", source="test", compiles=None, configs=None):
     return out
 
 
+def _frec(value, hashes_s, backend="cpu", source="test"):
+    out = _rec(value, backend=backend, source=source)
+    if hashes_s is not None:
+        out["fleet"] = {"fleet_hashes_s": hashes_s,
+                        "fleet_hashes_clean_shards": 8,
+                        "fleet_hashes_dirty_shards": 0}
+    return out
+
+
 def _write(path, records):
     with open(path, "w") as f:
         for r in records:
@@ -116,6 +125,132 @@ def test_check_flags_compile_count_growth(tmp_path):
     rc, lines = history.check(path=p)
     assert rc == 1
     assert any("COMPILE GROWTH" in ln for ln in lines)
+
+
+def test_check_flags_hash_read_cost_growth(tmp_path):
+    """The convergence-read gate (r6): a clean-fleet hashes() read that
+    regresses back toward O(fleet) — well past the rolling median plus
+    the absolute slack — fails the check."""
+    p = str(tmp_path / "h.jsonl")
+    _write(p, [_frec(1000, 0.02), _frec(1000, 0.03),
+               _frec(1000, 6.5, source="o-fleet-regression")])
+    rc, lines = history.check(path=p)
+    assert rc == 1
+    assert any("HASH-READ GROWTH" in ln for ln in lines)
+
+
+def test_check_hash_gate_passes_within_slack(tmp_path):
+    """Sub-second jitter on a milliseconds-scale read must not trip the
+    gate (absolute slack): 20ms -> 120ms is noise, not a regression."""
+    p = str(tmp_path / "h.jsonl")
+    _write(p, [_frec(1000, 0.02), _frec(1000, 0.03),
+               _frec(1000, 0.12, source="jittery-rerun")])
+    rc, lines = history.check(path=p)
+    assert rc == 0, lines
+
+
+def test_check_hash_gate_skips_when_history_lacks_fleet(tmp_path):
+    """Skip-clean semantics, both directions: a record WITH the fleet
+    section judged against history WITHOUT it (and vice versa) is
+    informational, never a failure."""
+    p = str(tmp_path / "h.jsonl")
+    _write(p, [_rec(1000), _rec(1000),
+               _frec(1000, 5.0, source="first-with-fleet")])
+    rc, lines = history.check(path=p)
+    assert rc == 0
+    assert any("comparison starts next run" in ln for ln in lines)
+    _write(p, [_frec(1000, 0.02), _rec(1000, source="no-fleet-run")])
+    rc, lines = history.check(path=p)
+    assert rc == 0, lines
+
+
+def test_hash_gate_runs_even_when_throughput_gate_skips(tmp_path):
+    """The convergence-read gate has its own comparison pool (config 8
+    carries its own numbers): a run whose headline config changed — so
+    the throughput gate skips — must still be judged on fleet_hashes_s."""
+    p = str(tmp_path / "h.jsonl")
+    priors = [dict(_frec(1000, 0.02), headline_config="5"),
+              dict(_frec(1000, 0.03), headline_config="5")]
+    cur = dict(_frec(900, 8.0, source="headline-fellback"),
+               headline_config="1")
+    _write(p, priors + [cur])
+    rc, lines = history.check(path=p)
+    assert any("SKIP throughput" in ln for ln in lines)
+    assert rc == 1, lines
+    assert any("HASH-READ GROWTH" in ln for ln in lines)
+
+
+def test_hash_gate_window_not_consumed_by_fleetless_runs(tmp_path):
+    """Filter-then-window: runs without config 8 in between must not push
+    the comparable fleet records out of the gate's window."""
+    p = str(tmp_path / "h.jsonl")
+    recs = [_frec(1000, 0.02)] + [_rec(1000) for _ in range(10)] \
+        + [_frec(1000, 9.0, source="regressed-after-gap")]
+    _write(p, recs)
+    rc, lines = history.check(path=p)
+    assert rc == 1
+    assert any("HASH-READ GROWTH" in ln for ln in lines)
+
+
+def test_check_hash_gate_is_backend_scoped(tmp_path):
+    """A CPU run's hash read is never judged against TPU history."""
+    p = str(tmp_path / "h.jsonl")
+    _write(p, [_frec(1000, 0.001, backend="tpu"),
+               _frec(1000, 0.001, backend="tpu"),
+               _frec(1000, 0.5, backend="cpu", source="cpu-read")])
+    rc, lines = history.check(path=p)
+    assert rc == 0, lines
+
+
+def test_record_from_bench_extracts_fleet_section():
+    rec = {"value": 14000, "backend": "cpu", "configs": {
+        "8": {"engine_ops_per_s": 14000, "fleet_hashes_s": 0.02,
+              "fleet_hashes_first_s": 21.0,
+              "fleet_hashes_clean_shards": 8,
+              "fleet_hashes_dirty_shards": 0,
+              "round_cost_scaling": 1.05, "round_max_s": 0.4,
+              "round_max_cause": "GC"}}}
+    out = history.record_from_bench(rec)
+    assert out["fleet"] == {
+        "fleet_hashes_s": 0.02, "fleet_hashes_first_s": 21.0,
+        "fleet_hashes_clean_shards": 8, "fleet_hashes_dirty_shards": 0,
+        "round_cost_scaling": 1.05, "round_max_s": 0.4}
+    # compact/driver records without config-8 detail: no fleet section
+    assert "fleet" not in history.record_from_bench(
+        {"value": 100, "configs": {"8": 1.5}})
+
+
+def test_check_never_compares_across_hosts(tmp_path):
+    """Host-scoping rule (r6): a host-stamped record is judged only
+    against same-host-class records — raw ops/sec differs ~10x between a
+    small container and a big runner on identical code. Un-stamped
+    (pre-r6 backfill) records fall out of a stamped record's pool."""
+    p = str(tmp_path / "h.jsonl")
+    big = dict(_rec(10_000_000), host={"cpus": 32, "machine": "x86_64"})
+    unstamped = _rec(12_000_000)
+    small = dict(_rec(1_000_000, source="small-box"),
+                 host={"cpus": 2, "machine": "x86_64"})
+    _write(p, [big, unstamped, small])
+    rc, lines = history.check(path=p)
+    assert rc == 0
+    assert any("SKIP" in ln for ln in lines)
+    # same-host history DOES gate
+    small2 = dict(_rec(400_000, source="small-box-regressed"),
+                  host={"cpus": 2, "machine": "x86_64"})
+    _write(p, [small, dict(small), small2])
+    rc, lines = history.check(path=p)
+    assert rc == 1
+    assert any("REGRESSION" in ln for ln in lines)
+    # an UN-stamped current record keeps the old pan-host behavior
+    _write(p, [_rec(1000), _rec(1000), _rec(980, source="ok-rerun")])
+    rc, lines = history.check(path=p)
+    assert rc == 0, lines
+
+
+def test_record_from_bench_stamps_host():
+    out = history.record_from_bench({"value": 100, "configs": {}})
+    assert out["host"]["cpus"] >= 1
+    assert isinstance(out["host"]["machine"], str)
 
 
 def test_check_never_compares_across_backends(tmp_path):
