@@ -33,6 +33,9 @@ bench:
 perfcheck:
 	JAX_PLATFORMS=cpu python -m automerge_tpu.perf check
 
-# The bench-history trajectory + latest compile telemetry, human-readable.
+# The bench-history trajectory + latest compile telemetry + the
+# contention & convergence-lag section (per-lock wait/hold, sampled
+# op-lag stages), human-readable.
 perfreport:
 	JAX_PLATFORMS=cpu python -m automerge_tpu.perf report
+	JAX_PLATFORMS=cpu python -m automerge_tpu.perf contention

@@ -57,6 +57,16 @@ DEFAULT_SCOPE = ("automerge_tpu/sync/", "automerge_tpu/utils/")
 _LOCK_FACTORIES = {
     "threading.Lock", "threading.RLock", "threading.Condition",
     "threading.Semaphore", "threading.BoundedSemaphore",
+    # the lockprof wrappers (utils/lockprof.py) are drop-in lock
+    # factories: an instrumented lock must keep its class-qualified
+    # identity (EngineDocSet._lock) and keep participating in ABBA /
+    # blocking-call analysis — profiling a lock must never exempt it
+    # from the discipline the profile exists to inform
+    "automerge_tpu.utils.lockprof.InstrumentedLock",
+    "automerge_tpu.utils.lockprof.InstrumentedRLock",
+    "automerge_tpu.utils.lockprof.InstrumentedCondition",
+    "lockprof.InstrumentedLock", "lockprof.InstrumentedRLock",
+    "lockprof.InstrumentedCondition",
 }
 _THREAD_FACTORY = "threading.Thread"
 

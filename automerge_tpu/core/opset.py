@@ -29,7 +29,7 @@ from __future__ import annotations
 
 from typing import Any, Iterator
 
-from ..utils import metrics
+from ..utils import metrics, oplag
 from ..utils.persist import AList, CowDict, EMPTY_ALIST
 from .change import Change, Op
 from .ids import HEAD, ROOT_ID, make_elem_id, parse_elem_id
@@ -535,6 +535,10 @@ def apply_change(b: Builder, change: Change, emit: bool = True) -> list[dict]:
     metrics.bump("core_changes_applied")
     metrics.bump("core_ops_applied", len(change.ops))
     metrics.bump("core_diffs_emitted", len(diffs))
+    # op-lifecycle plane: a change that sat causally-unready in the
+    # queue records its dependency-wait here (no-op unless it was parked
+    # — one unlocked empty-table check on the common path)
+    oplag.queue_admitted(actor, seq)
     return diffs
 
 
@@ -660,6 +664,10 @@ class OpSet:
         # causal-queue depth after the batch: a growing gauge means peers
         # are delivering out of causal order (or a dep will never arrive)
         metrics.gauge("core_queue_depth", len(b.queue))
+        # op-lifecycle plane: mark when parking began (one locked batch
+        # call; 1/N hash-sampled inside)
+        if b.queue:
+            oplag.queue_park_batch([(c.actor, c.seq) for c in b.queue])
         # coarse host-object estimate (change header + per-op records);
         # exact sizeof walks would cost more than the queue is worth
         metrics.gauge("core_queue_bytes",

@@ -1,6 +1,6 @@
 """CLI for the performance plane: `python -m automerge_tpu.perf
-{report,check,roofline,resident}` (docs/OBSERVABILITY.md "Performance
-plane").
+{report,check,contention,roofline,resident}` (docs/OBSERVABILITY.md
+"Performance plane" / "Contention & convergence lag").
 
 Exit codes: 0 = ok (including a gracefully skipped check), 1 = the
 regression gate tripped, 2 = usage error.
@@ -115,6 +115,30 @@ def _cmd_report(argv) -> int:
     detail = os.path.join(os.path.dirname(path), "BENCH_DETAIL.json")
     if os.path.exists(detail):
         print(f"# full per-config breakdown: {detail}")
+        # the contention & convergence-lag section (informational; the
+        # quantified baseline ROADMAP #1's ingestion refactor lands
+        # against — docs/OBSERVABILITY.md "Contention & convergence lag")
+        from . import contention
+        for line in contention.report_lines(detail_path=detail):
+            print(line)
+    return 0
+
+
+def _cmd_contention(argv) -> int:
+    ap = argparse.ArgumentParser(prog="automerge_tpu.perf contention")
+    ap.add_argument("--detail", default=None,
+                    help="BENCH_DETAIL.json to read per-config snapshots "
+                         "from (default: repo root)")
+    ap.add_argument("--snapshot", default=None,
+                    help="render a raw metrics.snapshot() JSON file "
+                         "instead of the bench detail")
+    ap.add_argument("--config", default=None,
+                    help="restrict the detail report to one bench config")
+    args = ap.parse_args(argv)
+    from . import contention
+    print("\n".join(contention.report_lines(
+        detail_path=args.detail, snapshot_path=args.snapshot,
+        config=args.config)))
     return 0
 
 
@@ -123,6 +147,7 @@ def main(argv=None) -> int:
     commands = {
         "check": _cmd_check,
         "report": _cmd_report,
+        "contention": _cmd_contention,
     }
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)
@@ -139,7 +164,7 @@ def main(argv=None) -> int:
         resident.main(rest)
         return 0
     print(f"unknown command {cmd!r}; expected one of "
-          "report, check, roofline, resident", file=sys.stderr)
+          "report, check, contention, roofline, resident", file=sys.stderr)
     return 2
 
 

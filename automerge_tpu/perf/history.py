@@ -151,7 +151,13 @@ def _norm_configs(raw) -> dict:
     for cfg, v in raw.items():
         if isinstance(v, dict):
             entry = {k: v[k] for k in ("speedup", "engine_ops_per_s",
-                                       "device_speedup", "backend")
+                                       "device_speedup", "backend",
+                                       # the contention plane (r7):
+                                       # per-config lock wait + sampled
+                                       # op-lag percentiles, the baseline
+                                       # ROADMAP #1's refactor must beat
+                                       "lock_wait_total_s",
+                                       "op_lag_p50_s", "op_lag_p99_s")
                      if isinstance(v.get(k), (int, float, str))}
         elif isinstance(v, (int, float)):
             entry = {"speedup": v}

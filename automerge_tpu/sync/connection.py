@@ -27,8 +27,8 @@ from typing import Callable
 
 from ..core import clock as C
 from ..core.change import coerce_change
-from ..utils import metrics
-from .frames import TRACE_KEY, pack_trace, unpack_trace
+from ..utils import metrics, oplag
+from .frames import OPLAG_KEY, TRACE_KEY, pack_trace, unpack_trace
 
 
 class Connection:
@@ -117,6 +117,12 @@ class Connection:
                 metrics.bump("sync_frame_bytes_sent", len(msg["frame"]))
             else:
                 msg["changes"] = [c.to_dict() for c in changes]
+            # op-lifecycle provenance (utils/oplag.py): a sampled op of
+            # this doc awaiting shipping rides out on this message, so
+            # the peer can record wire / apply / convergence lag
+            hdr = oplag.wire_header(doc_id)
+            if hdr is not None:
+                msg[OPLAG_KEY] = hdr
         self._send_traced(msg)
 
     def maybe_send_changes(self, doc_id: str) -> None:
@@ -218,6 +224,9 @@ class Connection:
             return None
         if self._handle_audit_msg(msg):
             return None
+        # op-lifecycle provenance: records the wire lag now, the
+        # peer-apply + convergence lag once the apply below finishes
+        lag = oplag.wire_receive(msg.pop(OPLAG_KEY, None))
         doc_id = msg["docId"]
         if msg.get("clock") is not None:
             self._their_clock = self._clock_union(self._their_clock, doc_id,
@@ -233,11 +242,16 @@ class Connection:
             # as-is (the engine service's native-encoder seam); plain
             # DocSets materialize changes from them.
             if hasattr(self._doc_set, "apply_columns"):
-                return self._doc_set.apply_columns(doc_id, cols)
-            return self._doc_set.apply_changes(doc_id, cols.to_changes())
+                out = self._doc_set.apply_columns(doc_id, cols)
+            else:
+                out = self._doc_set.apply_changes(doc_id, cols.to_changes())
+            oplag.peer_applied(lag)
+            return out
         if msg.get("changes") is not None:
-            return self._doc_set.apply_changes(
+            out = self._doc_set.apply_changes(
                 doc_id, [coerce_change(c) for c in msg["changes"]])
+            oplag.peer_applied(lag)
+            return out
 
         if self._doc_set.get_doc(doc_id) is not None:
             self.maybe_send_changes(doc_id)

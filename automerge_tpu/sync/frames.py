@@ -68,6 +68,15 @@ FRAME_MAGIC = b"AMW1"
 
 TRACE_KEY = "trace"
 
+# Op-lifecycle provenance header (utils/oplag.py): change-bearing
+# messages whose doc carries a sampled op additionally ship an
+# `"oplag": "<id>,<t_admit>,<t_send>"` key beside the trace header —
+# same envelope rules (JSON part of both wire forms; unknown-key-ignored
+# by peers that predate it). The receiver records the wire / peer-apply /
+# convergence lag stages from it (docs/OBSERVABILITY.md "Contention &
+# convergence lag").
+OPLAG_KEY = "oplag"
+
 
 def pack_trace(ctx: dict) -> str:
     """`{"tid": ..., "sid": ...}` -> compact `tid-sid` wire header."""

@@ -27,10 +27,9 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-import threading
 
 from ..core.change import Change, coerce_change
-from ..utils import metrics
+from ..utils import lockprof, metrics
 
 
 class LogArchive:
@@ -39,7 +38,11 @@ class LogArchive:
     def __init__(self, root: str):
         self.root = root
         os.makedirs(root, exist_ok=True)
-        self._lock = threading.Lock()
+        # instrumented (utils/lockprof.py): a lagging peer's O(history)
+        # cold read holds this across a full file parse (ADVICE.md low
+        # #2) — the wait histogram is how that cost stays visible until
+        # the storage-tier rework streams reads outside the lock
+        self._lock = lockprof.InstrumentedLock("archive")
 
     def _path(self, doc_id: str) -> str:
         h = hashlib.sha1(doc_id.encode()).hexdigest()[:20]

@@ -30,7 +30,7 @@ from typing import Callable
 from ..core.change import Change
 from ..engine.resident import ResidentDocSet
 from ..engine.resident_rows import CompactionAnchorError, DeviceDispatchError
-from ..utils import flightrec, metrics
+from ..utils import flightrec, lockprof, metrics, oplag, perfscope
 
 
 class _HandleOpSet:
@@ -152,7 +152,17 @@ class EngineDocSet:
         self._view_subs: list[Callable] = []
         # One node can serve several transport peers (TcpSyncServer spawns a
         # reader thread per socket); the resident engine is not re-entrant.
-        self._lock = threading.RLock()
+        # Instrumented (utils/lockprof.py): THIS is the lock ROADMAP #1's
+        # lock-free ingestion refactor exists to retire — its wait/hold
+        # histograms (sync_lock_wait_s{lock=service}, ...) are the
+        # refactor's recorded baseline. ShardedEngineDocSet renames each
+        # shard's label to service_shard<k>.
+        self._lock = lockprof.InstrumentedRLock("service")
+        # sampled op-lifecycle tokens awaiting this node's next flush,
+        # and flushed-round recordings awaiting the post-lock drain
+        # (utils/oplag.py; both mutated under self._lock)
+        self._lag_pending: list = []
+        self._lag_flushed: list = []
         # Diff records are index-based patches, so subscribers must see a
         # doc's batches in ingress order — but running callbacks under
         # self._lock would let a subscriber that grabs its own lock deadlock
@@ -300,9 +310,18 @@ class EngineDocSet:
         """Shared ingress tail: run apply_fn (which scatters the delta and,
         in live-view mode, reconciles + emits diffs), log admissions, fold
         diff records into the doc's mirror view."""
+        tok = oplag.admit(doc_id)
+        flush_t0 = flush_s = 0.0
         with self._lock:
             self.add_doc(doc_id)
+            if tok is not None:
+                flush_t0 = _time.perf_counter()
             diffs = apply_fn()
+            if tok is not None:
+                # docs-major ingress applies inline: no coalescing queue,
+                # the apply IS the flush stage (recorded below, after the
+                # lock releases — profiler cost must not inflate holds)
+                flush_s = _time.perf_counter() - flush_t0
             admitted = self._resident.last_admitted.get(doc_id, [])
             log = self._log[doc_id]
             for c in admitted:
@@ -314,6 +333,9 @@ class EngineDocSet:
             handle = self.get_doc(doc_id)
             if records:
                 self._notify_queue.append((doc_id, records))
+        oplag.flush_boundary((doc_id,))   # retire a stale awaiting token
+        if tok is not None:
+            oplag.flushed(tok, flush_start=flush_t0, flush_s=flush_s)
         if records:
             self._drain_notifications()
         if admitted:
@@ -376,6 +398,9 @@ class EngineDocSet:
                     rset._check_ghost_anchors_cols(
                         i, cols, 0, len(cols.op_action))
                 self._pending.setdefault(doc_id, []).append(cols)
+                tok = oplag.admit(doc_id)
+                if tok is not None:
+                    self._lag_pending.append(tok)
                 if not self._batch_depth:
                     self._flush_locked()
                 handle = self.get_doc(doc_id)
@@ -401,10 +426,27 @@ class EngineDocSet:
         round_no = self._round_seq
         flightrec.record("round_flush", shard=self._shard, round=round_no,
                          docs=len(self._pending), ops=int(n_ops))
+        # sampled op-lifecycle tokens riding this round (utils/oplag.py):
+        # taken out NOW so a failing flush drops rather than re-times them
+        toks, self._lag_pending = self._lag_pending, []
+        round_docs = frozenset(self._pending) if oplag.enabled() else None
+        phases0 = perfscope.phase_totals() if toks else None
         t0 = _time.perf_counter()
         with metrics.trace("sync_round_flush", tags={"round": round_no},
                            **labels):
             self._flush_pending_locked()
+        if round_docs is not None:
+            deltas = None
+            if toks:
+                p1 = perfscope.phase_totals()
+                deltas = {k: p1.get(k, 0.0) - phases0.get(k, 0.0)
+                          for k in ("pack", "dispatch", "device_wait")}
+            # stage recording happens OUTSIDE self._lock (and outside the
+            # round-latency window below): _drain_lag_records drains this
+            # after release, so the profiler's own cost never inflates
+            # the hold-time / round-latency baselines it exists to record
+            self._lag_flushed.append(
+                (toks, round_docs, t0, _time.perf_counter() - t0, deltas))
         # failure paths raise out of the span (its timing still records).
         # The swallowed mid-admission rebuild path restores the round to
         # self._pending for retry — subtract those ops so throughput
@@ -605,11 +647,31 @@ class EngineDocSet:
         except Exception:
             pass
 
+    def _drain_lag_records(self) -> None:
+        """Record sampled op-lifecycle stages for flushed rounds OUTSIDE
+        self._lock: histogram updates, flight-recorder appends, and the
+        periodic percentile refresh must not inflate the service-lock
+        hold time or round latency the contention plane exists to
+        measure. Runs before handler gossip so every token is parked in
+        the awaiting-wire table before its doc's message leaves."""
+        if not self._lag_flushed:
+            return
+        with self._lock:
+            batch, self._lag_flushed = self._lag_flushed, []
+        for toks, round_docs, t0, flush_s, deltas in batch:
+            # retire stale awaiting tokens for docs this round re-flushed
+            # BEFORE parking the round's own tokens
+            oplag.flush_boundary(round_docs)
+            for tok in toks:
+                oplag.flushed(tok, flush_start=t0, flush_s=flush_s,
+                              phases=deltas)
+
     def _drain_admitted(self) -> None:
         """Notify handlers for admitted docs, outside self._lock (a handler
         — e.g. a Connection — may call back into this node). Inside a
         batch() the calling thread still holds the lock, so draining
         defers to the batch exit (which runs after release)."""
+        self._drain_lag_records()
         while True:
             with self._lock:
                 if self._batch_depth or not self._admit_notify:

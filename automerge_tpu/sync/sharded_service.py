@@ -62,6 +62,9 @@ class ShardedEngineDocSet:
             for k in range(n_shards)]
         for k, s in enumerate(self.shards):
             s._shard = str(k)   # per-shard metric series (sync_round_flush…)
+            # per-shard lock-contention series (bounded: one per shard),
+            # so the lockprof plane separates a hot shard from the rest
+            s._lock.rename(f"service_shard{k}")
         # monotonic hash fan-out counter: tagged onto the fan-out span and
         # the flight-recorder progress events, so a post-mortem names which
         # round stalled and how far the fan-out got before stalling

@@ -32,6 +32,7 @@ import socket
 import struct
 import threading
 
+from ..utils import lockprof
 from .connection import Connection
 
 def _sync_lock_of(doc_set) -> threading.RLock:
@@ -167,7 +168,11 @@ class _Peer:
 
     def __init__(self, doc_set, sock: socket.socket, wire: str = "json"):
         self.sock = sock
-        self._send_lock = threading.Lock()
+        # instrumented (utils/lockprof.py): a peer wedged mid-sendall
+        # shows up in the contention plane (sync_lock_wait_s{lock=
+        # peer_send}) and the post-mortem holder table names the thread
+        # stuck inside the write
+        self._send_lock = lockprof.InstrumentedLock("peer_send")
         self.connection = LockedConnection(doc_set, self._send, wire=wire)
         # named so flight-recorder event tails and watchdog span stacks
         # attribute socket work to the right peer reader (not "Thread-3")

@@ -85,6 +85,12 @@ EVENT_KINDS: dict[str, str] = {
                    "(sync/audit.py; shards/mismatched)",
     "divergence": "a convergence divergence isolated to one doc "
                   "(sync/audit.py; shard/doc)",
+    "oplag_admit": "a sampled op entered the lifecycle plane "
+                   "(utils/oplag.py; id/doc — the provenance id every "
+                   "later oplag_stage event of this op carries)",
+    "oplag_stage": "one lifecycle stage of a sampled op completed "
+                   "(utils/oplag.py; id/stage/s — admission queue wait, "
+                   "flush, wire, peer apply, convergence)",
 }
 
 
@@ -164,12 +170,18 @@ def dump(reason: str, path: str | None = None,
         for e in evs:
             threads.setdefault(e["thread"], []).append(e)
         threads = {t: es[-_PER_THREAD:] for t, es in threads.items()}
+        try:    # who currently holds which instrumented lock (lockprof)
+            from . import lockprof
+            lock_holders = lockprof.holders_snapshot()
+        except Exception:
+            lock_holders = {}
         doc = {
             "reason": reason,
             "at": time.time(),
             "pid": os.getpid(),
             "argv": sys.argv,
             "span_stacks": metrics.span_stacks(),
+            "lock_holders": lock_holders,
             "threads": threads,
             "recent_spans": metrics.recent_spans(),
             "watchdog_events": metrics.watchdog_events(),

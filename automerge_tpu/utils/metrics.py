@@ -119,6 +119,15 @@ COUNTERS: dict[str, str] = {
     "sync_archive_tail_repaired": "torn archive tails repaired on open",
     "sync_archive_tail_skipped": "torn archive tails skipped on read",
     "sync_metrics_pulls": "remote metrics snapshots served to peers",
+    # lockprof (utils/lockprof.py): the contention plane. The `_total`
+    # suffix is deliberate prometheus idiom for this one counter (it
+    # exports as-is; the exporter adds no suffix to counters).
+    "sync_lock_contended_total":
+        "lock acquisitions that found the lock held {lock=...} "
+        "(utils/lockprof.py)",
+    "sync_ops_sampled":
+        "ingress ops sampled by the op-lifecycle plane (utils/oplag.py; "
+        "1 of every AMTPU_OPLAG_SAMPLE admissions)",
     "sync_audit_pulls": "convergence-audit digest requests served to peers",
     "sync_audits_completed":
         "convergence-audit rounds completed against a peer's digests",
@@ -154,10 +163,30 @@ GAUGES: dict[str, str] = {
     "obs_live_arrays_bytes": "sampled live jax-array footprint (bytes)",
     "obs_live_arrays_peak_bytes":
         "high-water mark of the live jax-array footprint since reset",
+    # oplag (utils/oplag.py): rolling per-stage lag percentiles over the
+    # sampled-op reservoir (refreshed every few samples; the exact
+    # reservoir lives in the snapshot's nested "oplag" section)
+    "sync_op_lag_p50_s":
+        "rolling median sampled-op lag {stage=...} (utils/oplag.py)",
+    "sync_op_lag_p99_s":
+        "rolling p99 sampled-op lag {stage=...} (utils/oplag.py)",
 }
 
 HISTOGRAMS: dict[str, str] = {
     "sync_round_seconds": "latency of coalesced service round flushes",
+    # lockprof (utils/lockprof.py): per-lock contention profile. Named
+    # with the `_s` unit suffix (the ISSUE-6 contract names) — they
+    # export as `sync_lock_wait_s{lock=...}_{count,sum,min,max}`.
+    "sync_lock_wait_s":
+        "time spent waiting to acquire an instrumented lock {lock=...}",
+    "sync_lock_hold_s":
+        "outermost hold time of an instrumented lock {lock=...}",
+    # oplag (utils/oplag.py): per-stage lag of sampled ops through the
+    # admission -> flush -> wire -> peer-apply -> converged lifecycle
+    "sync_op_lag_s":
+        "sampled op-lifecycle stage lag {stage=causal_queue|queue_wait|"
+        "pack|dispatch|device_wait|flush|origin_total|wire|peer_apply|"
+        "converge} (utils/oplag.py; docs/OBSERVABILITY.md)",
 }
 
 SPANS: dict[str, str] = {
@@ -483,6 +512,13 @@ def snapshot() -> dict:
         perf = None
     if perf:
         out["perf"] = perf
+    try:    # the op-lifecycle lag percentiles (same nested-section rule)
+        from . import oplag
+        lag = oplag.lag_snapshot()
+    except Exception:
+        lag = None
+    if lag:
+        out["oplag"] = lag
     return out
 
 
@@ -495,6 +531,11 @@ def reset() -> None:
     try:
         from . import perfscope
         perfscope.reset()
+    except Exception:
+        pass
+    try:
+        from . import oplag
+        oplag.reset()
     except Exception:
         pass
 
@@ -772,16 +813,25 @@ def watchdog(name: str, budget_s: float, logger=None,
         desc = "; ".join(f"{t}: {' > '.join(s)}"
                          for t, s in sorted(stacks.items())) \
             or "no active spans"
+        try:    # who holds what, not just which span stalled (lockprof)
+            from . import lockprof
+            holders = lockprof.holders_snapshot()
+        except Exception:
+            holders = {}
+        hdesc = "; ".join(
+            f"{n} held {h['held_s']:.2f}s by {h['thread']} ({h['site']})"
+            for n, h in sorted(holders.items())) or "none"
         lg.warning(
             "watchdog %r: traced region still running after %.2fs "
-            "(budget %.2fs); active spans: %s",
-            name, time.perf_counter() - t_start, budget_s, desc)
+            "(budget %.2fs); active spans: %s; lock holders: %s",
+            name, time.perf_counter() - t_start, budget_s, desc, hdesc)
         bump("obs_watchdog_fired", name=name)
         with _global.lock:
             _global.watchdog_events.append({
                 "name": name, "budget_s": budget_s,
                 "elapsed_s": round(time.perf_counter() - t_start, 3),
-                "spans": stacks, "at": time.time()})
+                "spans": stacks, "lock_holders": holders,
+                "at": time.time()})
         try:    # the stall post-mortem: one self-contained JSON file
             from . import flightrec
             flightrec.record("watchdog_fire", name=name,

@@ -415,6 +415,16 @@ def phase(name: str):
                 e[1] += 1
 
 
+def phase_totals() -> dict[str, float]:
+    """Accumulated seconds per phase since the last reset — a cheap
+    point-in-time read (one locked dict copy). The op-lifecycle plane
+    (utils/oplag.py) snapshots this around a round flush and attributes
+    the delta (pack/dispatch/device_wait) to the sampled ops that rode
+    the round."""
+    with _store.lock:
+        return {n: e[0] for n, e in _store.phases.items()}
+
+
 def phased(name: str):
     """Decorator form of phase() for whole-function attribution (the pack
     entry points in engine/pack.py). Same lint discipline: the name

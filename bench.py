@@ -57,6 +57,48 @@ HEADLINE_METRIC = ("ops-applied/sec, 10K-doc DocSet merge with "
 PASSES = 24
 CPU_PASSES = 4
 
+# -- faulthandler hygiene around timed regions (ADVICE.md low #3) -----------
+# The worker arms faulthandler.dump_traceback_later(180, repeat=True) for
+# tunnel-hang forensics; left armed, those periodic all-thread stack dumps
+# fire INSIDE timed measurement regions and perturb the numbers on small
+# hosts. Host-side timed loops run under _quiet_traceback_dumps(), which
+# cancels the watchdog and re-arms it on exit. Device-dispatch regions
+# (run_engine's TPU passes) deliberately stay armed: a wedged transfer or
+# remote compile is exactly what the dumps exist to localize, and their
+# timings are link-dominated.
+
+_FH_INTERVAL_S = 180
+_fh_armed = False
+
+
+def _arm_traceback_dumps() -> None:
+    import faulthandler
+    global _fh_armed
+    faulthandler.dump_traceback_later(_FH_INTERVAL_S, repeat=True,
+                                      exit=False, file=sys.stderr)
+    _fh_armed = True
+
+
+def _quiet_traceback_dumps():
+    """Context manager: suspend the periodic traceback dumps for a timed
+    host-side measurement region, re-arming after. No-op when the worker
+    never armed them (library use, tests)."""
+    import contextlib
+    import faulthandler
+
+    @contextlib.contextmanager
+    def _cm():
+        if not _fh_armed:
+            yield
+            return
+        faulthandler.cancel_dump_traceback_later()
+        try:
+            yield
+        finally:
+            faulthandler.dump_traceback_later(_FH_INTERVAL_S, repeat=True,
+                                              exit=False, file=sys.stderr)
+    return _cm()
+
 
 def _passes() -> int:
     import jax
@@ -227,28 +269,30 @@ def run_text_load_config(n_edits=65536, oracle_cap=None):
     import statistics
     ora_ts, blk_ts = [], []
     doc_small_oracle = doc_small_bulk = None
-    for _ in range(3):
-        # the oracle's timed region keeps parse + coerce + apply — the
-        # same wire-string start line am.load pays on the engine side
-        t0 = time.perf_counter()
-        d = am.init("o")
-        doc_small_oracle = apply_changes_to_doc(
-            d, d._doc.opset,
-            [coerce_change(c) for c in json.loads(small)],
-            incremental=False)
-        ora_ts.append(time.perf_counter() - t0)
-        t0 = time.perf_counter()
-        doc_small_bulk = am.load(small)
-        blk_ts.append(time.perf_counter() - t0)
+    with _quiet_traceback_dumps():
+        for _ in range(3):
+            # the oracle's timed region keeps parse + coerce + apply — the
+            # same wire-string start line am.load pays on the engine side
+            t0 = time.perf_counter()
+            d = am.init("o")
+            doc_small_oracle = apply_changes_to_doc(
+                d, d._doc.opset,
+                [coerce_change(c) for c in json.loads(small)],
+                incremental=False)
+            ora_ts.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            doc_small_bulk = am.load(small)
+            blk_ts.append(time.perf_counter() - t0)
     oracle_small_s = statistics.median(ora_ts)
     bulk_small_s = statistics.median(blk_ts)
     assert try_bulk_load(small) is not None, "bulk path did not engage"
     if not am.equals(doc_small_oracle, doc_small_bulk):
         raise AssertionError("bulk/interpretive load parity failure")
 
-    t0 = time.perf_counter()
-    doc_full = am.load(full)
-    bulk_full_s = time.perf_counter() - t0
+    with _quiet_traceback_dumps():
+        t0 = time.perf_counter()
+        doc_full = am.load(full)
+        bulk_full_s = time.perf_counter() - t0
     assert len(doc_full["t"]) == full_vis
 
     ops = 2 * n_edits  # ins+set / del per edit, roughly
@@ -320,32 +364,34 @@ def run_interactive_text_config(n_edits=65536, n_keys=1000):
     keys = [f"A:{i}" for i in range(vis)]
     vals = ["x"] * vis
     eng_ts, ora_ts = [], []
-    for s in range(n_slices):
-        chunk = moves[s * per:(s + 1) * per if s < n_slices - 1
-                      else len(moves)]
-        t0 = time.perf_counter()
-        for kind, pos, ch in chunk:
-            if kind == "ins":
-                doc = am.change(doc, lambda d, pos=pos, ch=ch:
-                                d["t"].insert_at(pos, ch))
-            else:
-                doc = am.change(doc, lambda d, pos=pos:
-                                d["t"].delete_at(pos))
-        eng_ts.append((time.perf_counter() - t0) / len(chunk))
+    with _quiet_traceback_dumps():
+        for s in range(n_slices):
+            chunk = moves[s * per:(s + 1) * per if s < n_slices - 1
+                          else len(moves)]
+            t0 = time.perf_counter()
+            for kind, pos, ch in chunk:
+                if kind == "ins":
+                    doc = am.change(doc, lambda d, pos=pos, ch=ch:
+                                    d["t"].insert_at(pos, ch))
+                else:
+                    doc = am.change(doc, lambda d, pos=pos:
+                                    d["t"].delete_at(pos))
+            eng_ts.append((time.perf_counter() - t0) / len(chunk))
 
-        # flat-index frontend cost model, same trace slice (list insert +
-        # position dict rebuild + full snapshot tuple, per keystroke)
-        t0 = time.perf_counter()
-        for kind, pos, ch in chunk:
-            if kind == "ins":
-                keys.insert(pos, "k")
-                vals.insert(pos, ch)
-            else:
-                keys.pop(pos)
-                vals.pop(pos)
-            _pos = {k: i for i, k in enumerate(keys)}  # position map rebuild
-            _snapshot = tuple(vals)                    # snapshot rebuild
-        ora_ts.append((time.perf_counter() - t0) / len(chunk))
+            # flat-index frontend cost model, same trace slice (list
+            # insert + position dict rebuild + full snapshot tuple, per
+            # keystroke)
+            t0 = time.perf_counter()
+            for kind, pos, ch in chunk:
+                if kind == "ins":
+                    keys.insert(pos, "k")
+                    vals.insert(pos, ch)
+                else:
+                    keys.pop(pos)
+                    vals.pop(pos)
+                _pos = {k: i for i, k in enumerate(keys)}  # position map
+                _snapshot = tuple(vals)                    # snapshot
+            ora_ts.append((time.perf_counter() - t0) / len(chunk))
     assert len(doc["t"]) == n
     engine_s = statistics.median(eng_ts) * n_keys
     oracle_s = statistics.median(ora_ts) * n_keys
@@ -474,14 +520,19 @@ def run_fleet_config(n_docs=100_000, n_shards=8, n_rounds=6,
         return msgs
 
     def timed_round(svc, msgs):
-        """One coalesced round; returns (seconds, gc collections during)."""
-        gc0 = sum(s["collections"] for s in gc.get_stats())
-        t0 = time.perf_counter()
-        with svc.batch():
-            for did, cols in msgs:
-                svc.apply_columns(did, cols)
-        dt = time.perf_counter() - t0
-        gc1 = sum(s["collections"] for s in gc.get_stats())
+        """One coalesced round; returns (seconds, gc collections during).
+        The periodic faulthandler dumps are suspended for the round
+        (ADVICE.md low #3) — one firing mid-round on this small host is
+        indistinguishable from the GC/OS jitter the max-round cause
+        attribution exists to separate."""
+        with _quiet_traceback_dumps():
+            gc0 = sum(s["collections"] for s in gc.get_stats())
+            t0 = time.perf_counter()
+            with svc.batch():
+                for did, cols in msgs:
+                    svc.apply_columns(did, cols)
+            dt = time.perf_counter() - t0
+            gc1 = sum(s["collections"] for s in gc.get_stats())
         return dt, gc1 - gc0
 
     # Both fleets ALIVE for the whole measurement (the interleave needs
@@ -551,16 +602,18 @@ def run_fleet_config(n_docs=100_000, n_shards=8, n_rounds=6,
     # each a single full-buffer kernel pass.
     # (the fleet_hashes perfscope phase is attributed INSIDE the sharded
     # fan-out, so these timings land in the phase rollup automatically)
-    t0 = time.perf_counter()
-    h = svc.hashes()
-    fleet_hashes_first_s = time.perf_counter() - t0
+    with _quiet_traceback_dumps():
+        t0 = time.perf_counter()
+        h = svc.hashes()
+        fleet_hashes_first_s = time.perf_counter() - t0
     first_clean = svc.last_hashes_clean_shards
     first_dirty = svc.last_hashes_dirty_shards
     # Clean re-read (no deltas since): served from the per-shard hash
     # caches — the product claim is sub-second at 100K docs.
-    t0 = time.perf_counter()
-    h2 = svc.hashes()
-    fleet_hashes_s = time.perf_counter() - t0
+    with _quiet_traceback_dumps():
+        t0 = time.perf_counter()
+        h2 = svc.hashes()
+        fleet_hashes_s = time.perf_counter() - t0
     assert h == h2, "clean re-read disagreed with the reconciled read"
     clean_shards = svc.last_hashes_clean_shards
     dirty_shards = svc.last_hashes_dirty_shards
@@ -640,10 +693,11 @@ def _oracle_apply(doc_changes):
 
 
 def run_oracle(doc_changes, repeat=1):
-    t0 = time.perf_counter()
-    for _ in range(repeat):
-        _oracle_apply(doc_changes)
-    return (time.perf_counter() - t0) / repeat
+    with _quiet_traceback_dumps():
+        t0 = time.perf_counter()
+        for _ in range(repeat):
+            _oracle_apply(doc_changes)
+        return (time.perf_counter() - t0) / repeat
 
 
 def run_oracle_split(doc_changes):
@@ -651,11 +705,12 @@ def run_oracle_split(doc_changes):
     separately, so per-doc linearity can be checked without re-running
     anything. Returns (total_s, first_half_s, second_half_s, n_first)."""
     n_first = max(1, len(doc_changes) // 2)
-    t0 = time.perf_counter()
-    _oracle_apply(doc_changes[:n_first])
-    t1 = time.perf_counter()
-    _oracle_apply(doc_changes[n_first:])
-    t2 = time.perf_counter()
+    with _quiet_traceback_dumps():
+        t0 = time.perf_counter()
+        _oracle_apply(doc_changes[:n_first])
+        t1 = time.perf_counter()
+        _oracle_apply(doc_changes[n_first:])
+        t2 = time.perf_counter()
     return t2 - t0, t1 - t0, t2 - t1, n_first
 
 
@@ -1171,11 +1226,12 @@ def run_resident_rounds(doc_changes, n_rounds=12, fraction=0.2):
         np.asarray(rset.hashes())
         gc.collect()
         time.sleep(0.1)
-        t0 = time.perf_counter()
-        for f in wire_frames[warm_rounds:]:
-            rset.apply_round_frames([f])
-        np.asarray(rset.hashes())   # the slice's convergence read
-        eng_slices.append((time.perf_counter() - t0) / n_rounds)
+        with _quiet_traceback_dumps():
+            t0 = time.perf_counter()
+            for f in wire_frames[warm_rounds:]:
+                rset.apply_round_frames([f])
+            np.asarray(rset.hashes())   # the slice's convergence read
+            eng_slices.append((time.perf_counter() - t0) / n_rounds)
 
         # oracle documents brought up through the warm rounds untimed
         # (their deltas are causal dependencies of the timed ones)
@@ -1190,15 +1246,16 @@ def run_resident_rounds(doc_changes, n_rounds=12, fraction=0.2):
         json_rounds = _oracle_wire_rounds(rounds[warm_rounds:])
         gc.collect()
         time.sleep(0.1)
-        t0 = time.perf_counter()
-        for jdeltas in json_rounds:
-            for i in changed:
-                doc = oracle_docs[i]
-                chs = [Change.from_dict(d)
-                       for d in json.loads(jdeltas[doc_ids[i]])]
-                oracle_docs[i] = apply_changes_to_doc(
-                    doc, doc._doc.opset, chs, incremental=True)
-        ora_slices.append((time.perf_counter() - t0) / n_rounds)
+        with _quiet_traceback_dumps():
+            t0 = time.perf_counter()
+            for jdeltas in json_rounds:
+                for i in changed:
+                    doc = oracle_docs[i]
+                    chs = [Change.from_dict(d)
+                           for d in json.loads(jdeltas[doc_ids[i]])]
+                    oracle_docs[i] = apply_changes_to_doc(
+                        doc, doc._doc.opset, chs, incremental=True)
+            ora_slices.append((time.perf_counter() - t0) / n_rounds)
     engine_round = statistics.median(eng_slices)
     oracle_round = statistics.median(ora_slices)
 
@@ -1441,6 +1498,11 @@ def _final_record(results_by_cfg: dict, backend: str | None, attempts: list):
                 "batched_device_speedup": r["batched"]["device_speedup"],
                 "batched_docs": r["batched"]["docs"]}
                if "batched" in r else {}),
+            **({"lock_wait_total_s": r["lock_wait_total_s"]}
+               if "lock_wait_total_s" in r else {}),
+            **({"op_lag_p50_s": r["op_lag_p50_s"],
+                "op_lag_p99_s": r["op_lag_p99_s"]}
+               if "op_lag_p50_s" in r else {}),
             **({"fleet_load_ops_per_s": r["fleet_load_ops_per_s"],
                 "round_ops_per_s": r["round_ops_per_s"],
                 "round_cost_scaling": r[
@@ -1494,6 +1556,27 @@ def _final_record(results_by_cfg: dict, backend: str | None, attempts: list):
     return rec
 
 
+def _attach_contention_fields(r: dict) -> None:
+    """Per-config contention-plane headline numbers, lifted out of the
+    config's metrics snapshot into first-class record fields (they land
+    in bench_history.jsonl via perf/history._norm_configs): total lock
+    wait across every instrumented lock, and the sampled op-lag p50/p99
+    — convergence lag when a wire was involved, else the origin
+    admission->flushed latency (bench configs are single-process)."""
+    m = r.get("metrics") or {}
+    lock_keys = [k for k in m if k.startswith("sync_lock_wait_s{")
+                 and k.endswith("_sum")]
+    if lock_keys:
+        r["lock_wait_total_s"] = round(
+            sum(m[k] for k in lock_keys
+                if isinstance(m[k], (int, float))), 6)
+    stages = ((m.get("oplag") or {}).get("stages") or {})
+    best = stages.get("converge") or stages.get("origin_total")
+    if isinstance(best, dict) and "p50_s" in best:
+        r["op_lag_p50_s"] = best["p50_s"]
+        r["op_lag_p99_s"] = best["p99_s"]
+
+
 def _metrics_rollup(rec: dict) -> dict:
     """Aggregate the per-config observability snapshots into the handful of
     per-layer span totals the one-line record can afford (full per-config
@@ -1514,6 +1597,11 @@ def _metrics_rollup(rec: dict) -> dict:
             "rows_round_apply_count", "rows_hashes_s",
             "sync_round_flush_s", "sync_rounds_flushed",
             "sync_ops_ingested", "sync_hashes_s",
+            # the contention plane: labels collapse, so these are the
+            # all-lock wait/hold totals and the all-stage op-lag summary
+            "sync_lock_wait_s_sum", "sync_lock_hold_s_sum",
+            "sync_lock_contended_total", "sync_ops_sampled",
+            "sync_op_lag_s_sum", "sync_op_lag_s_count",
             "obs_watchdog_fired", "obs_budget_exceeded")
     return {k: (round(tot[k], 3) if isinstance(tot[k], float) else tot[k])
             for k in keys if k in tot}
@@ -1607,9 +1695,7 @@ def worker_main(args):
     # shows which call sat inside the C layer when the parent's budget
     # killed this worker (the r5 TPU attempt died with no evidence of
     # WHERE config 2 wedged — never again).
-    import faulthandler
-    faulthandler.dump_traceback_later(180, repeat=True, exit=False,
-                                      file=sys.stderr)
+    _arm_traceback_dumps()
     import jax
     if args.force_cpu:
         # The axon TPU plugin overrides the JAX_PLATFORMS env var in this
@@ -1657,6 +1743,7 @@ def worker_main(args):
             _flightrec.reset()
             r = _run_config_budgeted(cfg, args.docs, cfg_budget)
             r["metrics"] = _metrics.snapshot()
+            _attach_contention_fields(r)
             if zombie_cfg is not None:
                 r["metrics_tainted_by"] = zombie_cfg
             r["backend"] = backend

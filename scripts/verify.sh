@@ -9,10 +9,13 @@
 #          blocking call under a lock — fails the build regardless of
 #          what else passes.
 # Stage 2: perf report (INFORMATIONAL): the bench-history trajectory the
-#          regression gate reads. Never fails verify — a CPU-only image or
-#          a missing/empty history must not block the build
-#          (TUNNEL_DIAGNOSIS.md: TPU absence is an environment fact, not a
-#          code defect). Run `make perfcheck` for the enforcing gate.
+#          regression gate reads, plus the contention & convergence-lag
+#          section (per-lock wait/hold, sampled op-lag stages — the
+#          baseline ROADMAP #1's ingestion refactor lands against). Never
+#          fails verify — a CPU-only image or a missing/empty history must
+#          not block the build (TUNNEL_DIAGNOSIS.md: TPU absence is an
+#          environment fact, not a code defect). Run `make perfcheck` for
+#          the enforcing gate.
 # Stage 3: the tier-1 pytest line EXACTLY as ROADMAP.md specifies it,
 #          including the DOTS_PASSED count the driver compares against the
 #          seed. Keep this in sync with ROADMAP.md "Tier-1 verify".
@@ -24,9 +27,11 @@ cd "$(dirname "$0")/.."
 echo "== stage 1/3: static analysis (graftlint) =="
 JAX_PLATFORMS=cpu python -m automerge_tpu.analysis || exit $?
 
-echo "== stage 2/3: perf report (informational) =="
+echo "== stage 2/3: perf report + contention (informational) =="
 JAX_PLATFORMS=cpu python -m automerge_tpu.perf report \
     || echo "perf report unavailable (informational stage — not a failure)"
+JAX_PLATFORMS=cpu python -m automerge_tpu.perf contention \
+    || echo "contention report unavailable (informational — not a failure)"
 
 echo "== stage 3/3: tier-1 suite (ROADMAP.md) =="
 set -o pipefail

@@ -1,0 +1,208 @@
+"""Lock-contention profiler (utils/lockprof.py): wait/hold histograms
+under forced contention, holder attribution in post-mortems, reentrancy
+accounting, and registry conformance of the new names."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from automerge_tpu.utils import flightrec, lockprof, metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_metrics():
+    metrics.reset()
+    flightrec.reset()
+    yield
+    metrics.reset()
+    flightrec.reset()
+
+
+def test_two_thread_contention_records_wait_hold_contended():
+    lk = lockprof.InstrumentedLock("t_contend")
+    entered = threading.Event()
+
+    def holder():
+        with lk:
+            entered.set()
+            time.sleep(0.25)
+
+    t = threading.Thread(target=holder, name="t-holder", daemon=True)
+    t.start()
+    assert entered.wait(2.0)
+    t0 = time.perf_counter()
+    with lk:
+        waited = time.perf_counter() - t0
+    t.join()
+    assert waited >= 0.1   # genuinely queued behind the holder
+
+    snap = metrics.snapshot()
+    # both acquisitions recorded a wait observation; only the second
+    # found the lock held
+    assert snap["sync_lock_wait_s{lock=t_contend}_count"] == 2
+    assert snap["sync_lock_contended_total{lock=t_contend}"] == 1
+    # the contended acquisition's wait dominates the sum
+    assert snap["sync_lock_wait_s{lock=t_contend}_sum"] >= 0.1
+    # two outermost holds; the holder's 0.25s sleep dominates
+    assert snap["sync_lock_hold_s{lock=t_contend}_count"] == 2
+    assert snap["sync_lock_hold_s{lock=t_contend}_max"] >= 0.2
+
+
+def test_uncontended_fast_path_records_zero_wait():
+    lk = lockprof.InstrumentedLock("t_fast")
+    with lk:
+        pass
+    snap = metrics.snapshot()
+    assert snap["sync_lock_wait_s{lock=t_fast}_count"] == 1
+    assert snap["sync_lock_wait_s{lock=t_fast}_max"] == 0.0
+    assert "sync_lock_contended_total{lock=t_fast}" not in snap
+
+
+def test_reentrant_holds_count_once():
+    lk = lockprof.InstrumentedRLock("t_reent")
+    with lk:
+        with lk:            # owner re-acquire: no new hold, no wait
+            with lk:
+                pass
+    snap = metrics.snapshot()
+    assert snap["sync_lock_hold_s{lock=t_reent}_count"] == 1
+    assert snap["sync_lock_wait_s{lock=t_reent}_count"] == 1
+
+
+def test_holder_table_names_thread_and_site():
+    lk = lockprof.InstrumentedRLock("t_holdertab")
+    held = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lk:
+            held.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=holder, name="t-owner", daemon=True)
+    t.start()
+    assert held.wait(2.0)
+    try:
+        holders = lockprof.holders_snapshot()
+        assert "t_holdertab" in holders
+        h = holders["t_holdertab"]
+        assert h["thread"] == "t-owner"
+        assert "test_lockprof.py" in h["site"]
+        assert h["held_s"] >= 0.0
+    finally:
+        release.set()
+        t.join()
+    # released: gone from the table
+    assert "t_holdertab" not in lockprof.holders_snapshot()
+
+
+def test_flightrec_dump_embeds_holder_table(tmp_path):
+    lk = lockprof.InstrumentedLock("t_dump")
+    held = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lk:
+            held.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=holder, name="t-dumper", daemon=True)
+    t.start()
+    assert held.wait(2.0)
+    try:
+        path = flightrec.dump("unit-lockprof",
+                              path=str(tmp_path / "dump.json"))
+        assert path is not None
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["lock_holders"]["t_dump"]["thread"] == "t-dumper"
+        assert "test_lockprof.py" in doc["lock_holders"]["t_dump"]["site"]
+    finally:
+        release.set()
+        t.join()
+
+
+def test_watchdog_fire_names_lock_holders():
+    lk = lockprof.InstrumentedLock("t_wdog")
+    held = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lk:
+            held.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=holder, name="t-wdog-owner", daemon=True)
+    t.start()
+    assert held.wait(2.0)
+    try:
+        with metrics.watchdog("sync_hashes_fanout", budget_s=0.05):
+            deadline = time.time() + 5.0
+            while time.time() < deadline and not metrics.watchdog_events():
+                time.sleep(0.02)
+        events = metrics.watchdog_events()
+        assert events, "watchdog never fired"
+        assert events[0]["lock_holders"]["t_wdog"]["thread"] \
+            == "t-wdog-owner"
+    finally:
+        release.set()
+        t.join()
+
+
+def test_service_lock_is_instrumented_and_shard_labeled():
+    from automerge_tpu.sync.sharded_service import ShardedEngineDocSet
+    svc = ShardedEngineDocSet(n_shards=2)
+    assert isinstance(svc.shards[0]._lock, lockprof.InstrumentedRLock)
+    assert svc.shards[0]._lock.name == "service_shard0"
+    assert svc.shards[1]._lock.name == "service_shard1"
+
+
+def test_condition_wait_records_under_lock_name():
+    cv = lockprof.InstrumentedCondition("t_cv")
+
+    def waker():
+        time.sleep(0.15)
+        cv.notify_all()
+
+    t = threading.Thread(target=waker, name="t-waker", daemon=True)
+    with cv:
+        t.start()
+        cv.wait(timeout=2.0)
+    t.join()
+    snap = metrics.snapshot()
+    assert snap["sync_lock_wait_s{lock=t_cv}_max"] >= 0.1
+
+
+def test_condition_wait_from_reentrant_hold_does_not_deadlock():
+    """threading.Condition releases ALL recursion levels before parking
+    (_release_save); the instrumented wrapper must too, or a notifier
+    blocks forever against a parked waiter still owning the lock."""
+    cv = lockprof.InstrumentedCondition("t_cv_reent")
+
+    def waker():
+        time.sleep(0.1)
+        cv.notify_all()
+
+    t = threading.Thread(target=waker, name="t-reent-waker", daemon=True)
+    with cv:
+        with cv:                     # reentrant hold, then wait
+            t.start()
+            assert cv.wait(timeout=5.0)
+            # depth restored: the inner release below must not underflow
+    t.join()
+    snap = metrics.snapshot()
+    assert snap["sync_lock_hold_s{lock=t_cv_reent}_count"] >= 1
+
+
+def test_new_metric_names_registered_with_right_kinds():
+    assert "sync_lock_wait_s" in metrics.HISTOGRAMS
+    assert "sync_lock_hold_s" in metrics.HISTOGRAMS
+    assert "sync_lock_contended_total" in metrics.COUNTERS
+    assert "sync_op_lag_s" in metrics.HISTOGRAMS
+    assert "sync_op_lag_p50_s" in metrics.GAUGES
+    assert "sync_op_lag_p99_s" in metrics.GAUGES
+    assert "sync_ops_sampled" in metrics.COUNTERS
+    assert "oplag_admit" in flightrec.EVENT_KINDS
+    assert "oplag_stage" in flightrec.EVENT_KINDS
