@@ -17,10 +17,12 @@ by default the per-config snapshots a full bench run leaves in
   the in-flush engine sub-spans (`rows_round_apply_s` /
   `engine_resident_apply_s`) vs the service-host remainder
   (coalescing, logs, floors), with the config-wide pack/dispatch/
-  device_wait phase totals as the engine-side split. This is the
-  quantified baseline ROADMAP #1's lock-free ingestion refactor must
-  beat: after the refactor, service-host time and
-  `sync_lock_wait_s{lock=service*}` must shrink while throughput holds.
+  device_wait phase totals as the engine-side split, plus the
+  epoch-ingestion decomposition (r7): `commit_wait_s` (writer park
+  from buffer append to group-commit resolution — NOT lock wait; the
+  `buffer_wait` oplag stage is its sampled in-buffer slice) next to
+  the residual `service*` lock wait, so the before/after of the
+  lock-free admission refactor reads off one line.
 
 Pure stdlib (like perf/history.py): loadable without initializing jax.
 """
@@ -41,8 +43,8 @@ _STAGE_RE = re.compile(
 
 #: oplag stage display order (matches the lifecycle; unknown stages sort
 #: after, alphabetically)
-_STAGE_ORDER = ("causal_queue", "queue_wait", "pack", "dispatch",
-                "device_wait", "flush", "origin_total", "wire",
+_STAGE_ORDER = ("causal_queue", "buffer_wait", "queue_wait", "pack",
+                "dispatch", "device_wait", "flush", "origin_total", "wire",
                 "peer_apply", "converge")
 
 
@@ -139,6 +141,13 @@ def flush_attribution(snapshot: dict) -> dict | None:
         "device_wait_s": round(ph("device_wait"), 4),
         "lock_wait_s": round(sum(
             r["wait_s"] for r in lock_table(snapshot).values()), 4),
+        # epoch-ingestion split: writer group-commit park (not a lock)
+        # vs the residual wait on the service* locks themselves
+        "commit_wait_s": round(
+            _collapse(snapshot, "sync_commit_wait_s_sum"), 4),
+        "service_lock_wait_s": round(sum(
+            r["wait_s"] for name, r in lock_table(snapshot).items()
+            if name.startswith("service")), 4),
     }
     named = min(engine_s + ph("pack") + ph("dispatch") + ph("device_wait"),
                 flush_s)
@@ -188,7 +197,9 @@ def lines_for_snapshot(snapshot: dict, label: str) -> list[str]:
             f"engine-side phases (config-wide): pack {att['pack_s']}s, "
             f"dispatch {att['dispatch_s']}s, device_wait "
             f"{att['device_wait_s']}s; lock wait total "
-            f"{att['lock_wait_s']}s; directly measured "
+            f"{att['lock_wait_s']}s (service* {att['service_lock_wait_s']}s)"
+            f"; group-commit park {att['commit_wait_s']}s; "
+            f"directly measured "
             f"{att['measured_pct']}% of flush wall time")
     return lines
 

@@ -157,7 +157,16 @@ def _norm_configs(raw) -> dict:
                                        # op-lag percentiles, the baseline
                                        # ROADMAP #1's refactor must beat
                                        "lock_wait_total_s",
-                                       "op_lag_p50_s", "op_lag_p99_s")
+                                       "op_lag_p50_s", "op_lag_p99_s",
+                                       # multi-writer admission (r8,
+                                       # config 9): the epoch-ingestion
+                                       # headline + its A/B evidence
+                                       "admission_ops_per_s",
+                                       "admission_scaling_4x",
+                                       "admission_vs_r6_single_writer_x",
+                                       "service_lock_wait_reduction_x",
+                                       "service_lock_wait_locked_s",
+                                       "service_lock_wait_epoch_s")
                      if isinstance(v.get(k), (int, float, str))}
         elif isinstance(v, (int, float)):
             entry = {"speedup": v}
@@ -445,4 +454,46 @@ def check(path: str | None = None, record: dict | None = None,
         lines.append(f"  fleet_hashes_s: {cur_h:.4f} (no prior "
                      "convergence-read telemetry — comparison starts "
                      "next run)")
+
+    # multi-writer admission gate (r8): config 9's N=4 epoch-mode
+    # admission throughput must hold against the same-backend same-host
+    # rolling median (raw ops/sec — host-class scoping applies exactly
+    # as for the headline gate), with the scaling ratio reported
+    # alongside. Skip-clean: runs without config 9, or with no
+    # comparable history, never fail.
+    def _mw(r: dict):
+        return ((r.get("configs") or {}).get("9") or {})
+
+    cur_mw = _mw(current).get("admission_ops_per_s")
+    prior_mw = [_mw(r).get("admission_ops_per_s")
+                for r in prior_pool
+                if (r.get("backend") or "none") == backend
+                and _host_ok(r)]
+    prior_mw = [x for x in prior_mw
+                if isinstance(x, (int, float)) and x > 0][-window:]
+    if isinstance(cur_mw, (int, float)) and cur_mw > 0 and prior_mw:
+        med_mw = statistics.median(prior_mw)
+        floor = 1.0 - threshold_pct / 100.0
+        ratio = cur_mw / med_mw
+        verdict = "OK" if ratio >= floor else "ADMISSION REGRESSION"
+        lines.append(
+            f"  multiwriter admission (config 9, N=4): {cur_mw:.0f} "
+            f"ops/s vs rolling median {med_mw:.0f} (x{ratio:.2f}, "
+            f"floor x{floor:.2f}) -> {verdict}")
+        if ratio < floor:
+            rc = 1
+    elif isinstance(cur_mw, (int, float)) and cur_mw > 0:
+        lines.append(f"  multiwriter admission (config 9, N=4): "
+                     f"{cur_mw:.0f} ops/s (no prior multi-writer "
+                     "telemetry — comparison starts next run)")
+    scal = _mw(current).get("admission_scaling_4x")
+    if isinstance(scal, (int, float)):
+        def _x(key):
+            v = _mw(current).get(key)
+            return f"x{v}" if isinstance(v, (int, float)) else "n/a"
+        lines.append(f"  multiwriter scaling (N=4 vs N=1): x{scal:.2f} "
+                     "(vs r6 single-writer baseline: "
+                     f"{_x('admission_vs_r6_single_writer_x')}"
+                     "); service-lock wait locked/epoch: "
+                     f"{_x('service_lock_wait_reduction_x')}")
     return rc, lines

@@ -23,6 +23,7 @@ element-wise max all-reduce over int32 clock matrices
 
 from __future__ import annotations
 
+import contextlib
 from typing import Callable
 
 from ..core import clock as C
@@ -62,6 +63,17 @@ class Connection:
         # registry key, released again in close()
         self._floor_sink = (doc_set
                             if hasattr(doc_set, "note_peer_clock") else None)
+        # Concurrency seams (no-ops here; LockedConnection installs real
+        # locks): _state_lock guards this connection's clock maps and
+        # send decisions; _apply_lock guards the doc_set apply for
+        # doc_sets that are NOT safe for concurrent ingestion. Keeping
+        # them separate lets a transport serialize Connection state in
+        # tiny sections while N peers' applies run concurrently into an
+        # epoch-buffered service (sync/service.py) — the receive chain
+        # no longer parks every peer behind one node-wide lock for the
+        # whole receive->apply->gossip span.
+        self._state_lock = contextlib.nullcontext()
+        self._apply_lock = contextlib.nullcontext()
 
     # -- lifecycle (connection.js:49-56) ------------------------------------
 
@@ -153,10 +165,19 @@ class Connection:
         if doc_state is None:
             raise TypeError("This object cannot be used for network sync. "
                             "Are you trying to sync a snapshot from the history?")
-        clock = doc_state.opset.clock
-        if not C.less_or_equal(self._our_clock.get(doc_id, {}), clock):
-            raise ValueError("Cannot pass an old state object to a connection")
-        self.maybe_send_changes(doc_id)
+        with self._state_lock:
+            # the clock read must happen UNDER the state lock: every
+            # entry into _our_clock is unioned from a clock read under
+            # this lock, so reading here keeps the monotonicity check
+            # sound — a pre-lock read could be overtaken by a concurrent
+            # peer's gossip and trip the old-state guard spuriously.
+            # (On an epoch-buffered service the read is a snapshot-cache
+            # hit in the steady state: no service lock.)
+            clock = doc_state.opset.clock
+            if not C.less_or_equal(self._our_clock.get(doc_id, {}), clock):
+                raise ValueError(
+                    "Cannot pass an old state object to a connection")
+            self.maybe_send_changes(doc_id)
 
     # -- metrics pull (METRICS message type; no reference counterpart) ------
 
@@ -220,6 +241,11 @@ class Connection:
             return self._receive_msg(msg)
 
     def _receive_msg(self, msg: dict):
+        # metrics / audit serving touches only thread-safe surfaces (the
+        # metrics registry; the engine's audit/hash caches) — served
+        # outside the transport state lock, so one peer's audit pull no
+        # longer queues every other peer's receive chain behind an
+        # engine read (the r6-baselined tcp.py lock hold, now retired)
         if self._handle_metrics_msg(msg):
             return None
         if self._handle_audit_msg(msg):
@@ -229,8 +255,9 @@ class Connection:
         lag = oplag.wire_receive(msg.pop(OPLAG_KEY, None))
         doc_id = msg["docId"]
         if msg.get("clock") is not None:
-            self._their_clock = self._clock_union(self._their_clock, doc_id,
-                                                  msg["clock"])
+            with self._state_lock:
+                self._their_clock = self._clock_union(
+                    self._their_clock, doc_id, msg["clock"])
             if self._floor_sink is not None:
                 self._floor_sink.note_peer_clock(self, doc_id, msg["clock"])
         if msg.get("frame") is not None:
@@ -240,23 +267,30 @@ class Connection:
             cols = decode_frame(msg["frame"])
             # DocSets exposing a column ingress get the decoded columns
             # as-is (the engine service's native-encoder seam); plain
-            # DocSets materialize changes from them.
-            if hasattr(self._doc_set, "apply_columns"):
-                out = self._doc_set.apply_columns(doc_id, cols)
-            else:
-                out = self._doc_set.apply_changes(doc_id, cols.to_changes())
+            # DocSets materialize changes from them. The apply runs
+            # under _apply_lock — a no-op for doc_sets declaring
+            # concurrent_ingest, so N peer reader threads ride ONE
+            # group-commit flush instead of serializing node-wide.
+            with self._apply_lock:
+                if hasattr(self._doc_set, "apply_columns"):
+                    out = self._doc_set.apply_columns(doc_id, cols)
+                else:
+                    out = self._doc_set.apply_changes(doc_id,
+                                                      cols.to_changes())
             oplag.peer_applied(lag)
             return out
         if msg.get("changes") is not None:
-            out = self._doc_set.apply_changes(
-                doc_id, [coerce_change(c) for c in msg["changes"]])
+            with self._apply_lock:
+                out = self._doc_set.apply_changes(
+                    doc_id, [coerce_change(c) for c in msg["changes"]])
             oplag.peer_applied(lag)
             return out
 
-        if self._doc_set.get_doc(doc_id) is not None:
-            self.maybe_send_changes(doc_id)
-        elif doc_id not in self._our_clock:
-            # The peer has a doc we don't know: request it.
-            self.send_msg(doc_id, {})
+        with self._state_lock:
+            if self._doc_set.get_doc(doc_id) is not None:
+                self.maybe_send_changes(doc_id)
+            elif doc_id not in self._our_clock:
+                # The peer has a doc we don't know: request it.
+                self.send_msg(doc_id, {})
 
-        return self._doc_set.get_doc(doc_id)
+            return self._doc_set.get_doc(doc_id)

@@ -27,9 +27,15 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+from collections import OrderedDict
 
 from ..core.change import Change, coerce_change
 from ..utils import lockprof, metrics
+
+#: parsed-prefix read cache entries kept per archive (LRU by doc) —
+#: bounded so cached cold reads cannot re-grow the RAM the log-horizon
+#: layer exists to reclaim
+CACHE_DOCS = int(os.environ.get("AMTPU_ARCHIVE_CACHE_DOCS", "8"))
 
 
 class LogArchive:
@@ -38,11 +44,16 @@ class LogArchive:
     def __init__(self, root: str):
         self.root = root
         os.makedirs(root, exist_ok=True)
-        # instrumented (utils/lockprof.py): a lagging peer's O(history)
-        # cold read holds this across a full file parse (ADVICE.md low
-        # #2) — the wait histogram is how that cost stays visible until
-        # the storage-tier rework streams reads outside the lock
+        # The lock guards appends (tail repair + write + fsync must not
+        # interleave) and the read-cache table. Reads only SNAPSHOT the
+        # file identity under it; the O(history) parse itself runs
+        # OUTSIDE the lock (ADVICE.md low #2 — one lagging peer's cold
+        # read must not stall concurrent appends), and the parsed prefix
+        # is cached keyed by (size, mtime_ns) so a peer catching up over
+        # several rounds pays the parse once.
         self._lock = lockprof.InstrumentedLock("archive")
+        # doc_id -> ((size, mtime_ns), parsed change list)
+        self._read_cache: "OrderedDict[str, tuple]" = OrderedDict()
 
     def _path(self, doc_id: str) -> str:
         h = hashlib.sha1(doc_id.encode()).hexdigest()[:20]
@@ -84,7 +95,13 @@ class LogArchive:
         The whole batch goes down as ONE buffered write + fsync after a
         torn-tail repair check: a crash mid-append can tear at most the
         final line, and the next append truncates the fragment before
-        writing, so records never interleave or glue."""
+        writing, so records never interleave or glue.
+
+        On the FIRST creation of a doc's archive file the containing
+        directory is fsynced too, before this returns (ADVICE low #1):
+        the caller truncates the RAM log right after, and a crash that
+        loses the brand-new DIRECTORY ENTRY (file data was fsynced, its
+        name was not) would lose the only copy of the archived prefix."""
         if not changes:
             return 0
         path = self._path(doc_id)
@@ -94,47 +111,90 @@ class LogArchive:
             rec["_doc"] = doc_id
             lines.append(json.dumps(rec, separators=(",", ":")))
         with self._lock:
+            created = not os.path.exists(path)
             self._repair_tail(path)     # no-op on a missing or clean file
             with open(path, "a") as f:
                 f.write("\n".join(lines) + "\n")
                 f.flush()
                 os.fsync(f.fileno())
+            if created:
+                self._fsync_dir()
         metrics.bump("sync_changes_archived", len(changes))
         return len(changes)
+
+    def _fsync_dir(self) -> None:
+        """Make a new file's directory entry durable (os.fsync on the
+        file alone does not cover its name on most filesystems)."""
+        try:
+            fd = os.open(self.root, os.O_RDONLY)
+        except OSError:
+            return   # platform without directory fds: best effort
+        try:
+            os.fsync(fd)
+        except OSError:
+            pass
+        finally:
+            os.close(fd)
 
     def read(self, doc_id: str) -> list[Change]:
         """All archived changes for a doc, deduplicated by (actor, seq).
 
-        A torn FINAL line (crash or full disk mid-append) is tolerated and
-        skipped — the failed append()'s caller never truncated the RAM log
-        for it, so nothing is lost; corruption anywhere BEFORE the final
-        line still raises (the archive is the only copy of the truncated
-        prefix, and silently dropping records would be divergence).
+        A torn FINAL line (crash or full disk mid-append, or a snapshot
+        racing a concurrent append) is tolerated and skipped — the
+        failed append()'s caller never truncated the RAM log for it (and
+        a racing append re-serves on the next read), so nothing is lost;
+        corruption anywhere BEFORE the final line still raises (the
+        archive is the only copy of the truncated prefix, and silently
+        dropping records would be divergence).
+
+        Concurrency/cost: the lock is held only to snapshot the file
+        identity (size + mtime) and consult the parse cache; the actual
+        O(history) read + parse runs OUTSIDE it against the snapshotted
+        byte prefix (the file is append-only between tail repairs, and a
+        repair changes the identity), so a lagging peer's cold read no
+        longer serializes against appends — and repeated cold reads of
+        the same prefix are one parse (LRU of CACHE_DOCS docs).
 
         The ``sync_archive_cold_reads`` metric (operator signal: peers
         falling behind the horizon) is bumped by the missing_changes call
         site, not here — internal replays (rebuild-from-log, materialize)
         also read and must not pollute it."""
         path = self._path(doc_id)
-        if not os.path.exists(path):
-            return []
-        out: dict[tuple, Change] = {}
         with self._lock:
-            with open(path) as f:
-                for line in f:         # streamed: the archive grows forever
-                    if not line.strip():
-                        continue
-                    try:
-                        rec = json.loads(line)
-                    except json.JSONDecodeError:
-                        # torn only if nothing non-empty follows (a
-                        # complete append always ends with a newline)
-                        if any(l.strip() for l in f):
-                            raise
-                        metrics.bump("sync_archive_tail_skipped")
-                        break
-                    if rec.pop("_doc", doc_id) != doc_id:
-                        continue  # sha1-prefix collision guard
-                    c = coerce_change(rec)
-                    out[(c.actor, c.seq)] = c
-        return list(out.values())
+            try:
+                st = os.stat(path)
+            except OSError:
+                return []
+            ident = (st.st_size, st.st_mtime_ns)
+            hit = self._read_cache.get(doc_id)
+            if hit is not None and hit[0] == ident:
+                self._read_cache.move_to_end(doc_id)
+                metrics.bump("sync_archive_reads_cached")
+                return list(hit[1])
+        with open(path, "rb") as f:
+            data = f.read(ident[0])      # exactly the snapshotted prefix
+        out: dict[tuple, Change] = {}
+        lines = data.split(b"\n")
+        for j, raw in enumerate(lines):
+            if not raw.strip():
+                continue
+            try:
+                rec = json.loads(raw.decode("utf-8"))
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                # torn only if nothing non-empty follows in the window
+                # (a complete append always ends with a newline)
+                if any(l.strip() for l in lines[j + 1:]):
+                    raise
+                metrics.bump("sync_archive_tail_skipped")
+                break
+            if rec.pop("_doc", doc_id) != doc_id:
+                continue  # sha1-prefix collision guard
+            c = coerce_change(rec)
+            out[(c.actor, c.seq)] = c
+        changes = list(out.values())
+        with self._lock:
+            self._read_cache[doc_id] = (ident, changes)
+            self._read_cache.move_to_end(doc_id)
+            while len(self._read_cache) > max(0, CACHE_DOCS):
+                self._read_cache.popitem(last=False)
+        return list(changes)

@@ -27,10 +27,13 @@ import threading
 import time as _time
 from typing import Callable
 
+import os
+
 from ..core.change import Change
 from ..engine.resident import ResidentDocSet
 from ..engine.resident_rows import CompactionAnchorError, DeviceDispatchError
 from ..utils import flightrec, lockprof, metrics, oplag, perfscope
+from . import epochs
 
 
 class _HandleOpSet:
@@ -65,11 +68,51 @@ class DocHandle:
         return self._service.materialize(self.doc_id)
 
 
+class PendingIngress:
+    """Wait handle for a pipelined (async) epoch-mode ingress: .wait()
+    blocks until the flush that carried the ingress and re-raises its
+    error. Appends from one thread flush in admission order, so waiting
+    on ingress k implies every earlier ingress of the same thread is
+    durable too — a sender streaming with bounded in-flight depth keeps
+    the durability contract while rounds flush back-to-back."""
+
+    __slots__ = ("_svc", "_ticket")
+
+    def __init__(self, svc: "EngineDocSet", ticket):
+        self._svc = svc
+        self._ticket = ticket
+
+    @property
+    def done(self) -> bool:
+        return self._ticket is None or self._ticket.done
+
+    def wait(self) -> None:
+        if self._ticket is None:
+            return            # synchronous fallback path: already flushed
+        # this thread now owns the post-flush gossip for its ingress —
+        # a concurrently-deciding backstop may still double-drain (the
+        # per-doc queue pops are atomic, so that's just shared work)
+        self._ticket.claimed = True
+        try:
+            self._ticket.wait(alive_fn=self._svc._kick_or_flush)
+        except BaseException:
+            self._svc._drain_admitted_shielded()
+            raise
+        self._svc._drain_admitted()
+
+
 class EngineDocSet:
+    #: Connection/transport marker: apply_changes/apply_columns and the
+    #: protocol reads are safe for concurrent entry from many threads
+    #: (epoch-buffered or lock-serialized), so transports need not hold
+    #: their doc_set-wide lock across the apply (sync/tcp.py).
+    concurrent_ingest = True
+
     def __init__(self, doc_ids: list[str] | None = None,
                  live_views: bool = False, backend: str = "resident",
                  device=None, log_archive_dir: str | None = None,
-                 log_horizon_changes: int | None = None):
+                 log_horizon_changes: int | None = None,
+                 ingest_mode: str | None = None):
         """live_views=True turns the node into a view server: every ingress
         runs the fused apply+reconcile with device-side diff emission
         (engine/diffs.py), per-doc MirrorDoc views are maintained
@@ -86,6 +129,24 @@ class EngineDocSet:
         coalesces many ingresses into ONE device dispatch — the steady
         state of a streaming sync service. live_views requires the
         docs-major backend (device-side diff emission lives there).
+
+        ingest_mode (rows backend only) selects the admission path:
+        "epoch" (default; env AMTPU_INGEST_MODE) buffers each ingress
+        into striped epoch-stamped buffers (sync/epochs.py) with NO
+        service lock on the admission path — a single flusher thread
+        seals epochs and drains them into the engine as coalesced
+        rounds, and concurrent writers group-commit (N writers ride one
+        flush). "locked" is the pre-epoch inline path (each ingress
+        flushes under the service lock) — kept for A/B measurement
+        (bench config 9) and as a fallback. Both modes keep the same
+        synchronous contract: when apply_* returns normally, the change
+        is flushed. A raised flush error keeps locked mode's restore-
+        for-retry semantics — the round's un-admitted columns stay in
+        _pending and a LATER flush may still admit them (at-least-once;
+        the engine's (actor, seq) dedup makes a re-submission of the
+        same change idempotent). In epoch mode that error reaches every
+        writer riding the failed round, not only the one whose ingress
+        caused it.
 
         log_archive_dir (rows backend only) attaches a log-horizon archive
         (sync/logarchive.py): the causally-stable log prefix — below the
@@ -131,6 +192,16 @@ class EngineDocSet:
                 "log_horizon_changes requires backend='rows' AND "
                 "log_archive_dir (the truncated prefix must go somewhere)")
         self.log_horizon_changes = log_horizon_changes
+        if ingest_mode is None:
+            ingest_mode = os.environ.get("AMTPU_INGEST_MODE", "epoch")
+        if ingest_mode not in ("epoch", "locked"):
+            raise ValueError(f"unknown ingest_mode {ingest_mode!r}")
+        if backend != "rows":
+            # docs-major ingress applies inline (live-view diff emission
+            # is tied to the apply); the epoch buffers target the
+            # streaming rows posture
+            ingest_mode = "locked"
+        self.ingest_mode = ingest_mode
         self._pending: dict[str, list] = {}   # rows backend: coalesced round
         # metrics label for this node's spans/counters; ShardedEngineDocSet
         # sets it to the shard index so per-shard series stay separable
@@ -163,6 +234,56 @@ class EngineDocSet:
         # (utils/oplag.py; both mutated under self._lock)
         self._lag_pending: list = []
         self._lag_flushed: list = []
+        # early-resolved tickets' park durations awaiting the post-lock
+        # drain (sync_commit_wait_s observes deferred out of the
+        # service-lock hold window; mutated under self._lock)
+        self._commit_waits: list = []
+        # Epoch-batched ingestion (sync/epochs.py, ingest_mode="epoch"):
+        # writers append into the striped buffer WITHOUT self._lock and
+        # park on a ticket; the flusher (one lazy thread per service /
+        # shard, amtpu-flusher-<k>) seals epochs under self._lock and
+        # drains them through _flush_locked as coalesced rounds. The
+        # service lock's remaining ingestion duty is the seal itself.
+        self._epoch = (epochs.EpochIngestBuffer()
+                       if ingest_mode == "epoch" else None)
+        self._flusher = (epochs.Flusher(
+            self._flush_epochs,
+            lambda: "amtpu-flusher-" + (self._shard if self._shard
+                                        is not None else "0"))
+            if ingest_mode == "epoch" else None)
+        # Epoch drains need no lock of their own: every seal + flush
+        # runs entirely under self._lock (the only out-of-lock step,
+        # resolving a drain-local ticket list, is safe to interleave),
+        # so concurrent drainers — flusher respawns, inline readers
+        # (_maybe_flush_locked), explicit flush() — already serialize
+        # there. (A writer-as-leader variant was measured and rejected:
+        # inline leadership seals too eagerly — 2.3-op rounds vs the
+        # flusher's 3.7 at 4 writers — and its GIL footprint stretched
+        # every co-running flush ~1.8x on a 2-core host.)
+        #
+        # Per-thread drain state: set while THIS thread runs the
+        # post-drain gossip backstop, so a handler callback re-entering
+        # apply takes the inline locked path instead of parking on a
+        # ticket only its own drain pass could resolve.
+        self._drain_local = threading.local()
+        # thread ident owning an open batch(): its own ingresses keep the
+        # coalesce-under-held-lock fast path (one dispatch per batch)
+        self._batch_owner: int | None = None
+        # epoch tickets riding the current _flush_locked (mutated under
+        # self._lock): consumed by _early_resolve_locked once admission
+        # is durable, so the flush tail overlaps the writers' wakeups
+        self._inflight_tickets: list = []
+        # Snapshot read plane (the PR 5 hash-epoch substrate extended to
+        # the whole read surface): per-doc admission versions, bumped
+        # under self._lock whenever a doc's clock/log moves (flush,
+        # archival) — _read_gen bumps for whole-engine swaps (rebuild).
+        # clock_of/missing_changes serve lock-free from these caches
+        # while the key matches and nothing is buffered or pending, so
+        # steady-state gossip reads never block admission or flush.
+        self._doc_ver: dict[str, int] = {}
+        self._read_gen = 0
+        self._clock_cache: dict[str, tuple] = {}
+        self._log_cache: dict[str, tuple] = {}
         # Diff records are index-based patches, so subscribers must see a
         # doc's batches in ingress order — but running callbacks under
         # self._lock would let a subscriber that grabs its own lock deadlock
@@ -275,6 +396,9 @@ class EngineDocSet:
                 floor = self._compaction_floor_locked(d)
                 out[d] = (rset.archive_log_prefix(d, floor)
                           if floor else 0)
+                if out[d]:
+                    # the RAM log was truncated: log snapshots re-key
+                    self._bump_read_vers_locked((d,))
             return out
 
     # -- registry surface (doc_set.js:5-38) ---------------------------------
@@ -326,6 +450,8 @@ class EngineDocSet:
             log = self._log[doc_id]
             for c in admitted:
                 log.setdefault(c.actor, []).append(c)
+            if admitted:
+                self._bump_read_vers_locked((doc_id,))
             records = (diffs or {}).get(doc_id, [])
             if records:
                 from ..engine.diffs import MirrorDoc
@@ -385,7 +511,40 @@ class EngineDocSet:
 
     # -- rows backend: coalesced round-frame ingress ------------------------
 
+    def apply_columns_async(self, doc_id: str, cols) -> PendingIngress:
+        """Pipelined columnar admission (epoch mode): buffer the ingress
+        and return a PendingIngress whose .wait() blocks until the
+        carrying flush (re-raising its error). A writer that keeps a
+        small in-flight window (await ticket k before appending k+D)
+        gets group-commit throughput with rounds flushing back-to-back —
+        the next cohort's ops are already buffered when a round
+        resolves, so no flush ever waits on a wake chain. Every handle
+        should eventually be waited — .wait() is the durability
+        observation point and the waiter drives handler gossip promptly
+        (an abandoned handle falls back to the drain thread's gossip
+        backstop, which runs only after the carrying round). Outside
+        epoch mode (locked services, docs-major, inside an owned batch)
+        this degrades to the synchronous apply and returns a
+        pre-resolved handle."""
+        if self.backend != "rows" or not self._epoch_admission_open():
+            self.apply_columns(doc_id, cols)
+            return PendingIngress(self, None)
+        return PendingIngress(self, self._epoch_append(doc_id, cols))
+
+    def _epoch_admission_open(self) -> bool:
+        """Epoch-buffered admission applies unless THIS thread must not
+        park on a ticket: inside its own batch() (the batch exit runs
+        the flush), or while it is the drain thread running the gossip
+        backstop (a handler re-entering apply must take the inline
+        locked path — parking would deadlock the drainer on a flush
+        only it performs)."""
+        return (self._epoch is not None
+                and self._batch_owner != threading.get_ident()
+                and not getattr(self._drain_local, "gossiping", False))
+
     def _rows_ingest(self, doc_id: str, cols) -> DocHandle:
+        if self._epoch_admission_open():
+            return self._rows_ingest_epoch(doc_id, cols)
         try:
             with self._lock:
                 self.add_doc(doc_id)
@@ -409,6 +568,196 @@ class EngineDocSet:
             raise
         self._drain_admitted()
         return handle
+
+    def _rows_ingest_epoch(self, doc_id: str, cols) -> DocHandle:
+        """Lock-free-admission ingress: append into the striped epoch
+        buffer (one stripe lock, microseconds), kick the flusher, and
+        park until the flush that carried the entry resolves the ticket
+        — the group-commit geometry. The service lock is never touched
+        on this path; concurrent writers' entries coalesce into ONE
+        round, so N writers amortize one flush (bench config 9).
+        Ghost-anchored ingresses are rejected at seal time, failing only
+        the offending ticket; a flush error, however, is group-scoped —
+        it re-raises to EVERY writer riding the failed round, and the
+        round's restored columns may still admit on a later retry flush
+        (the locked path's restore-for-retry semantics, see __init__'s
+        ingest_mode contract note)."""
+        # sync_commit_wait_s is recorded by the resolver (Ticket
+        # .resolve) — the writer's post-wake path stays lock-free.
+        # claimed=True: this thread WILL wait and run the gossip itself,
+        # so the flusher's backstop stays off the round (delivery happens
+        # on the applying thread — in a relay, inside the serve span).
+        PendingIngress(self, self._epoch_append(doc_id, cols,
+                                                claimed=True)).wait()
+        return self.get_doc(doc_id)
+
+    def _epoch_append(self, doc_id: str, cols, claimed: bool = False):
+        """Shared epoch admission: oplag-admit, one stripe-lock append,
+        kick the flusher. Both the synchronous and the pipelined ingress
+        park on the returned ticket via PendingIngress.wait, so the
+        wait/drain/re-raise contract lives in exactly one place."""
+        tok = oplag.admit(doc_id)
+        ticket = self._epoch.append(doc_id, cols, tok, claimed=claimed)
+        self._kick_or_flush()
+        return ticket
+
+    def _kick_or_flush(self) -> None:
+        """Ticket-liveness hook: re-kick the flusher — or, once close()
+        has stopped it, drain inline so a late writer (e.g. a TCP
+        reader still applying during shutdown) is resolved instead of
+        parked forever behind a dead flusher."""
+        if not self._flusher.kick():
+            self._flush_epochs()
+
+    def _seal_epochs_locked(self) -> list:
+        """The epoch seal (runs under self._lock — its one remaining
+        ingestion duty): swap the striped buffers out and coalesce the
+        drained entries into self._pending, where the existing flush /
+        restore-for-retry machinery takes over. Per-entry pre-admission
+        rejections (ghost anchors) resolve ONLY the offending sender's
+        ticket. Returns the tickets riding the coalesced round."""
+        entries = self._epoch.seal()
+        if not entries:
+            return []
+        tickets: list = []
+        n_ops = 0
+        for e in entries:
+            try:
+                self.add_doc(e.doc_id)
+                rset = self._resident
+                i = rset.doc_index[e.doc_id]
+                if rset.ghost_eids[i]:
+                    rset._check_ghost_anchors_cols(
+                        i, e.cols, 0, len(e.cols.op_action))
+            except BaseException as exc:
+                e.ticket.resolve(exc)
+                continue
+            self._pending.setdefault(e.doc_id, []).append(e.cols)
+            n_ops += len(e.cols.op_action)
+            if e.tok is not None:
+                oplag.sealed(e.tok)
+                self._lag_pending.append(e.tok)
+            tickets.append(e.ticket)
+        if n_ops:
+            # bulk-counted here (one metrics-lock crossing per seal, and
+            # in OPS — the registered unit — not buffered entries)
+            metrics.bump("sync_ops_buffered", int(n_ops))
+        flightrec.record("epoch_seal", shard=self._shard,
+                         entries=len(tickets), ops=int(n_ops))
+        return tickets
+
+    #: hard cap (seconds) on the flusher's pre-seal refill probe: the
+    #: probe only yields while the buffer is still GROWING, so the cap
+    #: exists for a pathological never-waiting append flood, not for
+    #: the steady state (which quiesces in a few GIL yields)
+    _REFILL_CAP_S = 5e-4
+
+    def _refill_probe(self) -> None:
+        """Adaptive group-commit window: before sealing, yield the GIL
+        while concurrent writers are still refilling the buffer. The
+        writers a round's resolve just woke are appending their next
+        in-flight window RIGHT NOW — sealing immediately cuts them off
+        mid-refill, pinning rounds at roughly half the writers'
+        pipeline depth (measured: 4.0 ops/round at 4 depth-2 writers,
+        the flusher-cycle-bound plateau of bench config 9). Each
+        `sleep(0)` hands the GIL to a runnable writer; the probe exits
+        as soon as a poll sees no growth (a solo or synchronous writer
+        quiesces on the first poll — no latency tax on the un-contended
+        path, which is why this probe lives here and NOT in the read
+        path's _maybe_flush_locked) or at the hard cap. Unlike the
+        fixed straggler delay measured-and-rejected earlier, this never
+        waits on a CLOCK for work that may not come — only on observed
+        growth."""
+        buf = self._epoch
+        if buf is None:
+            return
+        prev = -1
+        deadline = _time.perf_counter() + self._REFILL_CAP_S
+        while True:
+            cur = buf.count()
+            if cur <= prev or _time.perf_counter() >= deadline:
+                return
+            prev = cur
+            _time.sleep(0)
+
+    def _flush_epochs(self) -> None:
+        """Dedicated-flusher drain: the pre-seal refill probe
+        (_refill_probe — lets the just-woken writers finish appending
+        their next in-flight window so rounds fill toward the full
+        pipeline depth), then one seal + flush + resolve cycle.
+
+        After the drain, the gossip BACKSTOP: the waked writers
+        normally run the admission gossip (their _drain_admitted after
+        wait()), but an apply_columns_async caller that abandons (or
+        long-defers) its handle would otherwise strand _admit_notify —
+        replication silently stalled until unrelated traffic. The
+        backstop runs ONLY when the round carried at least one
+        unclaimed ticket (no writer has committed to waiting on it):
+        a round whose riders are all claimed has a parked writer per
+        ingress, each of which drains the gossip itself right after it
+        wakes — so the flusher must not race them for the handler
+        calls. That keeps delivery on the applying threads (a relayed
+        send stays inside the serve span that triggered it — one trace
+        end to end) and, crucially, keeps the synchronous contract
+        visible: when apply_* returns, its doc's gossip was delivered
+        by a writer thread, not left in flight on this one. The
+        _drain_local guard routes any handler callback that re-enters
+        apply on THIS thread onto the inline locked path, so the
+        drainer can never park on a ticket only it could resolve."""
+        self._refill_probe()
+        riders = self._drain_epochs_once()
+        if riders and all(t.claimed for t in riders):
+            return
+        self._drain_local.gossiping = True
+        try:
+            self._drain_admitted()
+        finally:
+            self._drain_local.gossiping = False
+
+    def _drain_epochs_once(self) -> list:
+        """One drain: seal the open epoch, flush the
+        coalesced round, resolve the riding tickets with the outcome —
+        returned (seal-rejected tickets excluded: their writers wake
+        with the error and run their own shielded gossip drain) so
+        _flush_epochs can decide whether the gossip backstop is needed. A
+        flush error reaches every waiting writer of the round (the same
+        visibility the inline path gave its single caller) while
+        self._pending keeps the existing restore-for-retry rules; the
+        waked writers normally run the admission gossip off the flusher
+        (the drain itself never calls handlers — _flush_epochs runs the
+        guarded backstop pass after it).
+
+        GC is paused for the drain (utils.gcpause, refcounted — same
+        treatment batch() gives its exit flush): the round encode is a
+        burst of small allocations, and generational collections landing
+        inside the flush window were measured at ~1.7x round cost on
+        the 2-core bench host."""
+        from ..utils.gcpause import gc_paused
+
+        exc: BaseException | None = None
+        riders: list = []
+        with self._lock, gc_paused():
+            tickets = self._seal_epochs_locked()
+            riders = tickets
+            # Flush only when the seal coalesced new entries: a restored
+            # _pending round (failed-flush retry state) is retried by the
+            # NEXT ingress/flush/read exactly as in locked mode — the
+            # flusher must not turn a liveness re-kick into a hot retry
+            # loop against a persistent failure.
+            if tickets and self._pending:
+                self._inflight_tickets = tickets
+                try:
+                    self._flush_locked()
+                except BaseException as e:
+                    exc = e
+                finally:
+                    # tickets NOT consumed by the early post-admission
+                    # resolve (the flush failed before admission): theirs
+                    # is the error outcome below
+                    tickets = self._inflight_tickets
+                    self._inflight_tickets = []
+        self._epoch.resolve(tickets, exc)
+        return riders
 
     def _metric_labels(self) -> dict:
         return {"shard": self._shard} if self._shard is not None else {}
@@ -489,6 +838,50 @@ class EngineDocSet:
                 return True
             return len(rset.change_log[rset.doc_index[d]]) > pre[d]
         try:
+            self._flush_pending_inner_locked(rset, pending, _changed)
+        finally:
+            # a mid-flush rebuild swapped the engine internals: every
+            # doc's log list was replaced, so the whole snapshot read
+            # plane (clock/log caches) must re-key — and the stale
+            # entries are dropped outright (they pin pre-rebuild lists)
+            if getattr(rset, "_rebuild_gen", 0) != pre_gen:
+                self._read_gen += 1
+                self._clock_cache.clear()
+                self._log_cache.clear()
+
+    def _early_resolve_locked(self) -> None:
+        """Resolve the in-flight epoch tickets (set by the epoch drain
+        paths around _flush_locked) as soon as the round's admission and
+        cache invalidation are durable. No-op when the flush was not
+        carrying epoch tickets (locked mode, batch exits, retries)."""
+        t, self._inflight_tickets = self._inflight_tickets, []
+        if t:
+            # release every futex here (one cheap wake each); the
+            # sync_commit_wait_s observes are deferred to
+            # _drain_lag_records OUTSIDE self._lock — per-ticket registry
+            # crossings under the hold would inflate exactly the
+            # service-lock hold time this refactor gates
+            self._commit_waits.extend(
+                w for w in (tk.resolve() for tk in t) if w is not None)
+
+    def _bump_read_vers_locked(self, docs) -> None:
+        """Invalidate the per-doc snapshot read caches (clock_of /
+        missing_changes) for docs whose clock or admitted log moved.
+        Invalidation rules mirror the hash-epoch plane (INTERNALS.md):
+        admission and archival bump the touched doc; rebuild bumps the
+        generation (_read_gen) in _flush_pending_locked; compaction
+        bumps nothing (clocks and logs are untouched by row reclaim).
+        Stale cache entries are EVICTED, not just out-keyed: a doc's
+        cached log tuple pins the pre-archival change_log, and keeping
+        it would re-grow exactly the RAM the log-horizon layer
+        reclaims."""
+        for d in docs:
+            self._doc_ver[d] = self._doc_ver.get(d, 0) + 1
+            self._clock_cache.pop(d, None)
+            self._log_cache.pop(d, None)
+
+    def _flush_pending_inner_locked(self, rset, pending, _changed) -> None:
+        try:
             self._apply_with_compaction(rset, pending)
         except DeviceDispatchError as e:
             # The admitted part of the flush is durable on the host
@@ -511,6 +904,8 @@ class EngineDocSet:
             self._pending = {
                 d: cols for d, cols in pending.items()
                 if d != e.doc_id and not _changed(d)}
+            self._bump_read_vers_locked(
+                d for d in pending if _changed(d))
             raise
         except Exception:
             # Pre-admission failure (budget precheck, malformed frame, …).
@@ -522,10 +917,20 @@ class EngineDocSet:
             # admit still gossip below via the shared tail.
             self._pending = {d: cols for d, cols in pending.items()
                              if not _changed(d)}
-            self._admit_notify.extend(d for d in pending if _changed(d))
+            if self.handlers:
+                self._admit_notify.extend(d for d in pending
+                                          if _changed(d))
+            self._bump_read_vers_locked(
+                d for d in pending if _changed(d))
             raise
         admitted = [d for d in pending if _changed(d)]
-        self._admit_notify.extend(admitted)
+        if self.handlers:
+            # no registered handlers -> no notifications to queue: the
+            # post-flush drain then needs no service-lock reacquisition
+            # per admitted doc (measured as the residual service-lock
+            # traffic of the epoch admission path)
+            self._admit_notify.extend(admitted)
+        self._bump_read_vers_locked(admitted)
         # Log-horizon auto-trigger: MUST run after `admitted` above —
         # archiving shrinks change_log, and the length-based _changed is
         # only sound before any archival of this flush's docs.
@@ -537,6 +942,16 @@ class EngineDocSet:
                     floor = self._compaction_floor_locked(d)
                     if floor:
                         rset.archive_log_prefix(d, floor)
+        # Host admission (and any archival) is durable and the snapshot
+        # read plane re-keyed: the round's riding tickets can resolve
+        # NOW, overlapping the remaining flush tail (span/metric
+        # accounting, lock release) with the writers' wake-and-next-
+        # append window — on a 2-core host that serial wake chain was a
+        # measurable slice of every group-commit cycle. Notifications
+        # were queued above, so a woken writer's drain sees them; the
+        # archival runs BEFORE this, so apply's post-conditions (horizon
+        # set, RAM log bounded) hold the moment the writer returns.
+        self._early_resolve_locked()
 
     def _apply_with_compaction(self, rset, pending: dict) -> None:
         """Apply one coalesced round; on VMEM-budget pressure, compact
@@ -596,16 +1011,31 @@ class EngineDocSet:
         return pins
 
     def flush(self) -> None:
-        """Apply any coalesced ingress now (rows backend; no-op otherwise)."""
+        """Apply any coalesced ingress now (rows backend; no-op otherwise).
+        Epoch mode: also seals and flushes any buffered epoch entries
+        inline (readers must never depend on flusher liveness)."""
         if self.backend != "rows":
             return
         try:
             with self._lock:
-                self._flush_locked()
+                self._maybe_flush_locked()
         except BaseException:
             self._drain_admitted_shielded()
             raise
         self._drain_admitted()
+
+    def close(self) -> None:
+        """Flush any buffered ingress and stop (join) the flusher thread.
+        Idle flushers exit on their own after the linger window, so
+        close() is a courtesy for deterministic teardown, not a
+        correctness requirement."""
+        if self._epoch is not None and not self._epoch.empty():
+            try:
+                self.flush()
+            except Exception:
+                pass   # tickets carried the error to their writers
+        if self._flusher is not None:
+            self._flusher.stop()
 
     def batch(self):
         """Context manager: coalesce every ingress inside the block into
@@ -625,17 +1055,25 @@ class EngineDocSet:
         def _cm():
             try:
                 with self._lock, gc_paused():
+                    prev_owner = self._batch_owner
+                    self._batch_owner = threading.get_ident()
                     self._batch_depth += 1
                     try:
                         yield self
                     finally:
                         self._batch_depth -= 1
+                        self._batch_owner = prev_owner
                         if not self._batch_depth:
                             self._flush_locked()
             except BaseException:
                 self._drain_admitted_shielded()
                 raise
             self._drain_admitted()
+            # other threads' ingresses buffered while this batch held the
+            # lock: hand them to the flusher now
+            if self._epoch is not None and not self._epoch.empty() \
+                    and self._flusher is not None:
+                self._flusher.kick()
         return _cm()
 
     def _drain_admitted_shielded(self) -> None:
@@ -654,6 +1092,11 @@ class EngineDocSet:
         hold time or round latency the contention plane exists to
         measure. Runs before handler gossip so every token is parked in
         the awaiting-wire table before its doc's message leaves."""
+        if self._commit_waits:
+            with self._lock:
+                waits, self._commit_waits = self._commit_waits, []
+            for w in waits:
+                metrics.observe("sync_commit_wait_s", w)
         if not self._lag_flushed:
             return
         with self._lock:
@@ -670,16 +1113,40 @@ class EngineDocSet:
         """Notify handlers for admitted docs, outside self._lock (a handler
         — e.g. a Connection — may call back into this node). Inside a
         batch() the calling thread still holds the lock, so draining
-        defers to the batch exit (which runs after release)."""
+        defers to the batch exit (which runs after release).
+
+        NON-REENTRANT per thread: a handler's read (Connection
+        .doc_changed reads clock_of, whose post-read drain lands back
+        here) must NOT start an inner drain — the inner pass would
+        deliver a LATER admission of the same doc first, record its
+        newer clock on the connection, and hand the outer doc_changed
+        frame a clock the old-state guard then rejects ("Cannot pass an
+        old state object"). The outermost frame's loop is still
+        running, so anything a handler's callback admits or re-queues
+        is delivered by IT, after the current handler returns — in
+        admission order. (missing_changes(drain=False) solves the same
+        hazard for the one caller that holds a non-reentrant lock; this
+        guard covers every read a handler may reach.)"""
         self._drain_lag_records()
-        while True:
-            with self._lock:
-                if self._batch_depth or not self._admit_notify:
-                    return
-                doc_id = self._admit_notify.pop(0)
-                handle = self.get_doc(doc_id)
-            for handler in list(self.handlers):
-                handler(doc_id, handle)
+        if not self._admit_notify:
+            # unlocked fast path (GIL-atomic list peek): nothing queued,
+            # so don't touch the service lock at all — the locked loop
+            # below stays authoritative when the peek sees entries
+            return
+        if getattr(self._drain_local, "draining", False):
+            return
+        self._drain_local.draining = True
+        try:
+            while True:
+                with self._lock:
+                    if self._batch_depth or not self._admit_notify:
+                        return
+                    doc_id = self._admit_notify.pop(0)
+                    handle = self.get_doc(doc_id)
+                for handler in list(self.handlers):
+                    handler(doc_id, handle)
+        finally:
+            self._drain_local.draining = False
 
     def _drain_notifications(self) -> None:
         """Deliver queued diff batches to view subscribers in ingress order.
@@ -719,21 +1186,61 @@ class EngineDocSet:
     # -- protocol reads -------------------------------------------------------
 
     def _maybe_flush_locked(self) -> None:
-        """Reads must observe pending coalesced ingress (rows backend)."""
-        if self.backend == "rows" and self._pending:
+        """Reads must observe pending coalesced ingress (rows backend).
+        Epoch mode: seal any buffered entries first and resolve their
+        tickets with the flush outcome — the inline twin of the
+        flusher's drain, so a read's recency never depends on flusher
+        scheduling."""
+        if self.backend != "rows":
+            return
+        tickets = (self._seal_epochs_locked()
+                   if self._epoch is not None else [])
+        if not self._pending:
+            epochs.EpochIngestBuffer.resolve(tickets)
+            return
+        self._inflight_tickets = tickets
+        try:
             self._flush_locked()
+        except BaseException as e:
+            leftover, self._inflight_tickets = self._inflight_tickets, []
+            epochs.EpochIngestBuffer.resolve(leftover, e)
+            raise
+        leftover, self._inflight_tickets = self._inflight_tickets, []
+        epochs.EpochIngestBuffer.resolve(leftover)
+
+    def _read_key(self, doc_id: str) -> tuple[int, int]:
+        """Validity key of a doc's snapshot read cache: the rebuild
+        generation plus the per-doc admission version (the read-surface
+        twin of the engine's hash epoch)."""
+        return (self._read_gen, self._doc_ver.get(doc_id, 0))
+
+    def _snap_fresh(self, doc_id: str, snap) -> bool:
+        """True when a cached per-doc snapshot may serve lock-free: the
+        key still matches, nothing is pending a flush, and no buffered
+        epoch entries exist for this doc. All reads here are GIL-atomic
+        dict peeks; any race with a concurrent flush either serves the
+        pre-flush snapshot (the read linearizes before the write) or
+        routes to the locked fill path."""
+        return snap is not None and snap[0] == self._read_key(doc_id) \
+            and not self._pending \
+            and (self._epoch is None or not self._epoch.has(doc_id))
 
     def clock_of(self, doc_id: str) -> dict[str, int]:
+        snap = self._clock_cache.get(doc_id)
+        if self._snap_fresh(doc_id, snap):
+            metrics.bump("sync_reads_cached")
+            return dict(snap[1])
         try:
             with self._lock:
                 self._maybe_flush_locked()
                 i = self._resident.doc_index[doc_id]
                 out = dict(self._resident.tables[i].clock)
+                self._clock_cache[doc_id] = (self._read_key(doc_id), out)
         except BaseException:
             self._drain_admitted_shielded()
             raise
         self._drain_admitted()  # a read-triggered flush may have admitted
-        return out
+        return dict(out)
 
     def missing_changes(self, doc_id: str, clock: dict[str, int],
                         drain: bool = True) -> list[Change]:
@@ -746,42 +1253,48 @@ class EngineDocSet:
         which holds a non-reentrant lock) must not re-enter the handler
         chain from its own read — the outer drain loop delivers whatever
         this read's flush admitted."""
+        if self.backend == "rows":
+            # Rows path: served from the per-doc log snapshot (immutable
+            # — archive_log_prefix REBINDS change_log[i], so a captured
+            # tuple never mutates under a reader). The per-peer seq
+            # filter and any archive cold read run OUTSIDE the service
+            # lock: one lagging peer's O(history) cold parse no longer
+            # stalls flushes (ADVICE low #2; logarchive.py additionally
+            # caches the parsed prefix keyed by file size).
+            snap = self._log_cache.get(doc_id)
+            if self._snap_fresh(doc_id, snap):
+                metrics.bump("sync_reads_cached")
+            else:
+                snap = self._fill_log_cache_locked(doc_id, drain)
+            if snap is None:
+                return []
+            _key, log, hz, archive = snap
+            out = [c if isinstance(c, Change) else c.change()
+                   for c in log if c.seq > clock.get(c.actor, 0)]
+            if hz and archive is not None \
+                    and any(clock.get(a, 0) < s for a, s in hz.items()):
+                # peer is behind the log horizon: transparent cold read
+                # of the archived prefix — the reference {docId, clock,
+                # changes} protocol is unchanged, the serving side just
+                # pays a (cached) file read. Clipped to the snapshotted
+                # horizon: after a rebuild restored the full log to RAM,
+                # a later partial re-archive can leave the archive
+                # holding more than the horizon covers — the RAM tail
+                # already serves that overlap.
+                metrics.bump("sync_archive_cold_reads")
+                cold = [c for c in archive.read(doc_id)
+                        if clock.get(c.actor, 0) < c.seq
+                        <= hz.get(c.actor, 0)]
+                out = cold + out
+            return out
         try:
             with self._lock:
                 self._maybe_flush_locked()
-                if self.backend == "rows":
-                    # the rows engine's admitted log is the re-serve source
-                    rset = self._resident
-                    i = rset.doc_index.get(doc_id)
-                    out = [] if i is None else [
-                        c if isinstance(c, Change) else c.change()
-                        for c in rset.change_log[i]
-                        if c.seq > clock.get(c.actor, 0)]
-                    if i is not None and rset.log_horizon[i] \
-                            and rset.log_archive is not None \
-                            and any(clock.get(a, 0) < s
-                                    for a, s in rset.log_horizon[i].items()):
-                        # peer is behind the log horizon: transparent cold
-                        # read of the archived prefix — the reference
-                        # {docId, clock, changes} protocol is unchanged,
-                        # the serving side just pays a file read
-                        metrics.bump("sync_archive_cold_reads")
-                        hz = rset.log_horizon[i]
-                        # clip to the CURRENT horizon: after a rebuild
-                        # restored the full log to RAM, a later partial
-                        # re-archive can leave the archive holding more
-                        # than the horizon covers — the RAM tail already
-                        # serves that overlap
-                        cold = [c for c in rset.log_archive.read(doc_id)
-                                if clock.get(c.actor, 0) < c.seq
-                                <= hz.get(c.actor, 0)]
-                        out = cold + out
-                else:
-                    out = []
-                    for actor, changes in self._log.get(doc_id, {}).items():
-                        have = clock.get(actor, 0)
-                        out.extend(c if isinstance(c, Change) else c.change()
-                                   for c in changes if c.seq > have)
+                out = []
+                for actor, changes in self._log.get(doc_id, {}).items():
+                    have = clock.get(actor, 0)
+                    out.extend(c if isinstance(c, Change) else c.change()
+                               for c in changes if c.seq > have)
         except BaseException:
             if drain:
                 self._drain_admitted_shielded()
@@ -789,6 +1302,34 @@ class EngineDocSet:
         if drain:
             self._drain_admitted()
         return out
+
+    def _fill_log_cache_locked(self, doc_id: str, drain: bool = True):
+        """Refresh one doc's log snapshot under the service lock: flush
+        pending ingress, then capture (validity key, log tuple, horizon
+        copy, archive handle). The capture is O(log tail) pointer
+        copies; every later read of the doc until its next admission is
+        lock-free. Returns None for unknown docs."""
+        try:
+            with self._lock:
+                self._maybe_flush_locked()
+                rset = self._resident
+                i = rset.doc_index.get(doc_id)
+                if i is None:
+                    snap = None
+                else:
+                    hz = rset.log_horizon[i]
+                    snap = (self._read_key(doc_id),
+                            tuple(rset.change_log[i]),
+                            dict(hz) if hz else {},
+                            rset.log_archive if hz else None)
+                    self._log_cache[doc_id] = snap
+        except BaseException:
+            if drain:
+                self._drain_admitted_shielded()
+            raise
+        if drain:
+            self._drain_admitted()
+        return snap
 
     # -- engine reads ---------------------------------------------------------
 
@@ -829,6 +1370,8 @@ class EngineDocSet:
         is pending (a read flushes it first)."""
         with self._lock:
             return bool(self._pending) \
+                or (self._epoch is not None
+                    and not self._epoch.empty()) \
                 or self._resident.hash_epoch != epoch
 
     def hashes_for(self, doc_ids) -> dict[str, int]:

@@ -37,10 +37,16 @@ STALL_WATCHDOG_S = float(os.environ.get("AMTPU_STALL_WATCHDOG_S", "120"))
 
 
 class ShardedEngineDocSet:
+    #: transports may apply without holding their doc_set-wide lock
+    #: (see EngineDocSet.concurrent_ingest; routing adds no shared state
+    #: beyond the stable crc32 hash)
+    concurrent_ingest = True
+
     def __init__(self, n_shards: int = 2, doc_ids: list[str] | None = None,
                  backend: str = "rows", devices=None,
                  log_archive_dir: str | None = None,
-                 log_horizon_changes: int | None = None):
+                 log_horizon_changes: int | None = None,
+                 ingest_mode: str | None = None):
         """devices: optional list of jax devices; shards bind round-robin
         so K shards drive K chips from one process (each shard's uploads
         and dispatches are pinned via the engine's `device` attribute —
@@ -58,12 +64,15 @@ class ShardedEngineDocSet:
                                  if devices else None),
                          log_archive_dir=(None if log_archive_dir is None
                                           else f"{log_archive_dir}/shard{k}"),
-                         log_horizon_changes=log_horizon_changes)
+                         log_horizon_changes=log_horizon_changes,
+                         ingest_mode=ingest_mode)
             for k in range(n_shards)]
         for k, s in enumerate(self.shards):
             s._shard = str(k)   # per-shard metric series (sync_round_flush…)
             # per-shard lock-contention series (bounded: one per shard),
-            # so the lockprof plane separates a hot shard from the rest
+            # so the lockprof plane separates a hot shard from the rest;
+            # each shard's lazy flusher thread picks up the shard label
+            # at spawn time (amtpu-flusher-<k>)
             s._lock.rename(f"service_shard{k}")
         # monotonic hash fan-out counter: tagged onto the fan-out span and
         # the flight-recorder progress events, so a post-mortem names which
@@ -119,6 +128,12 @@ class ShardedEngineDocSet:
     def apply_columns(self, doc_id: str, cols):
         return self.shard_of(doc_id).apply_columns(doc_id, cols)
 
+    def apply_columns_async(self, doc_id: str, cols):
+        """Pipelined admission routed to the owning shard (see
+        EngineDocSet.apply_columns_async); per-shard flushers drain
+        concurrently, so a streaming writer saturates K shards."""
+        return self.shard_of(doc_id).apply_columns_async(doc_id, cols)
+
     def archive_logs(self, doc_ids: list[str] | None = None) -> dict[str, int]:
         """Per-doc archived counts across shards (log-horizon layer)."""
         out: dict[str, int] = {}
@@ -129,6 +144,12 @@ class ShardedEngineDocSet:
             for d in doc_ids:
                 out.update(self.shard_of(d).archive_logs([d]))
         return out
+
+    def close(self) -> None:
+        """Flush buffered ingress and stop (join) every shard's flusher
+        thread — deterministic teardown for tests and restarts."""
+        for s in self.shards:
+            s.close()
 
     def flush(self) -> None:
         """Flush every shard even if one raises (shards are independent;

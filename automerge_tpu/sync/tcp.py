@@ -145,22 +145,25 @@ class LockedConnection(Connection):
     can re-enter doc_changed through DocSet handler gossip.
 
     The lock is SHARED by every connection attached to the same doc_set
-    (one lock per doc_set, held for the whole receive->apply->gossip chain).
-    Per-connection locks would deadlock: two reader threads each holding
-    their own connection's lock while gossip tries to enter the other's
-    (classic ABBA through DocSet handlers)."""
+    (one lock per doc_set — per-connection locks would deadlock: two
+    reader threads each holding their own connection's lock while gossip
+    tries to enter the other's, classic ABBA through DocSet handlers).
+    It is installed as the base Connection's `_state_lock`, guarding the
+    clock maps and send decisions in SHORT sections rather than the
+    whole receive->apply->gossip chain. The apply itself runs outside it
+    when the doc_set declares `concurrent_ingest` (EngineDocSet /
+    ShardedEngineDocSet): N peer reader threads then ingest concurrently
+    and group-commit through the service's epoch buffers instead of
+    serializing node-wide — the multi-writer drain path. Plain DocSets
+    (interpretive doc objects, not thread-safe) keep the apply under the
+    shared lock via `_apply_lock`."""
 
     def __init__(self, doc_set, send_msg, wire: str = "json"):
         super().__init__(doc_set, send_msg, wire=wire)
         self._lock = _sync_lock_of(doc_set)
-
-    def receive_msg(self, msg):
-        with self._lock:
-            return super().receive_msg(msg)
-
-    def doc_changed(self, doc_id, doc):
-        with self._lock:
-            super().doc_changed(doc_id, doc)
+        self._state_lock = self._lock
+        if not getattr(doc_set, "concurrent_ingest", False):
+            self._apply_lock = self._lock
 
 
 class _Peer:

@@ -65,6 +65,9 @@ EVENT_KINDS: dict[str, str] = {
     "frame_recv": "one protocol message read from a TCP socket",
     "round_flush": "a coalesced service round entering the engine "
                    "(sync/service.py; shard/round/docs/ops)",
+    "epoch_seal": "an ingestion epoch sealed into the pending round "
+                  "(sync/service.py; shard/entries/ops — the group-"
+                  "commit boundary of the epoch-buffered admission path)",
     "hash_read": "per-node converged hash-table read served "
                  "(sync/service.py; shard/docs)",
     "hash_shard": "sharded hash fan-out reaching shard k "

@@ -114,10 +114,23 @@ COUNTERS: dict[str, str] = {
     "sync_wire_bytes_received": "framed bytes read from a TCP transport",
     "sync_ops_ingested": "ops admitted through service round flushes",
     "sync_rounds_flushed": "coalesced service round flushes",
+    # epoch-batched ingestion (sync/epochs.py): the lock-free admission
+    # path and its snapshot read plane (sync/service.py)
+    "sync_ops_buffered":
+        "ingress ops appended to the epoch ingestion buffer "
+        "(sync/epochs.py; no service lock on this path)",
+    "sync_epochs_sealed":
+        "ingestion epochs sealed into coalesced rounds (sync/epochs.py)",
+    "sync_reads_cached":
+        "clock_of/missing_changes served lock-free from the per-doc "
+        "snapshot read cache (sync/service.py)",
     "sync_archive_cold_reads": "lagging-peer reads served from the archive",
     "sync_changes_archived": "changes moved into the log archive",
     "sync_archive_tail_repaired": "torn archive tails repaired on open",
     "sync_archive_tail_skipped": "torn archive tails skipped on read",
+    "sync_archive_reads_cached":
+        "archive cold reads served from the parsed-prefix cache "
+        "(sync/logarchive.py; keyed by file size+mtime)",
     "sync_metrics_pulls": "remote metrics snapshots served to peers",
     # lockprof (utils/lockprof.py): the contention plane. The `_total`
     # suffix is deliberate prometheus idiom for this one counter (it
@@ -184,9 +197,13 @@ HISTOGRAMS: dict[str, str] = {
     # oplag (utils/oplag.py): per-stage lag of sampled ops through the
     # admission -> flush -> wire -> peer-apply -> converged lifecycle
     "sync_op_lag_s":
-        "sampled op-lifecycle stage lag {stage=causal_queue|queue_wait|"
-        "pack|dispatch|device_wait|flush|origin_total|wire|peer_apply|"
-        "converge} (utils/oplag.py; docs/OBSERVABILITY.md)",
+        "sampled op-lifecycle stage lag {stage=causal_queue|buffer_wait|"
+        "queue_wait|pack|dispatch|device_wait|flush|origin_total|wire|"
+        "peer_apply|converge} (utils/oplag.py; docs/OBSERVABILITY.md)",
+    "sync_commit_wait_s":
+        "writer park from epoch-buffer append to its group-commit flush "
+        "resolving (sync/epochs.py ticket wait — NOT a lock wait: the "
+        "writer holds nothing while parked)",
 }
 
 SPANS: dict[str, str] = {

@@ -12,8 +12,12 @@ zero profiler work) and attributes its whole life to stages:
 
     causal_queue   parked causally-unready in the interpretive queue
                    (core/opset.py) until its deps arrived
-    queue_wait     ingress admitted -> its coalesced round flush started
-                   (sync/service.py `_rows_ingest` -> `_flush_locked`)
+    buffer_wait    ingress appended to the epoch ingestion buffer -> its
+                   epoch sealed into a coalesced round (sync/epochs.py;
+                   epoch-mode services only — the group-commit park)
+    queue_wait     sealed (or admitted, in locked mode) -> its coalesced
+                   round flush started (sync/service.py `_rows_ingest`
+                   -> `_flush_locked`)
     flush          the round flush that carried it (host admission +
                    device dispatch), wall time
     pack           host packing attributed to that flush (perfscope
@@ -59,6 +63,7 @@ counter increment at admission and nothing anywhere else.
 from __future__ import annotations
 
 import binascii
+import itertools
 import os
 import threading
 import time
@@ -79,8 +84,9 @@ _GAUGE_REFRESH = 32
 
 #: registered stage names (label values of sync_op_lag_s; the docstring
 #: above and docs/OBSERVABILITY.md define each)
-STAGES = ("causal_queue", "queue_wait", "pack", "dispatch", "device_wait",
-          "flush", "origin_total", "wire", "peer_apply", "converge")
+STAGES = ("causal_queue", "buffer_wait", "queue_wait", "pack", "dispatch",
+          "device_wait", "flush", "origin_total", "wire", "peer_apply",
+          "converge")
 
 #: bound on docs awaiting a wire send and on parked causal-queue marks
 _AWAIT_MAX = 256
@@ -95,7 +101,10 @@ WIRE_TTL_S = 5.0
 
 _lock = threading.Lock()
 _rate: int | None = None          # resolved lazily from the env
-_counter = 0                      # admissions since reset (sampling clock)
+# admissions-since-reset sampling clock: an itertools.count, whose
+# next() is a single C-level (GIL-atomic) operation — concurrent
+# epoch-mode writers admit without ever touching _lock
+_counter = itertools.count(1)
 _awaiting_wire: "OrderedDict[str, Token]" = OrderedDict()
 _parked: "OrderedDict[tuple, float]" = OrderedDict()
 _stage_res: dict[str, deque] = {}
@@ -105,13 +114,14 @@ _stage_count: dict[str, int] = {}
 class Token:
     """One sampled op in flight: provenance id + origin timestamps."""
 
-    __slots__ = ("id", "doc", "t0", "wall", "t_flushed")
+    __slots__ = ("id", "doc", "t0", "wall", "t_sealed", "t_flushed")
 
     def __init__(self, doc: str):
         self.id = binascii.hexlify(os.urandom(4)).decode()
         self.doc = doc
         self.t0 = time.perf_counter()
         self.wall = time.time()
+        self.t_sealed = 0.0
         self.t_flushed = 0.0
 
 
@@ -136,7 +146,7 @@ def set_sample_rate(n: int | None) -> None:
     global _rate, _counter
     with _lock:
         _rate = None if n is None else max(0, int(n))
-        _counter = 0
+        _counter = itertools.count(1)
 
 
 def enabled() -> bool:
@@ -192,11 +202,11 @@ def admit(doc_id: str) -> Token | None:
     n = sample_rate()
     if n <= 0:
         return None
-    global _counter
-    with _lock:
-        _counter += 1
-        if _counter % n:
-            return None
+    if next(_counter) % n:
+        # the common path: one GIL-atomic counter tick, no lock — an
+        # unsampled admission must stay nearly free even with many
+        # concurrent epoch-mode writers
+        return None
     tok = Token(doc_id)
     metrics.bump("sync_ops_sampled")
     try:
@@ -207,6 +217,18 @@ def admit(doc_id: str) -> Token | None:
     return tok
 
 
+def sealed(tok: Token) -> None:
+    """`tok`'s ingress left the epoch ingestion buffer (sync/epochs.py
+    seal). STAMP ONLY — this runs under the service lock, so it must
+    not touch the registry (histogram locks, flightrec, the periodic
+    percentile refresh would inflate exactly the lock-hold time the
+    contention plane measures); flushed() records the buffer_wait
+    stage from the stamp in the deferred _drain_lag_records pass. The
+    later queue_wait stage counts from the seal, keeping the stages
+    additive (buffer_wait + queue_wait = admission -> flush start)."""
+    tok.t_sealed = time.perf_counter()
+
+
 def flushed(tok: Token, flush_start: float, flush_s: float,
             phases: dict | None = None) -> None:
     """The round carrying `tok` flushed: record queue_wait / flush /
@@ -214,7 +236,11 @@ def flushed(tok: Token, flush_start: float, flush_s: float,
     (pack / dispatch / device_wait — the attribution is the ROUND's, so
     every sampled op in the round reports the stage time it actually
     experienced). Then park the token awaiting its wire send."""
-    record_stage(tok.id, "queue_wait", flush_start - tok.t0)
+    if tok.t_sealed:
+        # deferred from sealed() — see its stamp-only contract
+        record_stage(tok.id, "buffer_wait", tok.t_sealed - tok.t0)
+    record_stage(tok.id, "queue_wait",
+                 flush_start - (tok.t_sealed or tok.t0))
     record_stage(tok.id, "flush", flush_s)
     for stage in ("pack", "dispatch", "device_wait"):
         v = (phases or {}).get(stage, 0.0)
@@ -381,7 +407,7 @@ def reset() -> None:
     configuration, not run state."""
     global _counter
     with _lock:
-        _counter = 0
+        _counter = itertools.count(1)
         _awaiting_wire.clear()
         _parked.clear()
         _stage_res.clear()

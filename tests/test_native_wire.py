@@ -155,3 +155,51 @@ class TestConcatColumns:
         a = changes_to_columns([Change("X", 1, {}, (
             Op("set", ROOT_ID, key="k", value=1),))])
         assert concat_columns([a]) is a
+
+    def test_small_and_numpy_paths_agree_column_for_column(self):
+        """concat_columns routes rounds <= _SMALL_CONCAT_OPS through the
+        pure-python merge and everything larger through the numpy
+        remap/union path. Both must produce IDENTICAL columns (values,
+        dtypes, string tables) for the same parts — this pins the numpy
+        path (every production-size coalesced round) against the small
+        path the other concat tests exercise."""
+        import numpy as np
+
+        import automerge_tpu.native.wire as wire
+        from automerge_tpu.core.change import Change, Op
+        from automerge_tpu.core.ids import ROOT_ID
+
+        parts = []
+        for w in range(4):
+            chs = []
+            for s in range(1, 4):
+                chs.append(Change(
+                    f"actor{w}", s, {f"actor{(w + 1) % 4}": 1} if s > 1
+                    else {},
+                    tuple(Op("set", ROOT_ID, key=f"k{(w + i) % 5}",
+                             value=v)
+                          for i, v in enumerate(
+                              (s, 1.5 * w, f"s{w % 2}", True, None))),
+                    f"m{w}" if s == 1 else None))
+            parts.append(wire.changes_to_columns(chs))
+        assert sum(len(p.op_action) for p in parts) <= wire._SMALL_CONCAT_OPS
+
+        small = wire._concat_columns_small(parts)
+        # force the numpy branch on the SAME parts
+        orig = wire._SMALL_CONCAT_OPS
+        wire._SMALL_CONCAT_OPS = 0
+        try:
+            big = wire.concat_columns(parts)
+        finally:
+            wire._SMALL_CONCAT_OPS = orig
+        assert small is not big
+        for f in ("change_actor", "change_seq", "change_msg", "deps_off",
+                  "deps_actor", "deps_seq", "op_off", "op_action",
+                  "op_obj", "op_key", "op_elem", "op_vtag", "op_vint",
+                  "op_vdbl", "op_vstr"):
+            s_col, b_col = getattr(small, f), getattr(big, f)
+            assert np.asarray(s_col).dtype == np.asarray(b_col).dtype, f
+            assert np.array_equal(np.asarray(s_col), np.asarray(b_col)), f
+        for f in ("actors", "objects", "keys", "messages", "strings"):
+            assert list(getattr(small, f)) == list(getattr(big, f)), f
+        assert small.to_changes() == big.to_changes()

@@ -6,7 +6,7 @@ import pytest
 
 import automerge_tpu as am
 from automerge_tpu import DocSet
-from automerge_tpu.sync.tcp import TcpSyncClient, TcpSyncServer
+from automerge_tpu.sync.tcp import TcpSyncClient, TcpSyncServer, sync_lock
 
 
 def wait_until(predicate, timeout=10.0, interval=0.02):
@@ -41,10 +41,16 @@ def test_bidirectional_concurrent_edits_converge(pair):
     ds_server.set_doc("doc1", am.merge(am.init("S"), base))
     assert wait_until(lambda: ds_client.get_doc("doc1") is not None)
 
-    ds_server.set_doc("doc1", am.change(
-        ds_server.get_doc("doc1"), lambda d: d.__setitem__("server", 1)))
-    ds_client.set_doc("doc1", am.change(
-        ds_client.get_doc("doc1"), lambda d: d.__setitem__("client", 2)))
+    # the documented app-thread contract (sync_lock docstring): hold the
+    # transport lock around a get -> change -> set read-modify-write, or
+    # the receive thread can advance the doc (and the connection's
+    # advertised clock) between the read and the write
+    with sync_lock(ds_server):
+        ds_server.set_doc("doc1", am.change(
+            ds_server.get_doc("doc1"), lambda d: d.__setitem__("server", 1)))
+    with sync_lock(ds_client):
+        ds_client.set_doc("doc1", am.change(
+            ds_client.get_doc("doc1"), lambda d: d.__setitem__("client", 2)))
 
     expected = {"v": 0, "server": 1, "client": 2}
     assert wait_until(lambda: ds_server.get_doc("doc1") == expected
@@ -135,3 +141,52 @@ def test_reconnect_catches_up_after_disconnect():
     finally:
         client2.close()
         server.close()
+
+
+def test_epoch_services_bidirectional_multiwriter_over_tcp():
+    """Two rows-backend EPOCH services syncing over real TCP while local
+    writer threads stream into both sides, sharing one doc. Regression
+    pin for the re-entrant notification drain: Connection.doc_changed's
+    clock read (clock_of) used to re-enter _drain_admitted, deliver a
+    LATER admission of the same doc first, and then trip the connection's
+    old-state guard with the outer frame's stale clock — killing the TCP
+    read thread, so the fleet silently stopped converging."""
+    import threading
+
+    import numpy as np
+
+    from automerge_tpu.core.change import Change, Op
+    from automerge_tpu.core.ids import ROOT_ID
+    from automerge_tpu.native.wire import changes_to_columns
+    from automerge_tpu.sync.service import EngineDocSet
+
+    a = EngineDocSet(backend="rows")
+    b = EngineDocSet(backend="rows")
+    server = TcpSyncServer(a).start()
+    client = TcpSyncClient(b, server.host, server.port).start()
+    try:
+        def edit(svc, actor, docs):
+            for s in range(1, 31):
+                for d in docs:
+                    svc.apply_columns(d, changes_to_columns([Change(
+                        actor=actor, seq=s, deps={},
+                        ops=[Op("set", ROOT_ID, key="k", value=s)])]))
+
+        ta = threading.Thread(target=edit, args=(a, "AA", ["s1", "s2"]))
+        tb = threading.Thread(target=edit, args=(b, "BB", ["s2", "s3"]))
+        ta.start(); tb.start(); ta.join(); tb.join()
+
+        def converged():
+            ha, hb = a.hashes(), b.hashes()
+            return (set(ha) == set(hb) == {"s1", "s2", "s3"}
+                    and all(np.uint32(ha[d]) == np.uint32(hb[d])
+                            for d in ha))
+
+        assert wait_until(converged, timeout=30.0, interval=0.1), \
+            f"no convergence: {a.hashes()} vs {b.hashes()}"
+        assert a.clock_of("s2") == b.clock_of("s2") == {"AA": 30, "BB": 30}
+    finally:
+        client.close()
+        server.close()
+        a.close()
+        b.close()
