@@ -36,6 +36,8 @@ from __future__ import annotations
 
 from typing import Any, Iterator
 
+import numpy as np
+
 from ..utils.persist import CowDict
 
 # Split threshold; chunks split into two halves of CHUNK each. 256 keeps
@@ -46,7 +48,7 @@ CHUNK = 256
 
 class ElemList:
     __slots__ = ("_ids", "_keys", "_vals", "_kmap", "_pos",
-                 "_cum", "_next_id", "_flat_k", "_flat_v")
+                 "_cum", "_next_id", "_flat_k", "_flat_v", "_owned")
 
     def __init__(self, keys: list[str] | None = None,
                  values: list[Any] | None = None):
@@ -60,6 +62,7 @@ class ElemList:
         self._flat_k: list[str] | None = None     # cached flat key list
         self._flat_v: list[Any] | None = None     # cached flat value list
         self._next_id = 0
+        self._owned = True               # top lists private to this instance
         if keys:
             values = values if values is not None else [None] * len(keys)
             kmap = self._kmap
@@ -101,46 +104,58 @@ class ElemList:
         out._flat_k = self._flat_k
         out._flat_v = self._flat_v
         out._next_id = self._next_id
+        # BOTH sides lose top-list ownership: the child shares the parent's
+        # lists until its first mutation, and the parent must no longer
+        # mutate them in place either (never happens under the builder's
+        # copy-before-mutate discipline, but keep the invariant airtight)
+        self._owned = False
+        out._owned = False
         return out
 
     def _own_top(self) -> None:
-        """Un-share the top-level lists before an in-place top mutation.
-        Chunks themselves are immutable tuples, never edited in place."""
+        """Un-share the top-level lists before an in-place top mutation
+        (once per copy: a batch of edits pays ONE three-list fork, not one
+        per edit). Chunks themselves are immutable tuples, never edited in
+        place."""
+        if self._owned:
+            return
         self._ids = list(self._ids)
         self._keys = list(self._keys)
         self._vals = list(self._vals)
+        self._owned = True
 
     # -- caches ------------------------------------------------------------
 
     def _ensure_caches(self) -> None:
+        # C-speed rebuilds: dict(zip) + numpy cumsum, not Python loops —
+        # interactive keystrokes patch `_cum` with vectorized shifts
+        # (keystroke latency must stay flat in document length: the old
+        # per-edit O(chunks) Python patch loop was the r8 flatness
+        # regression), and the span-merge plane interleaves queries with
+        # splices, so a long document rebuilds these once per placed span
         if self._pos is None:
-            self._pos = {cid: i for i, cid in enumerate(self._ids)}
+            self._pos = dict(zip(self._ids, range(len(self._ids))))
         if self._cum is None:
-            cum = []
-            total = 0
-            for ck in self._keys:
-                cum.append(total)
-                total += len(ck)
+            n = len(self._keys)
+            cum = np.zeros(n, np.int64)
+            if n > 1:
+                np.cumsum(np.fromiter(map(len, self._keys[:-1]),
+                                      np.int64, n - 1), out=cum[1:])
             self._cum = cum
 
     def _locate_rank(self, index: int) -> tuple[int, int]:
         """(top position, offset) of global rank `index`."""
         self._ensure_caches()
         cum = self._cum
-        lo, hi = 0, len(cum) - 1
-        while lo < hi:   # rightmost chunk with cum <= index
-            mid = (lo + hi + 1) // 2
-            if cum[mid] <= index:
-                lo = mid
-            else:
-                hi = mid - 1
-        return lo, index - cum[lo]
+        p = int(np.searchsorted(cum, index, side="right")) - 1
+        return p, index - int(cum[p])
 
     # -- queries -----------------------------------------------------------
 
     def __len__(self) -> int:
         if self._cum is not None:
-            return (self._cum[-1] + len(self._keys[-1])) if self._keys else 0
+            return (int(self._cum[-1]) + len(self._keys[-1])) \
+                if self._keys else 0
         return sum(len(ck) for ck in self._keys)
 
     def index_of(self, key: str) -> int:
@@ -156,7 +171,7 @@ class ElemList:
             off = self._keys[p].index(key)
         except ValueError:
             return -1
-        return self._cum[p] + off
+        return int(self._cum[p]) + off
 
     def key_of(self, index: int) -> str | None:
         """Element ID at `index`, or None if out of range."""
@@ -210,12 +225,12 @@ class ElemList:
             self._keys[p] = nk
             self._vals[p] = nv
             # common case: chunk set unchanged — shift the rank cache
-            # incrementally instead of invalidating (a keystroke would
-            # otherwise pay a full O(chunks) rebuild on its next read)
+            # with one vectorized add instead of invalidating (a
+            # keystroke must neither rebuild O(chunks) caches nor pay an
+            # O(chunks) Python patch loop: flat in document length)
             if self._cum is not None:
-                cum = self._cum = list(self._cum)
-                for i in range(p + 1, len(cum)):
-                    cum[i] += 1
+                cum = self._cum = self._cum.copy()
+                cum[p + 1:] += 1
         else:
             # split: left half keeps the id (most keys stay mapped),
             # right half gets a fresh id and remaps its keys
@@ -232,6 +247,99 @@ class ElemList:
         self._flat_k = None
         self._flat_v = None
 
+    def own_kmap(self) -> None:
+        """Force the key map into owned (plain-dict) mode: one O(n) base
+        fork now, dict-speed writes afterwards. The span-merge plane
+        (core/textspans.py) calls this before a write burst large enough
+        that per-key persistent-overlay updates would dominate the merge;
+        sharing-safe (the shared base is forked, never mutated)."""
+        self._kmap.rebase()
+
+    def splice_insert(self, index: int, keys: list[str],
+                      values: list[Any]) -> None:
+        """Insert len(keys) consecutive elements at `index` in ONE splice:
+        O(k + chunks) instead of k per-op insert_index calls at
+        O(CHUNK + chunks) each. This is the span-splice primitive of the
+        batched text-merge plane (core/textspans.py): the run lands as
+        freshly-built chunks between the two halves of the split chunk,
+        and only the SMALLER surviving half remaps its keys (the larger
+        half keeps the split chunk's id) — key-map writes per splice are
+        k + min(off, CHUNK - off), not k + CHUNK."""
+        k = len(keys)
+        if k == 0:
+            return
+        if k == 1:
+            self.insert_index(index, keys[0], values[0])
+            return
+        self._own_top()
+        if not self._keys:
+            p = 0
+            old_id = None
+            head_k = head_v = tail_k = tail_v = ()
+        else:
+            if index >= len(self):
+                p = len(self._keys) - 1
+                off = len(self._keys[p])
+            else:
+                p, off = self._locate_rank(index)
+            ck, cv = self._keys[p], self._vals[p]
+            old_id = self._ids[p]
+            head_k, head_v = ck[:off], cv[:off]
+            tail_k, tail_v = ck[off:], cv[off:]
+        new_ids, new_keys, new_vals = [], [], []
+
+        def piece(pk, pv, cid):
+            if not pk:
+                return
+            if cid is None:
+                cid = self._next_id
+                self._next_id += 1
+                for kk in pk:
+                    self._kset(kk, cid)
+            new_ids.append(cid)
+            new_keys.append(pk)
+            new_vals.append(pv)
+
+        # the larger surviving half keeps the split chunk's id
+        head_keeps = len(head_k) >= len(tail_k)
+        piece(head_k, head_v, old_id if head_keeps else None)
+        for lo in range(0, k, CHUNK):
+            cid = self._next_id
+            self._next_id += 1
+            nk = tuple(keys[lo:lo + CHUNK])
+            new_ids.append(cid)
+            new_keys.append(nk)
+            new_vals.append(tuple(values[lo:lo + CHUNK]))
+            for kk in nk:
+                self._kset(kk, cid)
+        piece(tail_k, tail_v, None if head_keeps else old_id)
+        had_chunks = bool(self._keys)
+        if had_chunks:
+            self._ids[p:p + 1] = new_ids
+            self._keys[p:p + 1] = new_keys
+            self._vals[p:p + 1] = new_vals
+        else:
+            self._ids, self._keys, self._vals = new_ids, new_keys, new_vals
+        # rank-cache maintenance: patch `_cum` with three vectorized
+        # segments instead of invalidating — the span plane alternates
+        # placement queries with splices, and a full O(chunks) rebuild
+        # per splice was the dominant merge cost at 1M characters.
+        # `_pos` genuinely changes for every chunk after p (the top list
+        # shifted), so it is rebuilt lazily at C speed by _ensure_caches.
+        if self._cum is not None and had_chunks:
+            m = len(new_ids)
+            sizes = np.fromiter(map(len, new_keys), np.int64, m)
+            mid = np.zeros(m, np.int64)
+            np.cumsum(sizes[:-1], out=mid[1:])
+            self._cum = np.concatenate(
+                [self._cum[:p], self._cum[p] + mid,
+                 self._cum[p + 1:] + k])
+        else:
+            self._cum = None
+        self._pos = None
+        self._flat_k = None
+        self._flat_v = None
+
     def remove_index(self, index: int) -> None:
         p, off = self._locate_rank(index)
         self._own_top()
@@ -242,9 +350,8 @@ class ElemList:
             self._keys[p] = nk
             self._vals[p] = cv[:off] + cv[off + 1:]
             if self._cum is not None:  # chunk set unchanged: shift ranks
-                cum = self._cum = list(self._cum)
-                for i in range(p + 1, len(cum)):
-                    cum[i] -= 1
+                cum = self._cum = self._cum.copy()
+                cum[p + 1:] -= 1
         else:
             del self._ids[p], self._keys[p], self._vals[p]
             self._pos = None

@@ -508,37 +508,52 @@ def apply_op(b: Builder, op: Op, emit: bool = True) -> list[dict]:
     raise ValueError(f"Unknown operation type {action}")
 
 
-def apply_change(b: Builder, change: Change, emit: bool = True) -> list[dict]:
-    """Apply one causally-ready change (op_set.js:224-252)."""
+def admit_change_header(b: Builder, change: Change) -> dict | None:
+    """The op-independent half of applying one causally-ready change:
+    duplicate-delivery check, transitive-clock computation, states/clock/
+    deps/history bookkeeping (op_set.js:224-241, 243-248). Returns the
+    change's full vector clock, or None for an idempotent re-delivery.
+    Shared by the per-op path below and the batched text-merge plane
+    (core/textspans.py), so both admit changes bit-identically."""
     actor, seq = change.actor, change.seq
     prior = b.states.get(actor, EMPTY_ALIST)
     if seq <= len(prior):
         if prior[seq - 1][0] != change:
             raise ValueError(f"Inconsistent reuse of sequence number {seq} by {actor}")
-        return []  # idempotent re-delivery
+        return None  # idempotent re-delivery
 
     base = dict(change.deps)
     base[actor] = seq - 1
     all_deps = transitive_deps(b, base)
     b.states[actor] = prior.append((change, all_deps))
-
-    diffs: list[dict] = []
-    for op in change.ops:
-        d = apply_op(b, op.stamped(actor, seq), emit)
-        if d:
-            diffs.extend(d)
-
     b.deps = {a: s for a, s in b.deps.items() if s > all_deps.get(a, 0)}
     b.deps[actor] = seq
     b.clock[actor] = seq
     b.history = b.history.append(change)
     metrics.bump("core_changes_applied")
     metrics.bump("core_ops_applied", len(change.ops))
-    metrics.bump("core_diffs_emitted", len(diffs))
     # op-lifecycle plane: a change that sat causally-unready in the
     # queue records its dependency-wait here (no-op unless it was parked
     # — one unlocked empty-table check on the common path)
     oplag.queue_admitted(actor, seq)
+    return all_deps
+
+
+def apply_change(b: Builder, change: Change, emit: bool = True) -> list[dict]:
+    """Apply one causally-ready change (op_set.js:224-252)."""
+    actor, seq = change.actor, change.seq
+    # ops apply against the PRE-admission states view only through the
+    # stamped clocks, which admit_change_header has already appended —
+    # exactly the order the reference applies them in (op_set.js:224-241)
+    if admit_change_header(b, change) is None:
+        return []  # idempotent re-delivery
+
+    diffs: list[dict] = []
+    for op in change.ops:
+        d = apply_op(b, op.stamped(actor, seq), emit)
+        if d:
+            diffs.extend(d)
+    metrics.bump("core_diffs_emitted", len(diffs))
     return diffs
 
 
@@ -639,15 +654,44 @@ class OpSet:
     def add_change(self, change: Change) -> tuple["OpSet", list[dict]]:
         return self.add_changes([change])
 
-    def add_changes(self, changes,
-                    emit_diffs: bool = True) -> tuple["OpSet", list[dict]]:
+    def add_changes(self, changes, emit_diffs: bool = True,
+                    text_batch: bool = False) -> tuple["OpSet", list[dict]]:
         """Queue + causally apply a batch of changes (op_set.js:294-297).
 
         emit_diffs=False is the from-scratch-load fast path: no edit
         records are produced (returns an empty diff list) and sequence
         index maintenance is deferred to ONE rebuild per touched list at
         the end of the batch. State is bit-identical to the emitting path
-        — pinned by tests/test_nodiff_apply.py."""
+        — pinned by tests/test_nodiff_apply.py.
+
+        text_batch=True offers the batch to the span-granularity text
+        merge plane (core/textspans.py) first: a large all-text batch is
+        admitted with visible-order maintenance at SPAN granularity (one
+        placement + splice per contiguous run instead of per op) and
+        returns ONE coarse diff per touched object ({"action": "batch"})
+        instead of per-op edits — callers that fold diffs per object
+        (frontend/materialize.update_cache) are unaffected; callers that
+        need per-op edit records must not opt in. State is bit-identical
+        to the per-op path (tests/test_textspans.py)."""
+        if text_batch and emit_diffs and not self.queue:
+            from .textspans import TEXT_BATCH_MIN_OPS, try_apply_text_batch
+            changes = list(changes)
+            # pre-thaw gate: a below-threshold batch (every interactive
+            # keystroke takes this path) must not pay a Builder
+            # construction just to be rejected by the scan
+            if sum(len(c.ops) for c in changes
+                   if isinstance(c, Change)) >= TEXT_BATCH_MIN_OPS:
+                b = self.thaw()
+                batch_diffs = try_apply_text_batch(b, changes)
+                if batch_diffs is not None:
+                    metrics.gauge("core_queue_depth", len(b.queue))
+                    metrics.gauge("core_queue_bytes",
+                                  sum(120 + 80 * len(c.ops)
+                                      for c in b.queue))
+                    return self.freeze(b), batch_diffs
+                # ineligible: fall through on a FRESH builder (the scan
+                # phase mutates nothing, but a clean thaw keeps that
+                # contract local)
         b = self.thaw()
         diffs: list[dict] = []
         for change in changes:

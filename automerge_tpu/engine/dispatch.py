@@ -42,6 +42,10 @@ _LINK = {
                                  # native JSON parse, but that is not the
                                  # path apply_host prices)
     "bulk_fixed_s": 0.001,
+    "span_op_s": 2.5e-7,         # host numpy span-merge per span (lexsort
+                                 # + cumsum + hash over packed lanes)
+    "span_fixed_s": 1e-4,        # host numpy span-merge per batch (fixed
+                                 # array setup)
 }
 
 
@@ -171,6 +175,35 @@ def plan_for(doc_changes: list, passes: int = 1) -> Plan:
         else:
             host += doc_ops * _LINK["host_op_s"]
     return Plan("device" if dev < host else "host", dev, host)
+
+
+def plan_spans(n_docs: int, s_pad: int, passes: int = 1) -> Plan:
+    """Backend plan for a batched span-table merge of `n_docs` documents
+    whose span axis padded to `s_pad` lanes (engine/span_kernels.py). The
+    wire is the packed [D, F, S_pad] block; the host alternative is the
+    numpy reference path."""
+    from .pack import SPAN_FIELDS
+
+    wire_bytes = n_docs * len(SPAN_FIELDS) * s_pad * 4
+    dev = _device_cost(wire_bytes, passes)
+    host = _LINK["span_fixed_s"] + n_docs * s_pad * _LINK["span_op_s"]
+    return Plan("device" if dev < host else "host", dev, host)
+
+
+def merge_spans_adaptive(doc_spans: list, passes: int = 1):
+    """Route a batched span-table merge through the cheaper backend.
+    Returns (plan, result dict) — result arrays are numpy on the host
+    path, device arrays on the device path (same schema)."""
+    from ..utils import metrics
+    from .pack import pack_spans
+    from .span_kernels import merge_spans, merge_spans_host
+
+    spans = pack_spans(doc_spans)
+    plan = plan_spans(spans.shape[0], spans.shape[2], passes)
+    metrics.bump("engine_span_merges", backend=plan.backend)
+    if plan.backend == "host":
+        return plan, merge_spans_host(spans)
+    return plan, merge_spans(spans)
 
 
 def _causal_order(changes):

@@ -223,6 +223,61 @@ def apply_rows_hash(rows, dims: tuple, n_docs: int, interpret: bool = False):
 
 
 # ---------------------------------------------------------------------------
+# Span-table lane layout (the batched text-merge plane's wire shape)
+#
+# A span table is the run-length-encoded form of a text document's visible
+# order: one row per maximal run of consecutively-numbered same-origin
+# elements (core/textspans.spans_of_elems), extended for merging with the
+# anchor/priority columns the merge-order kernel sorts by. Like the row
+# buffer above, the layout is lane-native: per document, one int32
+# [len(SPAN_FIELDS), S_pad] block with the SPAN axis minor (padded to the
+# TPU lane width), so a fleet of divergent documents merges as one
+# [D, F, S_pad] dispatch with zero relayouts.
+#
+# Merge-order encoding (engine/span_kernels.py sorts by it):
+#   slot       2*i for the i-th span of the base (common-history) table;
+#              2*g+1 for a concurrent span anchored in the gap after base
+#              span g (-1 for the head gap), so concurrent spans interleave
+#              between the base spans they were typed between;
+#   prio_elem/prio_actor  RGA sibling priority of the span's head element —
+#              concurrent spans in one gap order by (elem, actor)
+#              DESCENDING, the reference's sibling rule (op_set.js:343-362);
+#   block_seq  ascending tiebreak keeping a flattened subtree block (one
+#              side's nested spans in one gap) contiguous and in its
+#              side-local document order.
+
+SPAN_FIELDS = ("span_mask", "origin_hash", "start_id", "vis_len", "slot",
+               "prio_elem", "prio_actor", "block_seq")
+
+
+@perfscope.phased("pack")
+def pack_spans(doc_spans: list) -> np.ndarray:
+    """Pack per-document span tables into [D, len(SPAN_FIELDS), S_pad]
+    int32 lanes. Each span is an (origin_hash, start_id, vis_len, slot,
+    prio_elem, prio_actor, block_seq) tuple; the mask row is synthesized.
+    The span axis pads to the TPU lane width (pad_to_lanes) — padded slots
+    mask out and sort to the end inside the kernel."""
+    from ..utils import metrics
+
+    d = len(doc_spans)
+    s_max = max((len(sp) for sp in doc_spans), default=0)
+    s_pad = pad_to_lanes(max(s_max, 1))
+    out = np.zeros((d, len(SPAN_FIELDS), s_pad), np.int32)
+    for i, spans in enumerate(doc_spans):
+        if not spans:
+            continue
+        arr = np.asarray(spans, np.int64).T  # [7, s]
+        if arr.shape[0] != len(SPAN_FIELDS) - 1:
+            raise ValueError(
+                f"span tuples must have {len(SPAN_FIELDS) - 1} columns "
+                f"({SPAN_FIELDS[1:]}), got {arr.shape[0]}")
+        out[i, 0, :arr.shape[1]] = 1
+        out[i, 1:, :arr.shape[1]] = arr.astype(np.int32)
+    metrics.bump("engine_span_tables_packed", d)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Compact wire: dtype-narrowed row buffers
 #
 # The row buffer is all-int32 on device (the megakernel's native layout),

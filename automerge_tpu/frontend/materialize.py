@@ -133,7 +133,8 @@ def update_cache(opset: OpSet, diffs: list[dict], old_cache: dict) -> dict:
 
 
 def apply_changes_to_doc(doc, opset: OpSet, changes, incremental: bool,
-                         emit_diffs: bool = True):
+                         emit_diffs: bool = True,
+                         text_batch: bool | None = None):
     """The frontend's change-ingestion entry point (freeze_api.js:245-267):
     run changes through the CRDT core, then refresh the materialization.
     Dispatches on the document's frontend style (auto_api.js:34-38).
@@ -142,11 +143,22 @@ def apply_changes_to_doc(doc, opset: OpSet, changes, incremental: bool,
     stream has no consumer) takes the opset's no-diff fast path — the
     bench oracle deliberately keeps emit_diffs=True, because the
     reference's applyChanges cannot skip diff emission (its frontends
-    are diff-driven, op_set.js:105-129)."""
+    are diff-driven, op_set.js:105-129).
+
+    text_batch=None (the default) opts incremental ingestion into the
+    span-granularity text plane (core/textspans.py): large all-text
+    batches — the merge shape — are admitted with one splice per
+    contiguous run and one coarse diff per object, which is exactly what
+    update_cache folds; ineligible batches fall through to the per-op
+    path unchanged. Pass False to force the per-op path (the bench's
+    A/B baseline)."""
     if not emit_diffs and incremental:
         raise ValueError("emit_diffs=False requires incremental=False")
+    if text_batch is None:
+        text_batch = incremental
     with perfscope.phase("host_materialize"):
-        new_opset, diffs = opset.add_changes(changes, emit_diffs=emit_diffs)
+        new_opset, diffs = opset.add_changes(changes, emit_diffs=emit_diffs,
+                                             text_batch=text_batch)
         if getattr(doc._doc, "frontend", "frozen") == "immutable":
             # The immutable-view frontend re-instantiates from the opset
             # (the reference's ImmutableAPI likewise refreshes rather than

@@ -118,6 +118,31 @@ class Text(ArrayReadOps):
     def __hash__(self):
         return hash(("Text", self._values))
 
+    def spans(self):
+        """Run-length-encoded view of this text: (actor, start_elem,
+        length, text) tuples, one per maximal run of consecutively-
+        numbered same-origin characters in document order — the host form
+        of the engine's span-table lane layout (engine/pack.SPAN_FIELDS).
+        Reads go straight through the persistent element index (lazy view
+        path) without materializing per-character tuples, so a merged
+        100K-char document summarizes in O(spans)."""
+        from ..core.textspans import rle_runs
+
+        if self._elems is not None:
+            keys = self._elems.keys
+            vals = self._elems.values
+        else:
+            keys, vals = self.elem_ids, self._values
+        resolve = self._resolve
+        out = []
+        for (actor, start, length, at) in rle_runs(keys):
+            chunk = vals[at:at + length]
+            if resolve:
+                chunk = [resolve(v) for v in chunk]
+            out.append((actor, start, length,
+                        "".join(str(v) for v in chunk)))
+        return out
+
     def join(self, sep: str = "") -> str:
         return sep.join(str(v) for v in self._values)
 

@@ -91,6 +91,11 @@ DEFAULT_COMPILE_GROWTH_PCT = 50.0
 #: slack, which absorbs timer jitter on reads that are milliseconds).
 DEFAULT_HASH_GROWTH_PCT = 100.0
 HASH_ABS_SLACK_S = 0.25
+#: keystroke-flatness ceiling (config 7, r8): keystroke latency at 4x
+#: document length over 1x. The acceptance bar is 1.25; the GATE fails at
+#: a looser ceiling so one noisy slice on a busy 2-core container cannot
+#: red a healthy run (the recorded value is still the honest number).
+DEFAULT_FLATNESS_MAX = 1.5
 
 #: config-8 fields copied into the history record's `fleet` section
 FLEET_KEYS = ("fleet_hashes_s", "fleet_hashes_first_s",
@@ -166,7 +171,17 @@ def _norm_configs(raw) -> dict:
                                        "admission_vs_r6_single_writer_x",
                                        "service_lock_wait_reduction_x",
                                        "service_lock_wait_locked_s",
-                                       "service_lock_wait_epoch_s")
+                                       "service_lock_wait_epoch_s",
+                                       # the text span plane (r8): config
+                                       # 10's bulk-merge headline + A/B
+                                       # evidence, and config 7's measured
+                                       # length-flatness ratio
+                                       "merge_ops_per_s",
+                                       "merge_speedup_vs_perop",
+                                       "merge_speedup_vs_replay",
+                                       "span_merge_s", "perop_merge_s",
+                                       "ms_per_keystroke",
+                                       "keystroke_flatness")
                      if isinstance(v.get(k), (int, float, str))}
         elif isinstance(v, (int, float)):
             entry = {"speedup": v}
@@ -496,4 +511,55 @@ def check(path: str | None = None, record: dict | None = None,
                      f"{_x('admission_vs_r6_single_writer_x')}"
                      "); service-lock wait locked/epoch: "
                      f"{_x('service_lock_wait_reduction_x')}")
+
+    # bulk text-merge gate (r8, config 10): the span-plane merge
+    # throughput must hold against the same-backend same-host rolling
+    # median (raw ops/sec — host-class scoping applies exactly as for
+    # the headline gate). Skip-clean: runs without config 10, or with no
+    # comparable history, never fail.
+    def _tm(r: dict):
+        return ((r.get("configs") or {}).get("10") or {})
+
+    cur_tm = _tm(current).get("merge_ops_per_s")
+    prior_tm = [_tm(r).get("merge_ops_per_s")
+                for r in prior_pool
+                if (r.get("backend") or "none") == backend
+                and _host_ok(r)]
+    prior_tm = [x for x in prior_tm
+                if isinstance(x, (int, float)) and x > 0][-window:]
+    if isinstance(cur_tm, (int, float)) and cur_tm > 0 and prior_tm:
+        med_tm = statistics.median(prior_tm)
+        floor = 1.0 - threshold_pct / 100.0
+        ratio = cur_tm / med_tm
+        verdict = "OK" if ratio >= floor else "MERGE REGRESSION"
+        lines.append(
+            f"  text bulk merge (config 10): {cur_tm:.0f} ops/s vs "
+            f"rolling median {med_tm:.0f} (x{ratio:.2f}, floor "
+            f"x{floor:.2f}) -> {verdict}")
+        if ratio < floor:
+            rc = 1
+    elif isinstance(cur_tm, (int, float)) and cur_tm > 0:
+        lines.append(f"  text bulk merge (config 10): {cur_tm:.0f} ops/s "
+                     "(no prior merge telemetry — comparison starts "
+                     "next run)")
+    tm_spd = _tm(current).get("merge_speedup_vs_perop")
+    if isinstance(tm_spd, (int, float)):
+        lines.append(f"  merge span-plane vs per-op: x{tm_spd:.2f} "
+                     "(vs full replay: "
+                     f"x{_tm(current).get('merge_speedup_vs_replay', 0)})")
+
+    # keystroke-flatness gate (r8, config 7): latency at 4x document
+    # length over 1x must stay under the ceiling. A RATIO is
+    # host-normalized, so no host scoping applies; the ceiling is looser
+    # than the 1.25 acceptance bar to absorb single-slice jitter.
+    flat = (((current.get("configs") or {}).get("7") or {})
+            .get("keystroke_flatness"))
+    if isinstance(flat, (int, float)):
+        verdict = ("OK" if flat <= DEFAULT_FLATNESS_MAX
+                   else "FLATNESS REGRESSION")
+        lines.append(
+            f"  keystroke flatness (config 7, 4x/1x): x{flat:.3f} "
+            f"(ceiling x{DEFAULT_FLATNESS_MAX}) -> {verdict}")
+        if flat > DEFAULT_FLATNESS_MAX:
+            rc = 1
     return rc, lines

@@ -88,6 +88,26 @@ COUNTERS: dict[str, str] = {
     "core_ops_applied": "ops inside admitted changes (host apply paths)",
     "core_diffs_emitted": "diff records produced by the interpretive apply",
     "core_bulk_fallbacks": "bulk builds that fell back to interpretive",
+    # text span plane (core/textspans.py + engine/span_kernels.py):
+    # batched text merging — span splices instead of per-op RGA inserts
+    "sync_text_batches_merged":
+        "change batches admitted through the span-granularity text plane "
+        "(core/textspans.py)",
+    "sync_text_spans_spliced":
+        "contiguous element runs spliced into the visible-order index "
+        "(one splice per run, not per op)",
+    "sync_text_ops_sequential":
+        "text ops from changes covering the local frontier (no "
+        "concurrency checks paid)",
+    "sync_text_ops_concurrent":
+        "text ops replayed with per-pair concurrency checks (the only "
+        "ops whose cost scales with divergence)",
+    "engine_span_tables_packed":
+        "span tables packed into the [ROWS, S_pad] lane layout "
+        "(engine/pack.pack_spans)",
+    "engine_span_merges":
+        "batched span-table merge dispatches (engine/span_kernels.py) "
+        "{backend=host|device}",
     # engine — docs-major device engine + adaptive router
     "engine_docs_reconciled": "documents reconciled by the batched kernel",
     "engine_ops_reconciled": "ops reconciled by the batched kernel",

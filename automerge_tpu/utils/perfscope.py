@@ -75,6 +75,8 @@ PHASES: dict[str, str] = {
     "fleet_hashes": "fleet-wide convergence reads: the sharded hash "
                     "fan-out incl. per-shard dirty-lane reconciles "
                     "(sync/sharded_service.py)",
+    "span_merge": "span-granularity text-merge placement: run placement "
+                  "walks + ElemList splices (core/textspans.py)",
 }
 
 #: seconds between jax.live_arrays() footprint samples (the walk is

@@ -269,6 +269,18 @@ class CowDict:
     def _maybe_rebase(self) -> None:
         if len(self._over) <= max(512, len(self._base) // 4):
             return
+        self.rebase()
+
+    def rebase(self) -> None:
+        """Fold the overlay into a PRIVATE base fork now (O(n)) so
+        subsequent writes run at plain-dict speed. Sharing-safe: the old
+        base is forked, never mutated, so sibling snapshots are
+        unaffected. No-op when already owned. Callers with a large write
+        burst pending (the span-merge plane, core/textspans.py) invoke
+        this up front: one base fork beats thousands of persistent-overlay
+        updates."""
+        if not self._shared and not len(self._over):
+            return
         base = dict(self._base)
         for k, v in self._over.items():
             if v is _DELETED:

@@ -25,12 +25,22 @@ Modeled, with sources:
 - applyQueuedOps fixpoint queue scan (op_set.js:250-266)
 - clock/deps maintenance (op_set.js:243-248)
 
+Round 8 adds the piece VERDICT r5 weak #3 called out as missing: the
+reference's **skip-list element index** (skip_list.js) and the list/text
+half of its edit-record pipeline (updateListElement, op_set.js:131-158,
+incl. the getPrevious RGA walk op_set.js:336-397). Text and list ops now
+pay what v0.8.0 pays per op: persistent-map bookkeeping + an O(log n)
+indexed skip-list update + the closest-visible-predecessor walk — so
+configs 6/7 grade against the SHIPPED reference's architecture, not the
+2017 pre-skip-list frontend.
+
 DELIBERATELY OMITTED, each a real cost the reference pays that this model
 does not charge (so the model under-counts the reference):
 - the FreezeAPI frontend folding every diff into materialized snapshots
   with path-copying to the root (freeze_api.js:148-186)
 - undo-stack assembly per local change (auto_api.js:41-68)
-- skip-list index maintenance for list elements (skip_list.js)
+- the skip list's own Immutable.js path-copying (this model's skip list
+  is mutable: node splices are O(level), not O(level) map copies)
 - JSON wire parse of incoming changes
 - Immutable.js's per-access overhead for `op.get('…')` on EVERY field
   read (ops here are plain dicts read with native attribute access)
@@ -44,11 +54,171 @@ cannot measure; BASELINE.md states the resulting bounds.
 
 from __future__ import annotations
 
+import random
 import time
 
 from automerge_tpu.utils.persist import AList, PMap
 
 _E = PMap()
+
+HEAD = "_head"
+
+
+class _SkipNode:
+    __slots__ = ("key", "value", "level", "prev_key", "next_key",
+                 "prev_count", "next_count")
+
+    def __init__(self, key, value, level):
+        self.key = key
+        self.value = value
+        self.level = level
+        self.prev_key = [None] * level
+        self.next_key = [None] * level
+        self.prev_count = [0] * level
+        self.next_count = [None] * level
+
+
+class SkipList:
+    """The reference's indexed skip list (skip_list.js): doubly-linked
+    nodes at every level with per-link widths, so `index_of` (rank of a
+    key), `key_at` (key at rank) and `insert_after` are all O(log n)
+    expected. Level draws use a seeded RNG (p = 1/2, the classic Pugh
+    parameters skip_list.js uses) so oracle runs are reproducible."""
+
+    def __init__(self, seed: int = 0):
+        self._head = _SkipNode(None, None, 1)
+        self._head.next_count = [None]
+        self._nodes: dict = {}
+        self._rng = random.Random(seed)
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def __contains__(self, key) -> bool:
+        return key in self._nodes
+
+    def _node(self, key) -> _SkipNode:
+        return self._head if key is None else self._nodes[key]
+
+    def _random_level(self) -> int:
+        level = 1
+        while level < 32 and self._rng.random() < 0.5:
+            level += 1
+        return level
+
+    def index_of(self, key) -> int:
+        """Rank of `key` (0-based), or -1 when absent: climb left from the
+        node, accumulating link widths (skip_list.js indexOf)."""
+        node = self._nodes.get(key)
+        if node is None:
+            return -1
+        i = 0
+        cur = node
+        while cur is not self._head:
+            top = cur.level - 1
+            i += cur.prev_count[top]
+            cur = self._node(cur.prev_key[top])
+        return i - 1
+
+    def key_at(self, index: int):
+        """Key at rank `index` (top-down descent over link widths)."""
+        if not 0 <= index < len(self._nodes):
+            return None
+        cur, pos = self._head, -1
+        for lvl in range(self._head.level - 1, -1, -1):
+            while (cur.next_key[lvl] is not None
+                   and pos + cur.next_count[lvl] <= index):
+                pos += cur.next_count[lvl]
+                cur = self._nodes[cur.next_key[lvl]]
+            if pos == index:
+                return cur.key
+        return cur.key
+
+    def value_at(self, index: int):
+        return self._nodes[self.key_at(index)].value
+
+    def set_value(self, key, value) -> None:
+        self._nodes[key].value = value
+
+    def _pre_walk(self, start: _SkipNode, start_dist: int, level: int):
+        """(node, distance) of the last node of height > `level` at or
+        before `start`, where `start_dist` is start's distance to the
+        position being spliced."""
+        cur, d = start, start_dist
+        while cur.level < level + 1:
+            top = cur.level - 1
+            d += cur.prev_count[top]
+            cur = self._node(cur.prev_key[top])
+        return cur, d
+
+    def insert_after(self, pred_key, key, value) -> None:
+        """Insert `key` immediately after `pred_key` (None = head), the
+        skip_list.js insertAfter splice: per-level width maintenance up
+        the node's height, width increments on the spanning links above."""
+        if key in self._nodes:
+            raise KeyError(f"duplicate key {key}")
+        new_level = self._random_level()
+        if new_level > self._head.level:
+            for _ in range(self._head.level, new_level):
+                self._head.prev_key.append(None)
+                self._head.prev_count.append(0)
+                self._head.next_key.append(None)
+                self._head.next_count.append(None)
+            self._head.level = new_level
+        node = _SkipNode(key, value, new_level)
+        self._nodes[key] = node
+        cur, d = self._node(pred_key), 1
+        for lvl in range(new_level):
+            cur, d = self._pre_walk(cur, d, lvl)
+            nxt_key = cur.next_key[lvl]
+            node.prev_key[lvl] = cur.key
+            node.prev_count[lvl] = d
+            node.next_key[lvl] = nxt_key
+            cur.next_key[lvl] = key
+            if nxt_key is not None:
+                nxt = self._nodes[nxt_key]
+                node.next_count[lvl] = nxt.prev_count[lvl] - d + 1
+                nxt.prev_key[lvl] = key
+                nxt.prev_count[lvl] = node.next_count[lvl]
+            cur.next_count[lvl] = d
+        # widen the taller spanning links crossing the insertion point
+        for lvl in range(new_level, self._head.level):
+            cur, d = self._pre_walk(cur, d, lvl)
+            if cur.next_key[lvl] is not None:
+                cur.next_count[lvl] += 1
+                self._nodes[cur.next_key[lvl]].prev_count[lvl] += 1
+
+    def remove(self, key) -> None:
+        """Unsplice `key` (skip_list.js removeKey): per-level width merge
+        at the node's height, width decrements on spanning links above."""
+        node = self._nodes.pop(key)
+        for lvl in range(node.level):
+            pre = self._node(node.prev_key[lvl])
+            nxt_key = node.next_key[lvl]
+            pre.next_key[lvl] = nxt_key
+            if nxt_key is not None:
+                nxt = self._nodes[nxt_key]
+                merged = node.prev_count[lvl] + nxt.prev_count[lvl] - 1
+                pre.next_count[lvl] = merged
+                nxt.prev_key[lvl] = node.prev_key[lvl]
+                nxt.prev_count[lvl] = merged
+            else:
+                pre.next_count[lvl] = None
+        cur, d = self._node(node.prev_key[node.level - 1]), 0
+        for lvl in range(node.level, self._head.level):
+            cur, d = self._pre_walk(cur, d, lvl)
+            if cur.next_key[lvl] is not None:
+                cur.next_count[lvl] -= 1
+                self._nodes[cur.next_key[lvl]].prev_count[lvl] -= 1
+
+    def to_list(self) -> list:
+        """Values in order (model verification only — not a modeled cost)."""
+        out = []
+        cur = self._head
+        while cur.next_key[0] is not None:
+            cur = self._nodes[cur.next_key[0]]
+            out.append(cur.value)
+        return out
 
 
 def _pm(d: dict) -> PMap:
@@ -99,7 +269,8 @@ def _is_concurrent(opset: PMap, op1: dict, op2: dict) -> bool:
 
 
 def _get_path(opset: PMap, object_id: str):
-    # op_set.js:44-60 — walk _inbound links to the root
+    # op_set.js:44-60 — walk _inbound links to the root; a sequence
+    # parent contributes the child's index via the skip list's indexOf
     path = []
     by_object = opset.get("byObject")
     while object_id != ROOT:
@@ -108,11 +279,101 @@ def _get_path(opset: PMap, object_id: str):
             return None
         ref = next(iter(ref))
         object_id = ref["obj"]
-        if by_object.get(object_id).get("_init")["action"] == "makeList":
-            path.insert(0, ref.get("elem", 0))
+        parent = by_object.get(object_id)
+        init = parent.get("_init")  # the root has no _init and is a map
+        if init is not None and init["action"] in ("makeList", "makeText"):
+            path.insert(0, parent.get("_elemIds").index_of(ref["key"]))
         else:
             path.insert(0, ref["key"])
     return path
+
+
+def _get_parent(opset: PMap, object_id: str, key: str):
+    # op_set.js:336-341
+    if key == HEAD:
+        return None
+    ins = opset.get("byObject").get(object_id).get("_insertion").get(key)
+    if ins is None:
+        raise KeyError(key)
+    return ins["key"]
+
+
+def _insertions_after(opset: PMap, object_id: str, parent_id,
+                      child_id=None):
+    # op_set.js:351-362 — children in Lamport-descending (elem, actor)
+    child = None
+    if child_id is not None:
+        i = child_id.rindex(":")
+        child = (int(child_id[i + 1:]), child_id[:i])
+    obj = opset.get("byObject").get(object_id)
+    ops = [op for op in obj.get("_following", _E).get(
+        parent_id if parent_id is not None else HEAD, ())
+        if op["action"] == "ins"]
+    if child is not None:
+        ops = [op for op in ops if (op["elem"], op["actor"]) < child]
+    ops.sort(key=lambda op: (op["elem"], op["actor"]), reverse=True)
+    return [f"{op['actor']}:{op['elem']}" for op in ops]
+
+
+def _get_previous(opset: PMap, object_id: str, key: str):
+    # op_set.js:380-397 — predecessor in RGA document order
+    parent_id = _get_parent(opset, object_id, key)
+    children = _insertions_after(opset, object_id, parent_id)
+    if children and children[0] == key:
+        return None if (parent_id is None or parent_id == HEAD) \
+            else parent_id
+    prev_id = None
+    for child in children:
+        if child == key:
+            break
+        prev_id = child
+    while True:
+        children = _insertions_after(opset, object_id, prev_id)
+        if not children:
+            return prev_id
+        prev_id = children[-1]
+
+
+def _update_list_element(opset: PMap, object_id: str, elem_id: str):
+    # op_set.js:131-158 — the skip-list half of the edit pipeline: an
+    # indexed-order update per op (indexOf / insertAfter / removeKey all
+    # O(log n)) plus the closest-visible-predecessor walk on fresh inserts
+    obj = opset.get("byObject").get(object_id)
+    ops = obj.get(elem_id, ())
+    sl: SkipList = obj.get("_elemIds")
+    index = sl.index_of(elem_id)
+    edit = {"type": "list", "obj": object_id,
+            "path": _get_path(opset, object_id)}
+    if index >= 0:
+        if not ops:
+            sl.remove(elem_id)
+            edit.update(action="remove", index=index)
+        else:
+            sl.set_value(elem_id, ops[0].get("value"))
+            edit.update(action="set", index=index,
+                        value=ops[0].get("value"))
+            if len(ops) > 1:
+                edit["conflicts"] = [
+                    {"actor": o["actor"], "value": o.get("value")}
+                    for o in ops[1:]]
+        return opset, [edit]
+    if not ops:
+        return opset, []  # deleting an absent element is a no-op
+    # closest visible predecessor (op_set.js:146-156)
+    prev_id = elem_id
+    while True:
+        index = -1
+        prev_id = _get_previous(opset, object_id, prev_id)
+        if prev_id is None:
+            break
+        index = sl.index_of(prev_id)
+        if index >= 0:
+            break
+    sl.insert_after(prev_id if index >= 0 else None, elem_id,
+                    ops[0].get("value"))
+    edit.update(action="insert", index=index + 1,
+                value=ops[0].get("value"))
+    return opset, [edit]
 
 
 def _update_map_key(opset: PMap, object_id: str, key: str):
@@ -165,14 +426,17 @@ def _apply_assign(opset: PMap, op: dict):
                              reverse=True))
     opset = opset.set("byObject", opset.get("byObject").set(
         object_id, obj.set(op["key"], remaining)))
+    init = obj.get("_init")  # the root has no _init and is a map
+    if init is not None and init["action"] in ("makeList", "makeText"):
+        return _update_list_element(opset, object_id, op["key"])
     return _update_map_key(opset, object_id, op["key"])
 
 
 def _apply_make(opset: PMap, op: dict):
-    # op_set.js:63-78 (list bookkeeping modeled as empty maps, no skip list)
+    # op_set.js:63-78; sequence objects carry the indexed skip list
     obj = _pm({"_init": op, "_inbound": ()})
     if op["action"] in ("makeList", "makeText"):
-        obj = obj.set("_elemIds", None)
+        obj = obj.set("_elemIds", SkipList())
     opset = opset.set("byObject",
                       opset.get("byObject").set(op["obj"], obj))
     return opset, [{"action": "create", "obj": op["obj"]}]
@@ -273,3 +537,65 @@ def run_refmodel(doc_changes) -> float:
         opset = _init_opset()
         opset, _diffs = apply_changes(opset, changes)
     return time.perf_counter() - t0
+
+
+# ---------------------------------------------------------------------------
+# interactive keystrokes (bench config 7's oracle side)
+
+
+class _RawOp:
+    __slots__ = ("action", "obj", "key", "elem", "value")
+
+    def __init__(self, action, obj, key=None, elem=None, value=None):
+        self.action = action
+        self.obj = obj
+        self.key = key
+        self.elem = elem
+        self.value = value
+
+
+class _RawChange:
+    __slots__ = ("actor", "seq", "deps", "ops")
+
+    def __init__(self, actor, seq, deps, ops):
+        self.actor = actor
+        self.seq = seq
+        self.deps = deps
+        self.ops = ops
+
+
+def find_text_object(opset: PMap) -> str:
+    """Object id of the first makeText object (model verification)."""
+    for oid, obj in opset.get("byObject").items():
+        if oid != ROOT and obj.get("_init")["action"] == "makeText":
+            return oid
+    raise KeyError("no text object")
+
+
+def text_of(opset: PMap, object_id: str) -> str:
+    """Visible text via the skip list (model verification only)."""
+    sl = opset.get("byObject").get(object_id).get("_elemIds")
+    return "".join(str(v) for v in sl.to_list())
+
+
+def keystroke_change(opset: PMap, object_id: str, actor: str, seq: int,
+                     kind: str, pos: int, ch=None) -> _RawChange:
+    """One interactive keystroke as the reference frontend would issue it:
+    position -> element id through the skip list (key_at, O(log n)), then
+    an ins+set (or del) change ready for `apply_changes`. Build cost is
+    part of the per-keystroke pipeline and belongs inside the timed
+    region."""
+    obj = opset.get("byObject").get(object_id)
+    sl: SkipList = obj.get("_elemIds")
+    if kind == "ins":
+        parent = sl.key_at(pos - 1) if pos > 0 else HEAD
+        elem = obj.get("_maxElem", 0) + 1
+        eid = f"{actor}:{elem}"
+        ops = [_RawOp("ins", object_id, key=parent, elem=elem),
+               _RawOp("set", object_id, key=eid, value=ch)]
+    else:
+        ops = [_RawOp("del", object_id, key=sl.key_at(pos))]
+    # a local change depends on everything the frontend has seen — the
+    # current deps frontier, minus the writer itself (change format)
+    deps = {a: s for a, s in opset.get("deps").items() if a != actor}
+    return _RawChange(actor, seq, deps, ops)

@@ -342,3 +342,101 @@ def test_cli_report_renders_trajectory(tmp_path):
 def test_cli_rejects_unknown_command():
     out = _cli("frobnicate")
     assert out.returncode == 2
+
+
+# -- r8 gates: bulk text merge (config 10) + keystroke flatness (config 7) --
+
+
+def _mrec(value, merge_ops, source="test", host=None):
+    out = _rec(value, source=source,
+               configs={"10": {"merge_ops_per_s": merge_ops,
+                               "merge_speedup_vs_perop": 3.0,
+                               "merge_speedup_vs_replay": 40.0}})
+    if host is not None:
+        out["host"] = host
+    return out
+
+
+def test_merge_gate_passes_on_steady_throughput(tmp_path):
+    p = str(tmp_path / "h.jsonl")
+    _write(p, [_mrec(1000, 9000), _mrec(1000, 9500),
+               _mrec(1000, 9200, source="rerun")])
+    rc, lines = history.check(path=p)
+    assert rc == 0, lines
+    assert any("text bulk merge" in ln and "OK" in ln for ln in lines)
+
+
+def test_merge_gate_flags_regression(tmp_path):
+    p = str(tmp_path / "h.jsonl")
+    _write(p, [_mrec(1000, 9000), _mrec(1000, 9500),
+               _mrec(1000, 3000, source="regressed")])
+    rc, lines = history.check(path=p)
+    assert rc == 1
+    assert any("MERGE REGRESSION" in ln for ln in lines)
+
+
+def test_merge_gate_first_run_and_absent_config_skip_cleanly(tmp_path):
+    p = str(tmp_path / "h.jsonl")
+    # no prior config-10 history: informational line, rc 0
+    _write(p, [_rec(1000), _mrec(1000, 9000, source="first")])
+    rc, lines = history.check(path=p)
+    assert rc == 0, lines
+    assert any("comparison starts next run" in ln
+               for ln in lines if "merge" in ln)
+    # run without config 10 against merge-carrying history: no gate line
+    _write(p, [_mrec(1000, 9000), _rec(1000, source="no-cfg10")])
+    rc, lines = history.check(path=p)
+    assert rc == 0, lines
+    assert not any("text bulk merge" in ln for ln in lines)
+
+
+def test_merge_gate_is_host_scoped(tmp_path):
+    """A big-host record must not set the bar for a small-host run."""
+    p = str(tmp_path / "h.jsonl")
+    big = {"cpus": 32, "machine": "x86_64"}
+    small = {"cpus": 2, "machine": "x86_64"}
+    _write(p, [_mrec(1000, 90000, host=big), _mrec(1000, 90000, host=big),
+               _mrec(1000, 9000, source="small-host", host=small)])
+    rc, lines = history.check(path=p)
+    assert rc == 0, lines   # no same-host history -> skip, not fail
+
+
+def test_flatness_gate_ok_and_ceiling(tmp_path):
+    p = str(tmp_path / "h.jsonl")
+
+    def frec(flat, source="test"):
+        return _rec(1000, source=source,
+                    configs={"7": {"keystroke_flatness": flat,
+                                   "ms_per_keystroke": 0.3}})
+
+    _write(p, [frec(1.0), frec(1.1, source="ok")])
+    rc, lines = history.check(path=p)
+    assert rc == 0, lines
+    assert any("keystroke flatness" in ln and "OK" in ln for ln in lines)
+
+    _write(p, [frec(1.0), frec(1.8, source="regressed")])
+    rc, lines = history.check(path=p)
+    assert rc == 1
+    assert any("FLATNESS REGRESSION" in ln for ln in lines)
+
+    # records without config 7 never produce the line
+    _write(p, [frec(1.0), _rec(1000, source="no-cfg7")])
+    rc, lines = history.check(path=p)
+    assert rc == 0
+    assert not any("keystroke flatness" in ln for ln in lines)
+
+
+def test_norm_configs_carries_span_plane_fields():
+    rec = {"backend": "cpu", "value": 10, "configs": {
+        "7": {"speedup": 1.1, "ms_per_keystroke": 0.31,
+              "keystroke_flatness": 1.05},
+        "10": {"speedup": 40.0, "merge_ops_per_s": 9100,
+               "merge_speedup_vs_perop": 3.1,
+               "merge_speedup_vs_replay": 41.5,
+               "span_merge_s": 1.2, "perop_merge_s": 3.8}}}
+    out = history.record_from_bench(rec)
+    assert out["configs"]["7"]["keystroke_flatness"] == 1.05
+    assert out["configs"]["7"]["ms_per_keystroke"] == 0.31
+    assert out["configs"]["10"]["merge_ops_per_s"] == 9100
+    assert out["configs"]["10"]["merge_speedup_vs_perop"] == 3.1
+    assert out["configs"]["10"]["span_merge_s"] == 1.2
