@@ -35,7 +35,9 @@ perfcheck:
 
 # The bench-history trajectory + latest compile telemetry + the
 # contention & convergence-lag section (per-lock wait/hold, sampled
-# op-lag stages), human-readable.
+# op-lag stages) + the perf-doctor ranked root-cause post-mortem over
+# the last bench detail, human-readable.
 perfreport:
 	JAX_PLATFORMS=cpu python -m automerge_tpu.perf report
 	JAX_PLATFORMS=cpu python -m automerge_tpu.perf contention
+	JAX_PLATFORMS=cpu python -m automerge_tpu.perf doctor

@@ -1,12 +1,19 @@
 """automerge_tpu.perf — the performance plane's tooling package.
 
-`python -m automerge_tpu.perf {report,check,roofline,resident}`:
+`python -m automerge_tpu.perf {report,check,contention,doctor,top,
+roofline,resident}`:
 
 - `report`   — print the bench-history trajectory (`bench_history.jsonl`)
                plus the latest run's perf telemetry when available.
 - `check`    — the regression gate: current run vs the rolling
                same-backend median; nonzero exit on throughput regression
                or compile-count growth (history.py).
+- `doctor`   — ranked root-cause report (doctor.py): live against a
+               fleet, or post-mortem against BENCH_DETAIL.json /
+               flight-recorder dumps.
+- `top`      — live terminal dashboard over the fleet collector
+               (fleet.py: scrape over `{"metrics": "pull"}`, straggler
+               detection; slo.py: the SLO verdict strip).
 - `roofline` — HBM-roofline probe for the rows megakernel (the former
                repo-root `profile_roofline.py`, now packaged; the script
                remains as a thin shim).

@@ -1,6 +1,13 @@
 """CLI for the performance plane: `python -m automerge_tpu.perf
-{report,check,contention,roofline,resident}` (docs/OBSERVABILITY.md
-"Performance plane" / "Contention & convergence lag").
+{report,check,contention,doctor,top,roofline,resident}`
+(docs/OBSERVABILITY.md "Performance plane" / "Contention & convergence
+lag" / "Fleet health").
+
+- `doctor` — ranked root-cause report: live against a fleet
+  (--connect), or post-mortem against a BENCH_DETAIL.json / flight-
+  recorder dump (--post-mortem; default: the repo BENCH_DETAIL.json).
+- `top`    — live terminal dashboard (fleet table, SLO verdict strip,
+  sparklines) driven by the fleet collector (perf/fleet.py).
 
 Exit codes: 0 = ok (including a gracefully skipped check), 1 = the
 regression gate tripped, 2 = usage error.
@@ -155,6 +162,12 @@ def main(argv=None) -> int:
     cmd, rest = argv[0], argv[1:]
     if cmd in commands:
         return commands[cmd](rest)
+    if cmd == "doctor":
+        from . import doctor
+        return doctor.main(rest)
+    if cmd == "top":
+        from . import top
+        return top.main(rest)
     if cmd == "roofline":
         from . import roofline
         roofline.main(rest)
@@ -164,7 +177,8 @@ def main(argv=None) -> int:
         resident.main(rest)
         return 0
     print(f"unknown command {cmd!r}; expected one of "
-          "report, check, contention, roofline, resident", file=sys.stderr)
+          "report, check, contention, doctor, top, roofline, resident",
+          file=sys.stderr)
     return 2
 
 

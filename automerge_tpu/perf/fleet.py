@@ -1,0 +1,546 @@
+"""Fleet health collector: scrape, roll up, flag stragglers.
+
+Every instrument the repo grew in rounds 1-8 — labeled metrics, oplag
+stage percentiles, lock-holder tables, watchdog fires, flight-recorder
+dumps — is per-node and post-hoc: at 100K docs across a fleet, "is the
+fleet healthy RIGHT NOW, and which node/stage/lock is the cause" meant
+hand-joining JSON files. This module is the layer that scrapes and
+judges live:
+
+- **sources**: the local node directly (one `metrics.snapshot()` call —
+  the epoch-snapshot read plane makes this cheap and consistent), plus
+  any number of peers over the existing `{"metrics": "pull"}` wire op
+  (`add_peer(connection)`); the peer's answer names its node
+  (`metrics.node_name()` -> `Connection.peer_node`), so fleets self-label.
+- **time-series ring per node** (bounded, `ring` samples): counters
+  become rates across consecutive samples, span totals become per-round
+  means, oplag reservoir percentiles and gauges are sampled as-is.
+- **fleet rollups + straggler/skew detection**: every tick the collector
+  compares each node's signals (converge-stage p99, round-flush mean,
+  service-lock wait rate, frame-drop rate, retrace rate) against the
+  fleet median of its role group and flags any node whose positive
+  deviation reaches K "sigma". The deviation scale is a robust one —
+  1.4826·MAD with relative/absolute floors — because a 3-node fleet's
+  two healthy members have MAD 0 and a classic z-score would divide by
+  the outlier it is trying to flag. Exported as `obs_fleet_*` series and
+  `straggler_flagged` flight-recorder events.
+- **self-overhead accounting**: every tick's wall cost lands in
+  `obs_fleet_scrape_s`; the SLO engine (perf/slo.py) bounds it — a
+  health plane that degrades the fleet it watches fails its own check.
+
+Scrape protocol for wire peers: tick k harvests whatever answers arrived
+since tick k-1 (stamped at ARRIVAL on the transport reader thread), then
+issues the next pull — the collector never blocks on a slow peer, and a
+dead one simply goes stale (`obs_fleet_scrape_age_s` keeps growing,
+surfaced in `fleet_state()["nodes"][n]["stale"]`).
+
+`python -m automerge_tpu.perf top` renders this live; `perf doctor`
+turns a flagged straggler into a ranked root-cause report
+(docs/OBSERVABILITY.md "Fleet health").
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+import time
+from collections import deque
+
+from ..utils import flightrec, metrics
+
+log = logging.getLogger("automerge_tpu.fleet")
+
+#: default seconds between scrape ticks
+DEFAULT_INTERVAL_S = 1.0
+#: default per-node ring length (samples)
+DEFAULT_RING = 128
+#: default K: a node flags when its robust deviation score reaches K
+DEFAULT_K_SIGMA = 3.0
+#: minimum nodes in a role group before straggler comparison is
+#: meaningful (a 2-node "fleet" has no median to deviate from)
+MIN_NODES = 3
+
+#: signals compared across nodes for straggler detection, with the
+#: absolute scale floor per signal (units of the signal itself) — the
+#: floor keeps a uniform fleet (MAD 0) from flagging noise, and makes a
+#: genuinely deviant node score high even when the healthy members are
+#: bit-identical
+STRAGGLER_SIGNALS: dict[str, float] = {
+    "converge_p99_s": 0.05,
+    "round_flush_mean_s": 0.01,
+    "lock_wait_rate": 0.05,
+    "drop_rate": 0.2,
+    "retrace_rate": 0.5,
+}
+#: relative floor on the deviation scale (fraction of |median|)
+REL_FLOOR = 0.25
+
+_SERVICE_WAIT_RE = re.compile(
+    r"^sync_lock_wait_s\{lock=service[^}]*\}_sum$")
+_SERVICE_HOLD_RE = re.compile(
+    r"^sync_lock_hold_s\{lock=service[^}]*\}_sum$")
+
+
+def collapse(snapshot: dict, prefix: str, suffix: str = "") -> float:
+    """Sum `prefix<suffix>` plus every labeled `prefix{...}<suffix>`
+    series in a flat snapshot (handles spans' `_s`/`_count` suffixes,
+    which sit OUTSIDE the label braces)."""
+    total = 0.0
+    exact = prefix + suffix
+    for k, v in snapshot.items():
+        if not isinstance(v, (int, float)):
+            continue
+        if k == exact or (k.startswith(prefix + "{")
+                          and k.endswith(suffix) and "}" in k):
+            total += v
+    return total
+
+
+def _stage_p99(snapshot: dict, stage: str) -> float | None:
+    """A stage's p99 from the nested oplag section, falling back to the
+    exported gauge. None when the node never recorded the stage."""
+    stages = ((snapshot.get("oplag") or {}).get("stages") or {})
+    st = stages.get(stage)
+    if isinstance(st, dict) and isinstance(st.get("p99_s"), (int, float)):
+        return float(st["p99_s"])
+    g = snapshot.get("sync_op_lag_p99_s{stage=%s}" % stage)
+    return float(g) if isinstance(g, (int, float)) else None
+
+
+def extract_features(snapshot: dict) -> dict:
+    """One node snapshot -> the flat feature dict the ring stores.
+    `_CUMULATIVE` keys are monotonic counters/totals (turned into rates
+    by NodeState); the rest are instantaneous samples."""
+    out = {
+        # cumulative
+        "ops_ingested": collapse(snapshot, "sync_ops_ingested"),
+        "rounds_flushed": collapse(snapshot, "sync_rounds_flushed"),
+        "round_flush_total_s": collapse(snapshot, "sync_round_flush", "_s"),
+        "round_flush_count": collapse(snapshot, "sync_round_flush",
+                                      "_count"),
+        "frames_dropped": collapse(snapshot, "sync_frames_dropped"),
+        "watchdog_fires": collapse(snapshot, "obs_watchdog_fired"),
+        "retraced": collapse(snapshot, "engine_kernels_retraced"),
+        "dispatched": collapse(snapshot, "engine_kernels_dispatched"),
+        "lock_wait_s": 0.0,
+        "lock_hold_s": 0.0,
+    }
+    for k, v in snapshot.items():
+        if not isinstance(v, (int, float)):
+            continue
+        if _SERVICE_WAIT_RE.match(k):
+            out["lock_wait_s"] += v
+        elif _SERVICE_HOLD_RE.match(k):
+            out["lock_hold_s"] += v
+    # instantaneous
+    for stage, key in (("converge", "converge_p99_s"),
+                       ("flush", "flush_p99_s"),
+                       ("queue_wait", "queue_wait_p99_s"),
+                       ("peer_apply", "peer_apply_p99_s")):
+        v = _stage_p99(snapshot, stage)
+        if v is not None:
+            out[key] = v
+    return out
+
+
+_CUMULATIVE = ("ops_ingested", "rounds_flushed", "round_flush_total_s",
+               "round_flush_count", "frames_dropped", "watchdog_fires",
+               "retraced", "dispatched", "lock_wait_s", "lock_hold_s")
+
+
+class NodeState:
+    """One scraped node: bounded sample ring + the derived view."""
+
+    def __init__(self, name: str, role: str = "node", ring: int = DEFAULT_RING):
+        self.name = name
+        self.role = role
+        self.samples: deque = deque(maxlen=max(2, ring))
+        self.last_snapshot: dict | None = None
+        self.last_at: float | None = None
+        self.straggler_since: float | None = None
+        self.straggler_signal: str | None = None
+
+    def add_sample(self, t: float, snapshot: dict) -> dict:
+        """Fold one snapshot in; returns the derived dict (rates over the
+        previous sample, instantaneous values as-is)."""
+        feats = extract_features(snapshot)
+        prev = self.samples[-1] if self.samples else None
+        derived = dict(feats)
+        if prev is not None:
+            dt = max(t - prev["t"], 1e-6)
+            pf = prev["features"]
+            for k in _CUMULATIVE:
+                # clamped at 0: cumulative counters only go backwards
+                # when the node's registry reset (process restart,
+                # metrics.reset) — that is a quiet tick, not a negative
+                # rate spiking the rollups and sparklines
+                derived[k + "_delta"] = max(0.0, feats[k] - pf.get(k, 0.0))
+            derived["ops_per_s"] = derived["ops_ingested_delta"] / dt
+            derived["lock_wait_rate"] = derived["lock_wait_s_delta"] / dt
+            derived["lock_hold_rate"] = derived["lock_hold_s_delta"] / dt
+            derived["drop_rate"] = derived["frames_dropped_delta"] / dt
+            derived["retrace_rate"] = derived["retraced_delta"] / dt
+            n = derived["round_flush_count_delta"]
+            derived["round_flush_mean_s"] = (
+                derived["round_flush_total_s_delta"] / n if n > 0 else 0.0)
+        self.samples.append({"t": t, "features": feats, "derived": derived})
+        self.last_snapshot = snapshot
+        self.last_at = t
+        return derived
+
+    def latest(self) -> dict | None:
+        return self.samples[-1]["derived"] if self.samples else None
+
+    def series(self, key: str) -> list[tuple[float, float]]:
+        """(t, value) points of one derived signal, oldest first (the
+        `perf top` sparkline feed)."""
+        out = []
+        for s in self.samples:
+            v = s["derived"].get(key)
+            if isinstance(v, (int, float)):
+                out.append((s["t"], float(v)))
+        return out
+
+
+def cost_percentiles(costs) -> tuple[float | None, float | None]:
+    """(p50, p99) over a scrape-cost sample, (None, None) when empty.
+    ONE definition shared by scrape_stats (what the collector_overhead
+    SLO judges) and bench config 11 (what the perf-history scrape gate
+    enforces) — the two numbers must never diverge."""
+    c = sorted(costs)
+    if not c:
+        return None, None
+    return (round(c[len(c) // 2], 6),
+            round(c[min(len(c) - 1, int(0.99 * (len(c) - 1)))], 6))
+
+
+def robust_scores(values: dict[str, float], abs_floor: float,
+                  rel_floor: float = REL_FLOOR) -> dict[str, float]:
+    """Positive robust deviation score per node vs the group median:
+    (x - median) / max(1.4826*MAD, rel_floor*|median|, abs_floor),
+    clamped at 0 (a FAST node is not a straggler). The MAD scale keeps
+    one huge outlier from inflating its own yardstick the way a plain
+    standard deviation would; the floors keep a uniform group (MAD 0)
+    from dividing by zero."""
+    if len(values) < 2:
+        return {n: 0.0 for n in values}
+    vals = sorted(values.values())
+    mid = len(vals) // 2
+    med = (vals[mid] if len(vals) % 2
+           else 0.5 * (vals[mid - 1] + vals[mid]))
+    devs = sorted(abs(v - med) for v in vals)
+    mad = (devs[mid] if len(devs) % 2
+           else 0.5 * (devs[mid - 1] + devs[mid]))
+    scale = max(1.4826 * mad, rel_floor * abs(med), abs_floor, 1e-9)
+    return {n: max(0.0, (v - med) / scale) for n, v in values.items()}
+
+
+class FleetCollector:
+    """Background scraper + rollup engine over local/wire sources."""
+
+    def __init__(self, interval_s: float = DEFAULT_INTERVAL_S,
+                 ring: int = DEFAULT_RING,
+                 k_sigma: float = DEFAULT_K_SIGMA,
+                 min_nodes: int = MIN_NODES,
+                 slo_engine=None):
+        self.interval_s = interval_s
+        self.ring = ring
+        self.k_sigma = k_sigma
+        self.min_nodes = min_nodes
+        self.slo_engine = slo_engine
+        self.nodes: dict[str, NodeState] = {}
+        self._locals: list[tuple[str, object]] = []   # (name, snapshot_fn)
+        self._wires: list[dict] = []                  # peer records
+        self._inbox_lock = threading.Lock()
+        self._scrape_costs: deque = deque(maxlen=256)
+        self.ticks = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- sources -------------------------------------------------------------
+
+    def add_local(self, name: str = "local", snapshot_fn=None,
+                  role: str = "node") -> NodeState:
+        """Scrape this process directly: `snapshot_fn()` (default the
+        global `metrics.snapshot`) runs on the collector thread each
+        tick."""
+        fn = snapshot_fn or metrics.snapshot
+        self._locals.append((name, fn))
+        return self._node(name, role)
+
+    def add_peer(self, connection, name: str | None = None,
+                 role: str = "node") -> None:
+        """Scrape a peer over its Connection via `{"metrics": "pull"}`.
+        The node is named by the peer's self-reported label when its
+        first answer arrives (Connection.peer_node), falling back to
+        `name`/`peer<k>`. Issues the first pull immediately."""
+        rec = {"conn": connection,
+               "name": name or f"peer{len(self._wires)}",
+               "role": role, "inbox": []}
+
+        def _arrived(snapshot, rec=rec):
+            with self._inbox_lock:
+                rec["inbox"].append((time.time(), snapshot))
+
+        connection.on_peer_metrics = _arrived
+        self._wires.append(rec)
+        try:
+            connection.request_metrics()
+        except Exception:
+            pass    # a dead transport just leaves the node stale
+
+    def _node(self, name: str, role: str) -> NodeState:
+        st = self.nodes.get(name)
+        if st is None:
+            st = self.nodes[name] = NodeState(name, role=role,
+                                              ring=self.ring)
+        return st
+
+    # -- the tick ------------------------------------------------------------
+
+    def scrape_once(self) -> dict:
+        """One scrape tick: sample local sources, harvest wire arrivals,
+        re-issue pulls, recompute stragglers + rollups, export the
+        obs_fleet_* series, and (when attached) evaluate the SLOs.
+        Returns fleet_state()."""
+        t0 = time.perf_counter()
+        now = time.time()
+        for name, fn in self._locals:
+            try:
+                snap = fn()
+            except Exception:
+                continue
+            st = self.nodes[name]
+            if isinstance(snap, dict):
+                st.add_sample(now, snap)
+        for rec in self._wires:
+            with self._inbox_lock:
+                arrivals, rec["inbox"] = rec["inbox"], []
+            conn = rec["conn"]
+            node_label = getattr(conn, "peer_node", None)
+            if node_label and node_label != rec["name"]:
+                # adopt the peer's self-reported label, migrating off the
+                # positional placeholder as long as nothing was recorded
+                # under it (the label arrives with the FIRST answer, so
+                # in practice the placeholder is always empty) — UNLESS
+                # another source already owns the label: two peers
+                # launched with the same AMTPU_NODE_NAME must not merge
+                # into one ring (interleaved registries make garbage
+                # rates), so the collision keeps its positional name and
+                # the misconfig is surfaced instead of hidden
+                taken = (any(r is not rec and r["name"] == node_label
+                             for r in self._wires)
+                         or any(n == node_label for n, _ in self._locals))
+                if taken:
+                    if not rec.get("collision_warned"):
+                        rec["collision_warned"] = True
+                        log.warning(
+                            "fleet collector: peer self-reports node "
+                            "label %r already owned by another source; "
+                            "keeping positional name %r (duplicate "
+                            "AMTPU_NODE_NAME?)", node_label, rec["name"])
+                else:
+                    placeholder = self.nodes.get(rec["name"])
+                    if placeholder is None or not placeholder.samples:
+                        self.nodes.pop(rec["name"], None)
+                        rec["name"] = node_label
+            st = self._node(rec["name"], rec["role"])
+            for (at, snap) in arrivals:
+                if isinstance(snap, dict):
+                    st.add_sample(at, snap)
+            try:
+                conn.request_metrics()
+            except Exception:
+                pass
+        self.ticks += 1
+        state = self._judge(now)
+        dt = time.perf_counter() - t0
+        self._scrape_costs.append(dt)
+        metrics.observe("obs_fleet_scrape_s", dt)
+        flightrec.record("fleet_scrape", nodes=len(self.nodes),
+                         fresh=state["rollup"]["nodes_fresh"],
+                         stragglers=len(state["stragglers"]),
+                         s=round(dt, 6))
+        if self.slo_engine is not None:
+            try:
+                self.slo_engine.evaluate(self)
+            except Exception:
+                pass    # a broken SLO spec must not kill the scraper
+        return state
+
+    def _judge(self, now: float) -> dict:
+        """Recompute straggler scores + fleet rollups from the latest
+        derived sample of every FRESH node, and export the gauges. A
+        stale node (no snapshot for 3 ticks — dead peer, wedged
+        transport) is excluded from scoring and rollups entirely: its
+        frozen last rates would otherwise keep it flagged (and keep
+        inflating the fleet ops/s) forever; it stays in the table with
+        the stale marker and a growing scrape age."""
+        stale_after = 3.0 * max(self.interval_s, 0.1)
+
+        def _fresh(st: NodeState) -> bool:
+            return st.last_at is not None and now - st.last_at <= stale_after
+
+        latest = {n: (st.latest() if _fresh(st) else None)
+                  for n, st in self.nodes.items()}
+        scores: dict[str, tuple[float, str | None]] = {
+            n: (0.0, None) for n in self.nodes}
+        roles: dict[str, list[str]] = {}
+        for n, st in self.nodes.items():
+            roles.setdefault(st.role, []).append(n)
+        for role, members in roles.items():
+            if len(members) < self.min_nodes:
+                continue
+            for signal, floor in STRAGGLER_SIGNALS.items():
+                vals = {n: latest[n].get(signal)
+                        for n in members if latest[n] is not None}
+                vals = {n: float(v) for n, v in vals.items()
+                        if isinstance(v, (int, float))}
+                if len(vals) < self.min_nodes:
+                    continue
+                for n, z in robust_scores(vals, floor).items():
+                    if z > scores[n][0]:
+                        scores[n] = (z, signal)
+        stragglers = []
+        for n, st in self.nodes.items():
+            z, signal = scores[n]
+            flagged = z >= self.k_sigma
+            if flagged:
+                stragglers.append(n)
+                if st.straggler_since is None:
+                    st.straggler_since = now
+                    metrics.bump("obs_fleet_stragglers_flagged", node=n)
+                    flightrec.record("straggler_flagged", node=n,
+                                     signal=signal, score=round(z, 2))
+                st.straggler_signal = signal
+            else:
+                st.straggler_since = None
+                st.straggler_signal = None
+            metrics.gauge("obs_fleet_straggler_score", round(z, 3), node=n)
+            if st.last_at is not None:
+                metrics.gauge("obs_fleet_scrape_age_s",
+                              round(now - st.last_at, 3), node=n)
+            d = latest[n] or {}
+            if isinstance(d.get("converge_p99_s"), (int, float)):
+                metrics.gauge("obs_fleet_converge_p99_s",
+                              round(d["converge_p99_s"], 6), node=n)
+            if isinstance(d.get("round_flush_mean_s"), (int, float)):
+                metrics.gauge("obs_fleet_round_flush_s",
+                              round(d["round_flush_mean_s"], 6), node=n)
+        fresh = sum(1 for st in self.nodes.values() if _fresh(st))
+        metrics.gauge("obs_fleet_nodes_scraped", fresh)
+
+        def _agg(key, how):
+            vals = [d[key] for d in latest.values()
+                    if d is not None and isinstance(d.get(key),
+                                                    (int, float))]
+            if not vals:
+                return None
+            if how == "sum":
+                return round(sum(vals), 6)
+            if how == "max":
+                return round(max(vals), 6)
+            vals.sort()
+            return round(vals[len(vals) // 2], 6)
+
+        rollup = {
+            "nodes": len(self.nodes),
+            "nodes_fresh": fresh,
+            "ops_per_s": _agg("ops_per_s", "sum"),
+            "converge_p99_s": _agg("converge_p99_s", "max"),
+            "round_flush_mean_s": _agg("round_flush_mean_s", "median"),
+            "frames_dropped": _agg("frames_dropped", "sum"),
+            "watchdog_fires": _agg("watchdog_fires", "sum"),
+            "retraced": _agg("retraced", "sum"),
+        }
+        self._last_state = {
+            "at": now,
+            "rollup": rollup,
+            "stragglers": stragglers,
+            "nodes": {
+                n: {
+                    "role": st.role,
+                    "age_s": (round(now - st.last_at, 3)
+                              if st.last_at is not None else None),
+                    "stale": not _fresh(st),
+                    "straggler_score": round(scores[n][0], 3),
+                    "straggler_signal": st.straggler_signal,
+                    "flagged": n in stragglers,
+                    "derived": latest[n],
+                } for n, st in self.nodes.items()},
+            "scrape": self.scrape_stats(),
+        }
+        return self._last_state
+
+    # -- read surface ---------------------------------------------------------
+
+    def fleet_state(self) -> dict:
+        """The latest judged fleet view (computed by scrape_once)."""
+        return getattr(self, "_last_state", None) or self._judge(time.time())
+
+    def stragglers(self) -> list[str]:
+        return list(self.fleet_state()["stragglers"])
+
+    def scrape_costs(self) -> list[float]:
+        """Per-tick scrape wall costs (bounded window, oldest first) —
+        the raw feed bench config 11 aggregates across sub-runs."""
+        return list(self._scrape_costs)
+
+    def scrape_stats(self) -> dict:
+        p50, p99 = cost_percentiles(self._scrape_costs)
+        return {"ticks": self.ticks, "p50_s": p50, "p99_s": p99}
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "FleetCollector":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="amtpu-fleet-collector",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop and join the scrape thread (idempotent)."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.scrape_once()
+            except Exception:
+                import logging
+                logging.getLogger("automerge_tpu.fleet").exception(
+                    "fleet scrape tick failed")
+
+
+def connect_sources(addrs: list[str], wire: str = "json"):
+    """CLI helper (`perf top --connect`, `perf doctor --connect`): open a
+    throwaway TcpSyncClient per `host:port`, return ([(name, connection),
+    ...], close_fn). The client's empty DocSet syncs nothing; the
+    connection exists to carry metrics pulls."""
+    from ..sync.docset import DocSet
+    from ..sync.tcp import TcpSyncClient
+
+    clients = []
+    conns = []
+    for addr in addrs:
+        host, _, port = addr.rpartition(":")
+        cli = TcpSyncClient(DocSet(), host or "127.0.0.1", int(port),
+                            wire=wire).start()
+        clients.append(cli)
+        conns.append((addr, cli.peer.connection))
+
+    def close():
+        for c in clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+    return conns, close

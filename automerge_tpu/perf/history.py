@@ -97,6 +97,14 @@ HASH_ABS_SLACK_S = 0.25
 #: red a healthy run (the recorded value is still the honest number).
 DEFAULT_FLATNESS_MAX = 1.5
 
+#: fleet-health collector gate (r9, config 11): the collector's own
+#: scrape tick p50 must stay under this ABSOLUTE budget — a health plane
+#: whose scrape cost creeps up is quietly taxing every node it watches.
+#: Absolute (not median-relative): scrape cost is a property of the
+#: collector code, not the workload, and the bound mirrors the
+#: collector_overhead SLO default (perf/slo.py DEFAULT_SCRAPE_P50_S).
+SCRAPE_BUDGET_S = 0.25
+
 #: config-8 fields copied into the history record's `fleet` section
 FLEET_KEYS = ("fleet_hashes_s", "fleet_hashes_first_s",
               "fleet_hashes_clean_shards", "fleet_hashes_dirty_shards",
@@ -181,7 +189,18 @@ def _norm_configs(raw) -> dict:
                                        "merge_speedup_vs_replay",
                                        "span_merge_s", "perop_merge_s",
                                        "ms_per_keystroke",
-                                       "keystroke_flatness")
+                                       "keystroke_flatness",
+                                       # the fleet health plane (r9,
+                                       # config 11): collector scrape
+                                       # cost + overhead A/B + how many
+                                       # injected fault classes the
+                                       # doctor attributed correctly
+                                       "scrape_p50_s", "scrape_p99_s",
+                                       "collector_overhead_pct",
+                                       "collector_duty_cycle_pct",
+                                       "round_overhead_pct",
+                                       "hashes_overhead_pct",
+                                       "faults_attributed")
                      if isinstance(v.get(k), (int, float, str))}
         elif isinstance(v, (int, float)):
             entry = {"speedup": v}
@@ -547,6 +566,29 @@ def check(path: str | None = None, record: dict | None = None,
         lines.append(f"  merge span-plane vs per-op: x{tm_spd:.2f} "
                      "(vs full replay: "
                      f"x{_tm(current).get('merge_speedup_vs_replay', 0)})")
+
+    # fleet-health collector gate (r9, config 11): the collector's own
+    # scrape tick p50 must stay under the ABSOLUTE budget (SCRAPE_BUDGET_S
+    # — absolute because scrape cost is a property of the collector code,
+    # not the workload). Skip-clean: runs without config 11 never fail.
+    def _fh(r: dict):
+        return ((r.get("configs") or {}).get("11") or {})
+
+    cur_sp = _fh(current).get("scrape_p50_s")
+    if isinstance(cur_sp, (int, float)):
+        verdict = "OK" if cur_sp <= SCRAPE_BUDGET_S else "SCRAPE OVER BUDGET"
+        lines.append(
+            f"  fleet-health scrape p50 (config 11): {cur_sp:.4f}s "
+            f"(budget <= {SCRAPE_BUDGET_S}s) -> {verdict}")
+        if cur_sp > SCRAPE_BUDGET_S:
+            rc = 1
+        att = _fh(current).get("faults_attributed")
+        ovh = _fh(current).get("collector_overhead_pct")
+        if att is not None or ovh is not None:
+            lines.append(
+                f"  fleet-health: {att if att is not None else '?'}/3 "
+                "fault classes attributed; collector duty-cycle bound "
+                f"{ovh if ovh is not None else '?'}%")
 
     # keystroke-flatness gate (r8, config 7): latency at 4x document
     # length over 1x must stay under the ceiling. A RATIO is

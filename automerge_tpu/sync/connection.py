@@ -49,8 +49,14 @@ class Connection:
         self._wire = wire
         self._their_clock: dict[str, dict[str, int]] = {}
         self._our_clock: dict[str, dict[str, int]] = {}
-        # last metrics snapshot the peer answered with (request_metrics)
+        # last metrics snapshot the peer answered with (request_metrics),
+        # its arrival wall time, and the peer's self-reported node label
+        # (metrics.node_name() on the serving side) — the fleet collector
+        # (perf/fleet.py) names scraped peers from peer_node instead of
+        # guessing from connection order
         self.peer_metrics: dict | None = None
+        self.peer_metrics_at: float | None = None
+        self.peer_node: str | None = None
         self.on_peer_metrics: Callable[[dict], None] | None = None
         # last span ring the peer shipped (request_metrics(spans=True)) —
         # merge with the local one via metrics.merge_timeline
@@ -202,11 +208,18 @@ class Connection:
         if kind == "pull":
             metrics.bump("sync_metrics_pulls")
             resp = {"metrics": "snapshot", "snapshot": metrics.snapshot()}
+            node = metrics.node_name()
+            if node is not None:
+                resp["node"] = node
             if msg.get("spans"):
                 resp["spans"] = metrics.recent_spans()
             self._send_traced(resp)
         elif kind == "snapshot":
+            import time as _time
             self.peer_metrics = msg.get("snapshot") or {}
+            self.peer_metrics_at = _time.time()
+            if msg.get("node"):
+                self.peer_node = str(msg["node"])
             if "spans" in msg:
                 self.peer_spans = msg.get("spans") or []
             if self.on_peer_metrics is not None:

@@ -32,7 +32,7 @@ import os
 from ..core.change import Change
 from ..engine.resident import ResidentDocSet
 from ..engine.resident_rows import CompactionAnchorError, DeviceDispatchError
-from ..utils import flightrec, lockprof, metrics, oplag, perfscope
+from ..utils import chaos, flightrec, lockprof, metrics, oplag, perfscope
 from . import epochs
 
 
@@ -305,6 +305,15 @@ class EngineDocSet:
         # floor forever: entries silently expire from the floor after this
         # many seconds without a message (they re-register on next msg)
         self.peer_floor_ttl: float = 900.0
+        # Fault injection (utils/chaos.py — the fleet health plane's test
+        # substrate): _chaos_node is this node's targeting label for
+        # in-process multi-node setups (bench/tests set it; None + no
+        # AMTPU_CHAOS_NODE = process-wide). The lock-hold chaos holder
+        # spawns here when its env knob is set, so a degraded-peer
+        # subprocess needs no code of its own; close() stops it. All
+        # hooks are one cached check when AMTPU_CHAOS_* is unset.
+        self._chaos_node: str | None = None
+        self._chaos_holder = chaos.maybe_lock_holder(self._lock)
 
     # -- peer registry / compaction floor -----------------------------------
 
@@ -815,6 +824,11 @@ class EngineDocSet:
         notifications for the docs that admitted changes."""
         if not self._pending:
             return
+        # chaos slow-apply (utils/chaos.py): an env-gated injected stall
+        # inside the flush window — the fault class the fleet doctor
+        # attributes as "slow_apply". Inert (one cached check) unless
+        # AMTPU_CHAOS_SLOW_APPLY_S is set.
+        chaos.slow_apply(self._chaos_node)
         from .frames import round_from_parts
 
         pending = self._pending
@@ -1036,6 +1050,9 @@ class EngineDocSet:
                 pass   # tickets carried the error to their writers
         if self._flusher is not None:
             self._flusher.stop()
+        if self._chaos_holder is not None:
+            self._chaos_holder.stop()
+            self._chaos_holder = None
 
     def batch(self):
         """Context manager: coalesce every ingress inside the block into

@@ -32,7 +32,7 @@ import socket
 import struct
 import threading
 
-from ..utils import lockprof
+from ..utils import chaos, lockprof
 from .connection import Connection
 
 def _sync_lock_of(doc_set) -> threading.RLock:
@@ -171,6 +171,9 @@ class _Peer:
 
     def __init__(self, doc_set, sock: socket.socket, wire: str = "json"):
         self.sock = sock
+        # chaos targeting label, inherited from the doc_set this peer
+        # serves (utils/chaos.py; None unless a bench/test labeled it)
+        self._chaos_node = getattr(doc_set, "_chaos_node", None)
         # instrumented (utils/lockprof.py): a peer wedged mid-sendall
         # shows up in the contention plane (sync_lock_wait_s{lock=
         # peer_send}) and the post-mortem holder table names the thread
@@ -184,10 +187,25 @@ class _Peer:
         self.closed = threading.Event()
 
     def _send(self, msg: dict) -> None:
+        # chaos frame-drop (utils/chaos.py): env-gated loss injection for
+        # the fleet health plane's fault-attribution proof. Only change-
+        # bearing kinds are ever dropped (telemetry/audit/clock always
+        # pass); the drop is counted like any other pre-write loss so
+        # the doctor's frame-loss signal reads off a real series.
+        if chaos.drop_frame(self._chaos_node, _msg_kind(msg)):
+            from ..utils import metrics
+            metrics.bump("sync_frames_dropped")
+            return
         with self._send_lock:
             try:
                 send_frame(self.sock, msg)
             except OSError:
+                # organic transport loss counts on the SAME series the
+                # injector uses — the fleet doctor's frame-loss signal
+                # must see a genuinely failing peer socket, not only
+                # chaos (the counter's documented contract)
+                from ..utils import metrics
+                metrics.bump("sync_frames_dropped")
                 self.closed.set()
 
     def start(self) -> None:

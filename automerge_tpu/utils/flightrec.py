@@ -94,6 +94,17 @@ EVENT_KINDS: dict[str, str] = {
     "oplag_stage": "one lifecycle stage of a sampled op completed "
                    "(utils/oplag.py; id/stage/s — admission queue wait, "
                    "flush, wire, peer apply, convergence)",
+    # fleet health plane (perf/fleet.py, perf/slo.py, utils/chaos.py)
+    "chaos_inject": "an injected chaos fault fired (utils/chaos.py; "
+                    "fault/node — discloses every degradation so a chaos "
+                    "post-mortem is never mistaken for an organic one)",
+    "fleet_scrape": "one fleet-collector scrape tick (perf/fleet.py; "
+                    "nodes/fresh/stragglers/s)",
+    "straggler_flagged": "the fleet collector flagged a straggler "
+                         "(perf/fleet.py; node/signal/score)",
+    "slo_verdict": "an SLO verdict transition (perf/slo.py; "
+                   "slo/ok/value/bound — recorded on CHANGE, so the ring "
+                   "shows when health flipped, not a heartbeat)",
 }
 
 

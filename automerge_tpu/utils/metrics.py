@@ -166,10 +166,26 @@ COUNTERS: dict[str, str] = {
         "convergence-audit rounds completed against a peer's digests",
     "sync_divergences_detected":
         "convergence-audit divergence reports (shard+doc isolated)",
+    # transport loss accounting (sync/tcp.py): a message the sender gave
+    # up on before the socket write — send failure or an injected fault
+    # (utils/chaos.py). The fleet doctor reads this as the frame-loss
+    # root-cause signal (perf/doctor.py).
+    "sync_frames_dropped":
+        "outgoing change-bearing messages dropped before the socket "
+        "write (sync/tcp.py; transport failure or injected fault)",
     # obs — the observability subsystem's own signals
     "obs_watchdog_fired": "watchdog budget overruns {name=...}",
     "obs_budget_exceeded": "trace(budget_s=...) post-hoc overruns {name=...}",
     "obs_flightrec_dumps": "flight-recorder post-mortem dumps {reason=...}",
+    # fleet health plane (perf/fleet.py, perf/slo.py, utils/chaos.py)
+    "obs_chaos_injected":
+        "chaos fault injections fired {fault=slow_apply|lock_hold|"
+        "frame_drop} (utils/chaos.py; inert unless AMTPU_CHAOS_* set)",
+    "obs_fleet_stragglers_flagged":
+        "straggler flags raised by the fleet collector {node=...} "
+        "(perf/fleet.py; counted on the transition into flagged)",
+    "obs_slo_breaches":
+        "SLO verdict transitions into breach {slo=...} (perf/slo.py)",
 }
 
 GAUGES: dict[str, str] = {
@@ -203,6 +219,25 @@ GAUGES: dict[str, str] = {
         "rolling median sampled-op lag {stage=...} (utils/oplag.py)",
     "sync_op_lag_p99_s":
         "rolling p99 sampled-op lag {stage=...} (utils/oplag.py)",
+    # fleet health plane (perf/fleet.py): per-node rollups the collector
+    # refreshes every scrape tick — node labels are bounded by fleet size
+    "obs_fleet_nodes_scraped":
+        "nodes with a fresh snapshot on the last collector tick "
+        "(perf/fleet.py)",
+    "obs_fleet_scrape_age_s":
+        "seconds since a node's last snapshot arrived {node=...} "
+        "(perf/fleet.py)",
+    "obs_fleet_converge_p99_s":
+        "per-node converge-stage p99 at the last scrape {node=...} "
+        "(perf/fleet.py)",
+    "obs_fleet_round_flush_s":
+        "per-node mean round-flush seconds over the scrape window "
+        "{node=...} (perf/fleet.py)",
+    "obs_fleet_straggler_score":
+        "robust deviation score vs the fleet median {node=...} "
+        "(perf/fleet.py; >= K sigma flags the node)",
+    "obs_slo_ok":
+        "current SLO verdict {slo=...} (perf/slo.py; 1 ok / 0 breach)",
 }
 
 HISTOGRAMS: dict[str, str] = {
@@ -224,6 +259,9 @@ HISTOGRAMS: dict[str, str] = {
         "writer park from epoch-buffer append to its group-commit flush "
         "resolving (sync/epochs.py ticket wait — NOT a lock wait: the "
         "writer holds nothing while parked)",
+    "obs_fleet_scrape_s":
+        "wall seconds of one fleet-collector scrape tick (perf/fleet.py; "
+        "the self-overhead the collector_overhead SLO bounds)",
 }
 
 SPANS: dict[str, str] = {
@@ -591,6 +629,37 @@ def watchdog_events() -> list[dict]:
     """Diagnoses recorded by fired watchdogs since the last reset()."""
     with _global.lock:
         return list(_global.watchdog_events)
+
+
+# ---------------------------------------------------------------------------
+# node identity (the fleet health plane's scrape naming)
+
+_node_name: str | None = None
+_node_name_read = False
+
+
+def node_name() -> str | None:
+    """This process's fleet node label, if any: AMTPU_NODE_NAME (read
+    once) or whatever set_node_name() installed. A Connection serving a
+    `{"metrics": "pull"}` stamps it on the answer, so a fleet collector
+    (perf/fleet.py) names scraped peers by THEIR self-identity instead
+    of guessing from socket order."""
+    global _node_name, _node_name_read
+    if not _node_name_read:
+        _node_name_read = True
+        _node_name = os.environ.get("AMTPU_NODE_NAME") or None
+    return _node_name
+
+
+def set_node_name(name: str | None) -> None:
+    """Override (or with None: clear back to the env) the node label."""
+    global _node_name, _node_name_read
+    if name is None:
+        _node_name_read = False
+        _node_name = None
+    else:
+        _node_name_read = True
+        _node_name = str(name)
 
 
 # ---------------------------------------------------------------------------

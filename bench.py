@@ -1439,6 +1439,333 @@ def run_bulk_merge_config(base_chars=1_000_000, concurrency=0.01,
     }
 
 
+# ---------------------------------------------------------------------------
+# config 11: fleet health — fault injection + doctor attribution
+
+
+def _spawn_fleet_peer(name: str, host: str, port: int, seconds: float,
+                      chaos_env: dict | None, stderr_path: str):
+    """One fleet peer as a REAL subprocess: its metrics registry, oplag
+    reservoirs, and chaos env are process-scoped, so the collector's
+    per-node snapshots are honest (an in-process 'fleet' shares one
+    metrics singleton and can only fake this). The degraded peer is
+    degraded by its ENVIRONMENT — no peer-side code knows it is the
+    victim."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["AMTPU_NODE_NAME"] = name
+    env["AMTPU_OPLAG_SAMPLE"] = "4"    # dense sampling: short run
+    for k in list(env):
+        if k.startswith("AMTPU_CHAOS_"):
+            del env[k]                 # only explicit injection below
+    env.update(chaos_env or {})
+    cmd = [sys.executable, os.path.abspath(__file__), "--fleet-peer",
+           "--connect", f"{host}:{port}", "--peer-name", name,
+           "--peer-seconds", str(seconds)]
+    with open(stderr_path, "w") as err:
+        # Popen dups the fd; closing our handle here leaks nothing
+        return subprocess.Popen(cmd, env=env, stdin=subprocess.PIPE,
+                                stdout=subprocess.DEVNULL, stderr=err)
+
+
+def _fleet_health_subrun(fault: str, chaos_env: dict, n_peers: int,
+                         traffic_s: float, interval_s: float):
+    """One fault-injection fleet: a hub service in THIS process, n_peers
+    subprocess peers (one launched degraded), the collector scraping hub
+    (direct) + peers (wire) every tick DURING the traffic window, and a
+    live doctor diagnosis captured at the strongest observation. Returns
+    the per-fault verdict dict + the collector's scrape costs."""
+    import tempfile
+
+    from automerge_tpu.perf import doctor as doctor_mod
+    from automerge_tpu.perf.fleet import FleetCollector
+    from automerge_tpu.sync.service import EngineDocSet
+    from automerge_tpu.sync.tcp import TcpSyncServer
+    from automerge_tpu.utils import metrics
+
+    degraded = "p1"   # stable victim: not the first, not the last
+    hub = EngineDocSet(backend="rows")
+    server = TcpSyncServer(hub, wire="columnar").start()
+    procs = []
+    stderr_paths = []
+    collector = FleetCollector(interval_s=interval_s, k_sigma=3.0,
+                               min_nodes=3)
+    collector.add_local("hub", role="hub")
+    # the three fault sub-runs share one worker-process registry: count
+    # this sub-run's relayed ops as a DELTA, not the cumulative total
+    ops0 = metrics.snapshot().get("sync_ops_ingested", 0)
+    try:
+        for k in range(n_peers):
+            name = f"p{k}"
+            spath = os.path.join(tempfile.gettempdir(),
+                                 f"amtpu-bench-peer-{fault}-{name}.log")
+            stderr_paths.append(spath)
+            procs.append(_spawn_fleet_peer(
+                name, server.host, server.port, traffic_s,
+                chaos_env if name == degraded else None, spath))
+        deadline = time.time() + 180.0
+        while len(server.peers) < n_peers:
+            if time.time() > deadline:
+                raise RuntimeError(
+                    f"fleet-health peers never connected "
+                    f"({len(server.peers)}/{n_peers}; see {stderr_paths})")
+            if any(p.poll() is not None for p in procs):
+                raise RuntimeError(
+                    f"a fleet-health peer died during startup "
+                    f"(see {stderr_paths})")
+            time.sleep(0.1)
+        for peer in server.peers:
+            collector.add_peer(peer.connection, role="peer")
+        for p in procs:   # synchronized start: everyone generates together
+            p.stdin.write(b"GO\n")
+            p.stdin.flush()
+        # scrape DURING the traffic window and keep the strongest
+        # flagged observation — after traffic stops, every node's rates
+        # decay to zero and there is nothing left to deviate from
+        best = None
+        t_end = time.time() + traffic_s + 2.0
+        with _quiet_traceback_dumps():
+            while time.time() < t_end:
+                time.sleep(interval_s)
+                state = collector.scrape_once()
+                flagged = [n for n in state["stragglers"]
+                           if state["nodes"][n]["role"] == "peer"]
+                if flagged:
+                    report = doctor_mod.diagnose_live(collector)
+                    top = (report["causes"] or [{}])[0]
+                    score = top.get("score", 0.0)
+                    if best is None or score > best["score"]:
+                        best = {"flagged": flagged, "report": report,
+                                "top": top, "score": score}
+        m = metrics.snapshot()
+        hub_ops = m.get("sync_ops_ingested", 0) - ops0
+        scrape_costs = collector.scrape_costs()
+        if best is None:
+            raise AssertionError(
+                f"fleet-health[{fault}]: collector never flagged a "
+                f"straggler (expected {degraded}); nodes="
+                f"{sorted(collector.nodes)}")
+        expected_cause = {"slow_apply": "slow_apply",
+                          "lock_hold": "lock_contention",
+                          "frame_drop": "frame_loss"}[fault]
+        top = best["top"]
+        assert degraded in best["flagged"], (
+            f"fleet-health[{fault}]: flagged {best['flagged']}, "
+            f"expected {degraded}")
+        assert top.get("cause") == expected_cause \
+            and top.get("node") == degraded, (
+            f"fleet-health[{fault}]: doctor ranked "
+            f"{top.get('cause')}@{top.get('node')} first, expected "
+            f"{expected_cause}@{degraded}; causes="
+            f"{[(c['cause'], c['node'], c['score']) for c in best['report']['causes'][:4]]}")
+        return {
+            "degraded": degraded,
+            "flagged": best["flagged"],
+            "top_cause": top.get("cause"),
+            "top_node": top.get("node"),
+            "top_score": top.get("score"),
+            "expected_cause": expected_cause,
+            "attributed": True,
+            "causes": [{k: c[k] for k in ("cause", "node", "score")}
+                       for c in best["report"]["causes"][:4]],
+            "hub_ops_ingested": int(hub_ops),
+        }, scrape_costs
+    finally:
+        collector.stop()
+        for p in procs:
+            try:
+                p.stdin.close()    # peers park on stdin; EOF releases them
+            except OSError:
+                pass
+        server.close()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=10)
+        hub.close()
+
+
+def _fleet_health_overhead_ab(reps=3, n_docs=48, window_s=2.0,
+                              interval_s=0.4):
+    """Collector self-overhead A/B, in-process (the <2% acceptance bar):
+    identical workloads against a rows service, with vs without a
+    collector scraping the local node at the SAME tick interval the
+    fault-injection fleet runs. On a GIL-bound host the overhead IS the
+    scrape duty cycle (scrape_s / interval), so each side is measured
+    as THROUGHPUT over a multi-second window spanning many ticks — a
+    single sub-ms round or clean read cannot carry a percentage (its
+    timer jitter is 10x the effect; measured: median-of-15 clean reads
+    swung ±12% run to run while the duty-cycle bound is <1%). Reps
+    interleaved so both sides see the same machine state; returns
+    median per-rep overhead percentages for round throughput and
+    clean-convergence-read throughput."""
+    import statistics
+
+    from automerge_tpu.core.change import Change, Op
+    from automerge_tpu.core.ids import ROOT_ID
+    from automerge_tpu.native.wire import changes_to_columns
+    from automerge_tpu.perf.fleet import FleetCollector
+    from automerge_tpu.sync.service import EngineDocSet
+
+    def one_side(with_collector: bool):
+        svc = EngineDocSet(backend="rows")
+        collector = None
+        if with_collector:
+            collector = FleetCollector(interval_s=interval_s)
+            collector.add_local("node")
+            collector.start()
+        try:
+            docs = [f"d{i}" for i in range(n_docs)]
+            seqs = {d: 0 for d in docs}
+
+            def round_wire():
+                msgs = []
+                for i, d in enumerate(docs):
+                    seqs[d] += 1
+                    msgs.append((d, changes_to_columns([Change(
+                        actor=f"A{i % 7}", seq=seqs[d], deps={},
+                        ops=[Op("set", ROOT_ID, key=f"f{seqs[d] % 4}",
+                                value=seqs[d])])])))
+                return msgs
+
+            with svc.batch():     # untimed load round
+                for d, cols in round_wire():
+                    svc.apply_columns(d, cols)
+            # round throughput over the window (wire generation runs
+            # inside the window on BOTH sides — symmetric, and it is
+            # exactly the GIL-bound host work a scrape tick preempts)
+            n_rounds = 0
+            t0 = time.perf_counter()
+            t_end = t0 + window_s
+            while time.perf_counter() < t_end:
+                with svc.batch():
+                    for d, cols in round_wire():
+                        svc.apply_columns(d, cols)
+                n_rounds += 1
+            rounds_per_s = n_rounds / (time.perf_counter() - t0)
+            svc.hashes()          # pay the dirty reconcile untimed
+            n_reads = 0
+            t0 = time.perf_counter()
+            t_end = t0 + window_s
+            while time.perf_counter() < t_end:
+                svc.hashes()
+                n_reads += 1
+            reads_per_s = n_reads / (time.perf_counter() - t0)
+            return rounds_per_s, reads_per_s
+        finally:
+            if collector is not None:
+                collector.stop()
+            svc.close()
+
+    round_pcts, hash_pcts = [], []
+    with _quiet_traceback_dumps():
+        one_side(False)           # warmup service (jit, caches)
+        for rep in range(reps):
+            # side order ALTERNATES per rep: interpreter/allocator state
+            # drifts monotonically across a run, so a fixed order reads
+            # that drift as collector overhead (measured as a steady
+            # +3-6% phantom with with-first ordering)
+            if rep % 2 == 0:
+                w_round, w_read = one_side(True)
+                o_round, o_read = one_side(False)
+            else:
+                o_round, o_read = one_side(False)
+                w_round, w_read = one_side(True)
+            round_pcts.append(100.0 * (o_round / max(w_round, 1e-9) - 1.0))
+            hash_pcts.append(100.0 * (o_read / max(w_read, 1e-9) - 1.0))
+    return (round(statistics.median(round_pcts), 2),
+            round(statistics.median(hash_pcts), 2))
+
+
+def run_fleet_health_config(n_peers=3, traffic_s=6.0, interval_s=0.4):
+    """Config 11: fleet health under fault injection. Three sub-runs, one
+    per chaos fault class (utils/chaos.py), each a REAL multi-process
+    fleet — a hub service in the bench worker plus n_peers subprocess
+    peers syncing over TCP, one peer launched with the chaos env set.
+    The collector (perf/fleet.py) scrapes hub + peers every tick over
+    the `{"metrics": "pull"}` wire op; the acceptance claim is that it
+    flags the degraded peer as the straggler and `perf doctor` ranks the
+    injected cause FIRST, for all three classes. Then the collector
+    self-overhead A/B: identical in-process round streams with/without a
+    collector attached (interleaved reps, medians) — the <2% criterion —
+    plus the scrape-cost numbers the perf-history gate bounds."""
+    from automerge_tpu.utils import oplag
+
+    faults = {
+        "slow_apply": {"AMTPU_CHAOS_SLOW_APPLY_S": "0.12"},
+        "lock_hold": {"AMTPU_CHAOS_LOCK_HOLD_S": "0.12",
+                      "AMTPU_CHAOS_LOCK_HOLD_EVERY_S": "0.08"},
+        "frame_drop": {"AMTPU_CHAOS_DROP_FRAMES": "1.0"},
+    }
+    oplag.set_sample_rate(4)      # dense lifecycle sampling for the hub
+    results = {}
+    all_costs = []
+    t0 = time.perf_counter()
+    try:
+        for fault, env in faults.items():
+            results[fault], costs = _fleet_health_subrun(
+                fault, env, n_peers, traffic_s, interval_s)
+            all_costs.extend(costs)
+    finally:
+        oplag.set_sample_rate(None)
+    faults_wall = time.perf_counter() - t0
+
+    from automerge_tpu.perf.fleet import cost_percentiles
+
+    round_overhead_pct, hashes_overhead_pct = _fleet_health_overhead_ab(
+        interval_s=interval_s)
+    # the SAME percentile definition scrape_stats / the SLO engine use
+    scrape_p50, scrape_p99 = cost_percentiles(all_costs)
+    # The honest overhead number is the scrape DUTY CYCLE: the collector
+    # adds exactly its scrape work to the node, so scrape_p50/interval
+    # upper-bounds the average slowdown of any GIL-bound path it shares
+    # a process with (multi-core hosts pay less). The wall-clock A/B
+    # above corroborates it but is jitter-dominated at this magnitude
+    # (medians swing +-5% around zero across runs on a busy host — both
+    # are recorded, the bound is the headline).
+    duty_pct = (round(100.0 * scrape_p50 / interval_s, 2)
+                if scrape_p50 is not None else None)
+    total_ops = sum(r["hub_ops_ingested"] for r in results.values())
+    return {
+        "config": 11,
+        "name": CONFIGS[11][0],
+        "docs": n_peers * 4,
+        "ops": total_ops,
+        "faults": results,
+        "faults_attributed": sum(1 for r in results.values()
+                                 if r["attributed"]),
+        "scrape_p50_s": (round(scrape_p50, 5)
+                         if scrape_p50 is not None else None),
+        "scrape_p99_s": (round(scrape_p99, 5)
+                         if scrape_p99 is not None else None),
+        "scrape_ticks": len(all_costs),
+        "collector_overhead_pct": duty_pct,
+        "collector_duty_cycle_pct": duty_pct,
+        "round_overhead_pct": round_overhead_pct,
+        "hashes_overhead_pct": hashes_overhead_pct,
+        "protocol": (f"{n_peers} subprocess peers + 1 hub over TCP "
+                     f"(columnar wire), {traffic_s}s synchronized "
+                     "traffic per fault class, peer p1 degraded via "
+                     "AMTPU_CHAOS_* env in ITS process only; collector "
+                     f"scrapes hub direct + peers via metrics pull every "
+                     f"{interval_s}s; doctor diagnosis captured at the "
+                     "strongest flagged tick; collector_overhead_pct is "
+                     "the scrape duty-cycle bound (scrape_p50/interval — "
+                     "the collector adds exactly its scrape work); the "
+                     "round/hashes A/B percentages are throughput-window "
+                     "medians (alternating side order, in-process) and "
+                     "are jitter-dominated at this magnitude (+-5% "
+                     "around zero on a busy host) — corroboration, not "
+                     "the headline"),
+        "engine_s": round(faults_wall, 3),
+        "oracle_s": None,
+        "speedup": None,
+        "parity": True,
+    }
+
+
 CONFIGS = {
     1: ("single-doc LWW storm (2 actors x 1000 sets)", gen_lww_storm),
     2: ("nested JSON card board (8 actors)", gen_trellis),
@@ -1451,6 +1778,8 @@ CONFIGS = {
     9: ("multi-writer ingestion saturation (epoch group-commit)", None),
     10: ("bulk text merge: two 1M+-char divergent histories "
          "(1% concurrent, span plane)", None),
+    11: ("fleet health: fault injection, straggler + doctor attribution",
+         None),
 }
 
 
@@ -2077,6 +2406,8 @@ def run_config(cfg: int, n_docs: int | None = None, oracle_cap_docs=12000):
         return run_multiwriter_config()
     if cfg == 10:
         return run_bulk_merge_config()
+    if cfg == 11:
+        return run_fleet_health_config()
     name, gen = CONFIGS[cfg]
     kwargs = {}
     if cfg == 5 and n_docs:
@@ -2317,6 +2648,17 @@ def _final_record(results_by_cfg: dict, backend: str | None, attempts: list):
                 "span_counts": r["span_counts"],
                 "engine_span_merge": r["engine_span_merge"]}
                if r.get("config") == 10 else {}),
+            **({"scrape_p50_s": r["scrape_p50_s"],
+                "scrape_p99_s": r["scrape_p99_s"],
+                "scrape_ticks": r["scrape_ticks"],
+                "collector_overhead_pct": r["collector_overhead_pct"],
+                "collector_duty_cycle_pct": r["collector_duty_cycle_pct"],
+                "round_overhead_pct": r["round_overhead_pct"],
+                "hashes_overhead_pct": r["hashes_overhead_pct"],
+                "faults_attributed": r["faults_attributed"],
+                "faults": r["faults"],
+                "protocol": r["protocol"]}
+               if r.get("config") == 11 else {}),
             **({"fleet_load_ops_per_s": r["fleet_load_ops_per_s"],
                 "round_ops_per_s": r["round_ops_per_s"],
                 "round_cost_scaling": r[
@@ -2501,6 +2843,53 @@ def _run_config_budgeted(cfg: int, n_docs, budget_s: float):
     return box["result"]
 
 
+def fleet_peer_main(args):
+    """One fleet-health peer process (config 11): a rows sync service
+    connected to the hub over TCP, generating a steady single-op change
+    stream for --peer-seconds, then parking to keep serving metrics
+    pulls until the parent closes stdin. Degradation, if any, comes
+    entirely from this process's AMTPU_CHAOS_* environment — the code
+    path is identical for healthy and degraded peers."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")   # host-side sync service
+    _load_package()
+    from automerge_tpu.core.change import Change, Op
+    from automerge_tpu.core.ids import ROOT_ID
+    from automerge_tpu.native.wire import changes_to_columns
+    from automerge_tpu.sync.service import EngineDocSet
+    from automerge_tpu.sync.tcp import TcpSyncClient
+
+    name = args.peer_name
+    svc = EngineDocSet(backend="rows")
+    svc._chaos_node = name
+    host, _, port = args.connect.rpartition(":")
+    client = TcpSyncClient(svc, host or "127.0.0.1", int(port),
+                           wire="columnar").start()
+    docs = [f"{name}-d{j}" for j in range(4)]
+    seqs = {d: 0 for d in docs}
+    print("PEER READY", flush=True)
+    sys.stdin.readline()                        # the parent's GO barrier
+    deadline = time.perf_counter() + args.peer_seconds
+    k = 0
+    while time.perf_counter() < deadline:
+        d = docs[k % len(docs)]
+        seqs[d] += 1
+        cols = changes_to_columns([Change(
+            actor=f"A-{name}", seq=seqs[d], deps={},
+            ops=[Op("set", ROOT_ID, key=f"f{k % 4}", value=k)])])
+        try:
+            svc.apply_columns(d, cols)
+        except Exception:
+            pass                                # chaos may starve a round
+        k += 1
+        time.sleep(args.peer_period)
+    print("PEER DONE", flush=True)
+    sys.stdin.read()        # park: keep serving metrics pulls until EOF
+    client.close()
+    svc.close()
+    sys.exit(0)
+
+
 def worker_main(args):
     """Run the measurements. Streams one `RESULT {json}` line per finished
     config and a `FINAL {json}` line at the end, all flushed immediately so
@@ -2588,6 +2977,10 @@ def worker_main(args):
                     f"writers (x{r['admission_scaling_4x']} vs 1, "
                     f"service-lock wait /{r['service_lock_wait_reduction_x']})"
                     if r.get("admission_ops_per_s") is not None else
+                    f"{r['faults_attributed']}/3 fault classes "
+                    f"attributed, scrape p50 {r['scrape_p50_s']}s, "
+                    f"collector overhead {r['collector_overhead_pct']}%"
+                    if r.get("faults_attributed") is not None else
                     f"{r.get('round_ops_per_s', 0)} round ops/s")
         print(f"# config {cfg} [{r['name']}]: {r['ops']} ops, "
               f"{ora_note}engine {r['engine_s']:.3f}s "
@@ -2873,7 +3266,18 @@ def main():
     ap.add_argument("--force-cpu", action="store_true")
     ap.add_argument("--skip", type=lambda s: {int(x) for x in s.split(",") if x},
                     default=set())
+    ap.add_argument("--fleet-peer", action="store_true",
+                    help="(internal) run as a config-11 fleet-health peer")
+    ap.add_argument("--connect", default=None,
+                    help="(fleet-peer) hub host:port")
+    ap.add_argument("--peer-name", default="p0")
+    ap.add_argument("--peer-seconds", type=float, default=6.0)
+    ap.add_argument("--peer-period", type=float, default=0.02)
     args = ap.parse_args()
+
+    if args.fleet_peer:
+        fleet_peer_main(args)
+        return
 
     if args.worker:
         worker_main(args)

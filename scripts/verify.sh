@@ -11,11 +11,13 @@
 # Stage 2: perf report (INFORMATIONAL): the bench-history trajectory the
 #          regression gate reads, plus the contention & convergence-lag
 #          section (per-lock wait/hold, sampled op-lag stages — the
-#          baseline ROADMAP #1's ingestion refactor lands against). Never
-#          fails verify — a CPU-only image or a missing/empty history must
-#          not block the build (TUNNEL_DIAGNOSIS.md: TPU absence is an
-#          environment fact, not a code defect). Run `make perfcheck` for
-#          the enforcing gate.
+#          baseline ROADMAP #1's ingestion refactor lands against) and
+#          the perf-doctor post-mortem over the last bench detail (ranked
+#          root causes per config — docs/OBSERVABILITY.md "Fleet
+#          health"). Never fails verify — a CPU-only image or a
+#          missing/empty history must not block the build
+#          (TUNNEL_DIAGNOSIS.md: TPU absence is an environment fact, not
+#          a code defect). Run `make perfcheck` for the enforcing gate.
 # Stage 3: the tier-1 pytest line EXACTLY as ROADMAP.md specifies it,
 #          including the DOTS_PASSED count the driver compares against the
 #          seed. Keep this in sync with ROADMAP.md "Tier-1 verify".
@@ -32,6 +34,8 @@ JAX_PLATFORMS=cpu python -m automerge_tpu.perf report \
     || echo "perf report unavailable (informational stage — not a failure)"
 JAX_PLATFORMS=cpu python -m automerge_tpu.perf contention \
     || echo "contention report unavailable (informational — not a failure)"
+JAX_PLATFORMS=cpu python -m automerge_tpu.perf doctor --post-mortem BENCH_DETAIL.json \
+    || echo "perf doctor unavailable (informational — not a failure)"
 
 echo "== stage 3/3: tier-1 suite (ROADMAP.md) =="
 set -o pipefail

@@ -1,0 +1,288 @@
+"""Chaos fault-injection hooks (utils/chaos.py) + end-to-end straggler /
+doctor attribution over per-node captures of real degraded services.
+
+The inertness contract matters as much as the injection: with no
+AMTPU_CHAOS_* set every hook must be a cached check that records
+nothing — these hooks sit on the round-flush and transport hot paths.
+"""
+
+import os
+import time
+
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu import DocSet
+from automerge_tpu.core.change import Change, Op
+from automerge_tpu.core.ids import ROOT_ID
+from automerge_tpu.native.wire import changes_to_columns
+from automerge_tpu.sync.service import EngineDocSet
+from automerge_tpu.sync.tcp import TcpSyncClient, TcpSyncServer
+from automerge_tpu.utils import chaos, metrics
+
+CHAOS_VARS = ("AMTPU_CHAOS_SLOW_APPLY_S", "AMTPU_CHAOS_LOCK_HOLD_S",
+              "AMTPU_CHAOS_LOCK_HOLD_EVERY_S", "AMTPU_CHAOS_DROP_FRAMES",
+              "AMTPU_CHAOS_NODE")
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos(monkeypatch):
+    """Every test starts and ends with a pristine chaos config."""
+    for var in CHAOS_VARS:
+        monkeypatch.delenv(var, raising=False)
+    chaos.reload()
+    yield
+    for var in CHAOS_VARS:
+        monkeypatch.delenv(var, raising=False)
+    chaos.reload()
+    metrics.reset()
+
+
+def _one_op_cols(actor, seq, key="k", value=1):
+    return changes_to_columns([Change(
+        actor=actor, seq=seq, deps={},
+        ops=[Op("set", ROOT_ID, key=key, value=value)])])
+
+
+def wait_until(predicate, timeout=10.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# inertness
+
+
+def test_hooks_fully_inert_when_unset():
+    metrics.reset()
+    assert not chaos.enabled()
+    assert chaos.maybe_lock_holder(object()) is None
+    assert chaos.drop_frame("any", "frame") is False
+    t0 = time.perf_counter()
+    chaos.slow_apply("any")
+    assert time.perf_counter() - t0 < 0.05   # no sleep happened
+    svc = EngineDocSet(backend="rows")
+    try:
+        assert svc._chaos_holder is None
+        svc.apply_columns("d0", _one_op_cols("A", 1))
+    finally:
+        svc.close()
+    snap = metrics.snapshot()
+    assert not any(k.startswith("obs_chaos") for k in snap), \
+        [k for k in snap if k.startswith("obs_chaos")]
+    assert snap.get("sync_frames_dropped", 0) == 0
+
+
+def test_drop_frame_never_touches_telemetry_kinds(monkeypatch):
+    monkeypatch.setenv("AMTPU_CHAOS_DROP_FRAMES", "1.0")
+    chaos.reload()
+    # change-bearing kinds drop at p=1.0; telemetry kinds never do
+    assert chaos.drop_frame(None, "frame") is True
+    assert chaos.drop_frame(None, "metrics:pull") is False
+    assert chaos.drop_frame(None, "metrics:snapshot") is False
+    assert chaos.drop_frame(None, "audit:pull") is False
+    assert chaos.drop_frame(None, "clock") is False
+
+
+def test_node_targeting(monkeypatch):
+    metrics.reset()
+    monkeypatch.setenv("AMTPU_CHAOS_SLOW_APPLY_S", "0.2")
+    monkeypatch.setenv("AMTPU_CHAOS_NODE", "victim")
+    chaos.reload()
+    # a non-matching node is untouched
+    t0 = time.perf_counter()
+    chaos.slow_apply("innocent")
+    chaos.slow_apply(None)
+    assert time.perf_counter() - t0 < 0.1
+    assert metrics.snapshot().get(
+        "obs_chaos_injected{fault=slow_apply}", 0) == 0
+    # the matching node pays
+    t0 = time.perf_counter()
+    chaos.slow_apply("victim")
+    assert time.perf_counter() - t0 >= 0.2
+    assert metrics.snapshot().get(
+        "obs_chaos_injected{fault=slow_apply}", 0) == 1
+
+
+# ---------------------------------------------------------------------------
+# the three fault classes against real services
+
+
+def test_slow_apply_inflates_round_flush(monkeypatch):
+    metrics.reset()
+    monkeypatch.setenv("AMTPU_CHAOS_SLOW_APPLY_S", "0.05")
+    chaos.reload()
+    svc = EngineDocSet(backend="rows")
+    try:
+        t0 = time.perf_counter()
+        svc.apply_columns("d0", _one_op_cols("A", 1))
+        assert time.perf_counter() - t0 >= 0.05
+    finally:
+        svc.close()
+    snap = metrics.snapshot()
+    assert snap.get("obs_chaos_injected{fault=slow_apply}", 0) >= 1
+    # the sleep lands INSIDE the flush window (the slow-apply signature)
+    assert snap.get("sync_round_flush_s", 0) >= 0.05
+
+
+def test_lock_hold_auto_holder_and_close(monkeypatch):
+    metrics.reset()
+    monkeypatch.setenv("AMTPU_CHAOS_LOCK_HOLD_S", "0.04")
+    monkeypatch.setenv("AMTPU_CHAOS_LOCK_HOLD_EVERY_S", "0.02")
+    chaos.reload()
+    svc = EngineDocSet(backend="rows")
+    try:
+        assert svc._chaos_holder is not None
+        holder_thread = svc._chaos_holder._thread
+        assert holder_thread.name == "amtpu-chaos-lockhold"
+        assert wait_until(lambda: metrics.snapshot().get(
+            "obs_chaos_injected{fault=lock_hold}", 0) >= 2)
+    finally:
+        svc.close()
+    # close() stops AND joins the holder (thread hygiene)
+    assert not holder_thread.is_alive()
+    snap = metrics.snapshot()
+    # the hold shows on the instrumented service lock — the signature
+    # that separates lock_hold from slow_apply for the doctor
+    assert snap.get("sync_lock_hold_s{lock=service}_max", 0) >= 0.03
+
+
+def test_frame_drop_over_tcp_spares_telemetry(monkeypatch):
+    metrics.reset()
+    monkeypatch.setenv("AMTPU_CHAOS_DROP_FRAMES", "1.0")
+    monkeypatch.setenv("AMTPU_CHAOS_NODE", "victim")
+    chaos.reload()
+    ds_server, ds_client = DocSet(), DocSet()
+    ds_client._chaos_node = "victim"
+    server = TcpSyncServer(ds_server).start()
+    client = TcpSyncClient(ds_client, server.host, server.port).start()
+    try:
+        ds_client.set_doc("doc1", am.change(
+            am.init(), lambda d: d.__setitem__("hello", "net")))
+        time.sleep(0.5)
+        # the change-bearing message was dropped at the victim's sender
+        assert ds_server.get_doc("doc1") is None
+        snap = metrics.snapshot()
+        assert snap.get("sync_frames_dropped", 0) >= 1
+        assert snap.get("obs_chaos_injected{fault=frame_drop}", 0) >= 1
+        # the telemetry plane still works THROUGH the degraded link:
+        # a metrics pull round-trips (chaos never drops metrics kinds)
+        conn = client.peer.connection
+        conn.request_metrics()
+        assert wait_until(lambda: conn.peer_metrics is not None)
+    finally:
+        client.close()
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end attribution: three per-node captures per fault class, the
+# collector must flag the degraded node and the doctor must rank the
+# injected cause first (the ISSUE acceptance shape, in-process)
+
+
+def _capture_service_node(monkeypatch, fault_env: dict, n_ops=16):
+    """Run one rows service (optionally degraded) and return the
+    (mid, end) metrics snapshot pair a collector source can replay.
+    The registry is reset first so the snapshots are this node's own."""
+    for k, v in fault_env.items():
+        monkeypatch.setenv(k, v)
+    chaos.reload()
+    metrics.reset()
+    svc = EngineDocSet(backend="rows")
+    try:
+        for k in range(n_ops):
+            svc.apply_columns(f"d{k % 4}", _one_op_cols("A", k // 4 + 1,
+                                                        key=f"f{k % 3}"))
+        mid = metrics.snapshot()
+        for k in range(n_ops):
+            svc.apply_columns(f"d{k % 4}",
+                              _one_op_cols("A", n_ops // 4 + k // 4 + 1,
+                                           key=f"f{k % 3}"))
+        end = metrics.snapshot()
+    finally:
+        svc.close()
+        for k in fault_env:
+            monkeypatch.delenv(k, raising=False)
+        chaos.reload()
+    return mid, end
+
+
+def _capture_dropping_node(monkeypatch, n_ops=10):
+    """A node whose outgoing change frames are dropped (TCP pair)."""
+    monkeypatch.setenv("AMTPU_CHAOS_DROP_FRAMES", "1.0")
+    chaos.reload()
+    metrics.reset()
+    ds_server, ds_client = DocSet(), DocSet()
+    server = TcpSyncServer(ds_server).start()
+    client = TcpSyncClient(ds_client, server.host, server.port).start()
+    try:
+        def burst(base):
+            for k in range(n_ops):
+                ds_client.set_doc(f"doc{base + k}", am.change(
+                    am.init(), lambda d, k=k: d.__setitem__("n", k)))
+        burst(0)
+        mid = metrics.snapshot()
+        burst(n_ops)
+        end = metrics.snapshot()
+    finally:
+        client.close()
+        server.close()
+        monkeypatch.delenv("AMTPU_CHAOS_DROP_FRAMES", raising=False)
+        chaos.reload()
+    return mid, end
+
+
+def _replay_source(pair):
+    """Collector source that serves the mid snapshot once, then end."""
+    state = {"n": 0}
+
+    def fn():
+        state["n"] += 1
+        return pair[0] if state["n"] == 1 else pair[1]
+    return fn
+
+
+@pytest.mark.parametrize("fault,env,expected_cause", [
+    ("slow_apply", {"AMTPU_CHAOS_SLOW_APPLY_S": "0.04"}, "slow_apply"),
+    ("lock_hold", {"AMTPU_CHAOS_LOCK_HOLD_S": "0.05",
+                   "AMTPU_CHAOS_LOCK_HOLD_EVERY_S": "0.01"},
+     "lock_contention"),
+    ("frame_drop", {}, "frame_loss"),
+])
+def test_straggler_and_doctor_attribution(monkeypatch, fault, env,
+                                          expected_cause):
+    from automerge_tpu.perf import doctor
+    from automerge_tpu.perf.fleet import FleetCollector
+
+    captures = {}
+    for node in ("a", "b"):
+        captures[node] = _capture_service_node(monkeypatch, {})
+    if fault == "frame_drop":
+        captures["x"] = _capture_dropping_node(monkeypatch)
+    else:
+        captures[("x")] = _capture_service_node(monkeypatch, env)
+
+    metrics.reset()   # the collector's own exports start clean
+    collector = FleetCollector(interval_s=0.05, k_sigma=3.0, min_nodes=3)
+    for node, pair in captures.items():
+        collector.add_local(node, _replay_source(pair), role="peer")
+    collector.scrape_once()
+    time.sleep(0.05)
+    state = collector.scrape_once()
+
+    assert state["stragglers"] == ["x"], (fault, state["nodes"])
+    assert state["nodes"]["x"]["straggler_score"] >= 3.0
+    report = doctor.diagnose_live(collector)
+    top = report["causes"][0]
+    assert top["cause"] == expected_cause and top["node"] == "x", (
+        fault, [(c["cause"], c["node"], c["score"])
+                for c in report["causes"][:4]])
+    # the collector disclosed the flag through the export surface too
+    snap = metrics.snapshot()
+    assert snap.get("obs_fleet_stragglers_flagged{node=x}", 0) == 1
+    assert snap.get("obs_fleet_straggler_score{node=x}", 0) >= 3.0

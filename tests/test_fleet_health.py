@@ -1,0 +1,589 @@
+"""Fleet health plane: collector (perf/fleet.py), SLO engine
+(perf/slo.py), doctor (perf/doctor.py), and the `perf top` renderer."""
+
+import json
+import time
+
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu import DocSet
+from automerge_tpu.perf import doctor, history, slo
+from automerge_tpu.perf.fleet import (FleetCollector, extract_features,
+                                      robust_scores)
+from automerge_tpu.sync.tcp import TcpSyncClient, TcpSyncServer
+from automerge_tpu.utils import flightrec, metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_metrics():
+    metrics.reset()
+    flightrec.reset()
+    yield
+    metrics.reset()
+    flightrec.reset()
+    metrics.set_node_name(None)
+
+
+def wait_until(predicate, timeout=10.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _snap(ops=0, flush_s=0.0, flush_n=0, lockw=0.0, drops=0, conv=None,
+          watchdog=0, retraced=0, sharded=False):
+    out = {
+        "sync_ops_ingested": ops,
+        "sync_frames_dropped": drops,
+        "obs_watchdog_fired{name=sync_hashes_fanout}": watchdog,
+        "engine_kernels_retraced{kernel=apply_doc}": retraced,
+        "sync_lock_wait_s{lock=service}_sum": lockw,
+        "sync_lock_wait_s{lock=service}_count": 10,
+        "sync_lock_hold_s{lock=service}_sum": lockw * 1.5,
+    }
+    if sharded:   # labeled span variants must collapse too
+        out["sync_round_flush{shard=0}_s"] = flush_s / 2
+        out["sync_round_flush{shard=1}_s"] = flush_s / 2
+        out["sync_round_flush{shard=0}_count"] = flush_n // 2
+        out["sync_round_flush{shard=1}_count"] = flush_n - flush_n // 2
+    else:
+        out["sync_round_flush_s"] = flush_s
+        out["sync_round_flush_count"] = flush_n
+    if conv is not None:
+        out["oplag"] = {"sample_rate": 4, "stages": {
+            "converge": {"count": 8, "p50_s": conv / 2, "p90_s": conv,
+                         "p99_s": conv, "max_s": conv}}}
+    return out
+
+
+def _scripted(*snaps):
+    """Source returning the given snapshots in order (last one sticky)."""
+    seq = list(snaps)
+
+    def fn():
+        return seq.pop(0) if len(seq) > 1 else seq[0]
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# feature extraction + scoring
+
+
+def test_extract_features_collapses_labels_and_reads_oplag():
+    f = extract_features(_snap(ops=100, flush_s=2.0, flush_n=40,
+                               lockw=0.5, drops=3, conv=0.25,
+                               sharded=True))
+    assert f["ops_ingested"] == 100
+    assert f["round_flush_total_s"] == pytest.approx(2.0)
+    assert f["round_flush_count"] == 40
+    assert f["lock_wait_s"] == pytest.approx(0.5)
+    assert f["frames_dropped"] == 3
+    assert f["converge_p99_s"] == pytest.approx(0.25)
+
+
+def test_extract_features_gauge_fallback_for_percentiles():
+    f = extract_features({"sync_op_lag_p99_s{stage=converge}": 0.75})
+    assert f["converge_p99_s"] == pytest.approx(0.75)
+
+
+def test_robust_scores_uniform_and_outlier():
+    # uniform group: nobody deviates
+    z = robust_scores({"a": 1.0, "b": 1.0, "c": 1.0}, abs_floor=0.1)
+    assert all(v == 0.0 for v in z.values())
+    # one huge outlier: ITS score is large, the healthy pair's is 0;
+    # a plain z-score would have divided by the outlier-inflated std
+    z = robust_scores({"a": 0.01, "b": 0.01, "x": 5.0}, abs_floor=0.05)
+    assert z["x"] > 3.0 and z["a"] == 0.0 and z["b"] == 0.0
+    # deviating DOWN (a fast node) is not straggling
+    z = robust_scores({"a": 1.0, "b": 1.0, "x": 0.0}, abs_floor=0.05)
+    assert z["x"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# collector: rings, rates, rollups, straggler transitions
+
+
+def test_collector_rates_and_rollup():
+    c = FleetCollector(interval_s=0.05, min_nodes=3)
+    c.add_local("a", _scripted(_snap(ops=0), _snap(ops=100, flush_s=0.1,
+                                                   flush_n=20)))
+    c.scrape_once()
+    time.sleep(0.05)
+    state = c.scrape_once()
+    d = state["nodes"]["a"]["derived"]
+    assert d["ops_per_s"] > 0
+    assert d["round_flush_mean_s"] == pytest.approx(0.005)
+    assert state["rollup"]["ops_per_s"] == pytest.approx(d["ops_per_s"])
+    assert state["rollup"]["nodes"] == 1
+    # ring series feed (the perf top sparklines)
+    assert len(c.nodes["a"].series("ops_per_s")) >= 1
+
+
+def test_straggler_flag_exports_and_transition_counting():
+    c = FleetCollector(interval_s=0.02, min_nodes=3, k_sigma=3.0)
+    # three snapshots each, growing steadily — tick 3 must still see
+    # nonzero deltas so the flag HOLDS (exercising the no-double-count)
+    c.add_local("a", _scripted(_snap(), _snap(ops=60, flush_s=0.06,
+                                              flush_n=30),
+                               _snap(ops=120, flush_s=0.12, flush_n=60)),
+                role="peer")
+    c.add_local("b", _scripted(_snap(), _snap(ops=60, flush_s=0.06,
+                                              flush_n=30),
+                               _snap(ops=120, flush_s=0.12, flush_n=60)),
+                role="peer")
+    c.add_local("x", _scripted(_snap(), _snap(ops=20, flush_s=3.0,
+                                              flush_n=10),
+                               _snap(ops=40, flush_s=6.0, flush_n=20)),
+                role="peer")
+    c.scrape_once()
+    time.sleep(0.02)
+    state = c.scrape_once()
+    assert state["stragglers"] == ["x"]
+    assert state["nodes"]["x"]["straggler_signal"] == "round_flush_mean_s"
+    # flagged again on the next tick: the transition counter must NOT
+    # double-count a node that stays flagged
+    time.sleep(0.02)
+    state = c.scrape_once()
+    assert state["stragglers"] == ["x"]
+    snap = metrics.snapshot()
+    assert snap.get("obs_fleet_stragglers_flagged{node=x}") == 1
+    assert snap.get("obs_fleet_straggler_score{node=x}", 0) >= 3.0
+    assert snap.get("obs_fleet_round_flush_s{node=x}", 0) > 0
+    assert snap.get("obs_fleet_nodes_scraped") == 3
+    assert "obs_fleet_scrape_s_count" in snap
+    kinds = [e["kind"] for e in flightrec.events()]
+    assert "straggler_flagged" in kinds and "fleet_scrape" in kinds
+
+
+def test_no_flagging_below_min_nodes():
+    c = FleetCollector(interval_s=0.02, min_nodes=3)
+    c.add_local("a", _scripted(_snap(), _snap(ops=60, flush_s=0.06,
+                                              flush_n=30)), role="peer")
+    c.add_local("x", _scripted(_snap(), _snap(ops=10, flush_s=5.0,
+                                              flush_n=10)), role="peer")
+    c.scrape_once()
+    time.sleep(0.02)
+    state = c.scrape_once()
+    assert state["stragglers"] == []   # a 2-node group has no median
+
+
+def test_stale_node_drops_out_of_scoring_and_rollup():
+    """A dead peer's frozen last rates must not keep it flagged (or keep
+    inflating the fleet rollup) forever — stale nodes are excluded from
+    judging, kept in the table with the stale marker."""
+    c = FleetCollector(interval_s=0.02, min_nodes=3, k_sigma=3.0)
+    for n, flush in (("a", 0.06), ("b", 0.06), ("x", 3.0)):
+        c.add_local(n, _scripted(_snap(),
+                                 _snap(ops=60, flush_s=flush, flush_n=30),
+                                 _snap(ops=120, flush_s=2 * flush,
+                                       flush_n=60)), role="peer")
+    c.scrape_once()
+    time.sleep(0.02)
+    state = c.scrape_once()
+    assert state["stragglers"] == ["x"]
+    # x's process dies: stop sampling it and age its last snapshot out
+    c._locals = [(n, f) for n, f in c._locals if n != "x"]
+    c.nodes["x"].last_at -= 10.0
+    for s in c.nodes["x"].samples:
+        s["t"] -= 10.0
+    state = c.scrape_once()
+    assert state["stragglers"] == []
+    assert state["nodes"]["x"]["stale"] is True
+    assert state["nodes"]["x"]["derived"] is None
+    assert state["rollup"]["nodes_fresh"] == 2
+
+
+def test_counter_reset_clamps_to_quiet_tick():
+    """A restarted peer's counters go backwards; the derived rates must
+    clamp to zero, not spike negative through rollups and sparklines."""
+    c = FleetCollector(interval_s=0.02)
+    c.add_local("a", _scripted(_snap(ops=500, drops=40),
+                               _snap(ops=3, drops=0)))
+    c.scrape_once()
+    time.sleep(0.02)
+    state = c.scrape_once()
+    d = state["nodes"]["a"]["derived"]
+    assert d["ops_per_s"] == 0.0 and d["drop_rate"] == 0.0
+
+
+def test_slo_delta_rebaselines_on_membership_change():
+    """A late joiner's lifetime counters are not growth on this engine's
+    watch: delta SLOs re-baseline when the reporting set changes, and
+    resume counting new growth against the new membership."""
+    c = FleetCollector(interval_s=0.02)
+    c.add_local("a", _scripted(_snap(watchdog=5)))
+    eng = slo.SloEngine(slos=[
+        {"name": "watchdog_clean", "signal": "watchdog_fires",
+         "bound": 0, "delta": True}])
+    c.scrape_once()
+    eng.evaluate(c)
+    assert eng.verdicts["watchdog_clean"]["ok"] is True
+    # node b joins carrying 7 LIFETIME fires: membership changed, so the
+    # rollup jump re-baselines instead of breaching
+    c.add_local("b", _scripted(_snap(watchdog=7), _snap(watchdog=7),
+                               _snap(watchdog=8)))
+    c.scrape_once()
+    eng.evaluate(c)
+    assert eng.verdicts["watchdog_clean"]["ok"] is True
+    c.scrape_once()
+    eng.evaluate(c)   # same membership, still 12 total
+    assert eng.verdicts["watchdog_clean"]["ok"] is True
+    c.scrape_once()   # b records one NEW fire (7 -> 8)
+    eng.evaluate(c)
+    assert eng.verdicts["watchdog_clean"]["ok"] is False
+
+
+def test_roles_compared_separately():
+    """A hub doing 10x the relay work of the peers must not be flagged
+    against them — comparison happens within role groups."""
+    c = FleetCollector(interval_s=0.02, min_nodes=3)
+    c.add_local("hub", _scripted(_snap(), _snap(ops=600, flush_s=3.0,
+                                                flush_n=100)), role="hub")
+    for n in ("p0", "p1", "p2"):
+        c.add_local(n, _scripted(_snap(), _snap(ops=60, flush_s=0.06,
+                                                flush_n=30)), role="peer")
+    c.scrape_once()
+    time.sleep(0.02)
+    state = c.scrape_once()
+    assert state["stragglers"] == []
+
+
+def test_wire_scrape_over_real_tcp_names_peer():
+    """add_peer + the {"metrics":"pull"} plumbing: arrivals are folded
+    in on the next tick and the node adopts the peer's self-reported
+    label (metrics.node_name -> Connection.peer_node)."""
+    metrics.set_node_name("srv-7")
+    ds_server, ds_client = DocSet(), DocSet()
+    server = TcpSyncServer(ds_server).start()
+    client = TcpSyncClient(ds_client, server.host, server.port).start()
+    try:
+        ds_server.set_doc("doc1", am.change(
+            am.init(), lambda d: d.__setitem__("hello", "net")))
+        assert wait_until(
+            lambda: ds_client.get_doc("doc1") is not None)
+        conn = client.peer.connection
+        c = FleetCollector(interval_s=0.05)
+        c.add_peer(conn, role="peer")       # issues the first pull
+        assert wait_until(lambda: conn.peer_metrics is not None)
+        c.scrape_once()                     # harvest + re-pull
+        assert "srv-7" in c.nodes
+        assert c.nodes["srv-7"].samples
+        assert wait_until(
+            lambda: conn.peer_metrics_at is not None)
+        state = c.fleet_state()
+        assert state["nodes"]["srv-7"]["age_s"] is not None
+    finally:
+        client.close()
+        server.close()
+
+
+class _FakeConn:
+    """Duck-typed Connection: answers every pull synchronously with the
+    scripted snapshot, self-reporting `label`."""
+
+    def __init__(self, label, snap_fn):
+        self.peer_node = label
+        self.peer_metrics = None
+        self.on_peer_metrics = None
+        self._snap_fn = snap_fn
+
+    def request_metrics(self):
+        self.peer_metrics = self._snap_fn()
+        if self.on_peer_metrics is not None:
+            self.on_peer_metrics(self.peer_metrics)
+
+
+def test_duplicate_peer_labels_do_not_merge():
+    """Two peers self-reporting the same node label (copy-pasted
+    AMTPU_NODE_NAME) must NOT fold into one sample ring — interleaved
+    registries would make garbage rates; the collision keeps its
+    positional name instead."""
+    c = FleetCollector(interval_s=0.02)
+    c.add_peer(_FakeConn("worker", _scripted(_snap(ops=10))), role="peer")
+    c.add_peer(_FakeConn("worker", _scripted(_snap(ops=99))), role="peer")
+    c.scrape_once()
+    time.sleep(0.02)
+    state = c.scrape_once()
+    assert len(state["nodes"]) == 2
+    assert "worker" in state["nodes"]
+    assert "peer1" in state["nodes"]      # the collision kept its slot
+    # and a peer label colliding with a LOCAL source is refused too
+    c2 = FleetCollector(interval_s=0.02)
+    c2.add_local("hub", _scripted(_snap()))
+    c2.add_peer(_FakeConn("hub", _scripted(_snap(ops=5))), role="peer")
+    c2.scrape_once()
+    state = c2.scrape_once()
+    assert set(state["nodes"]) == {"hub", "peer0"}
+
+
+def test_organic_send_failure_counts_as_dropped():
+    """A real transport failure lands on the SAME sync_frames_dropped
+    series the chaos injector uses — the doctor's frame-loss signal
+    must see a genuinely failing peer socket, not only injected loss."""
+    from automerge_tpu.sync.tcp import _Peer
+
+    class _DeadSock:
+        def sendall(self, data):
+            raise OSError("broken pipe")
+
+        def close(self):
+            pass
+
+    peer = _Peer(DocSet(), _DeadSock())
+    before = metrics.snapshot().get("sync_frames_dropped", 0)
+    peer._send({"docId": "d", "clock": {}, "changes": []})
+    snap = metrics.snapshot()
+    assert snap.get("sync_frames_dropped", 0) == before + 1
+    assert peer.closed.is_set()
+
+
+def test_collector_thread_lifecycle():
+    c = FleetCollector(interval_s=0.02)
+    c.add_local("a", _scripted(_snap(), _snap(ops=10)))
+    c.start()
+    assert wait_until(lambda: c.ticks >= 2)
+    t = c._thread
+    c.stop()
+    assert not t.is_alive()
+    assert c.scrape_stats()["p50_s"] is not None
+
+
+# ---------------------------------------------------------------------------
+# SLO engine
+
+
+def test_slo_transitions_breach_and_recover():
+    c = FleetCollector(interval_s=0.02, min_nodes=3)
+    src = _scripted(_snap(conv=0.01), _snap(conv=0.01),
+                    _snap(conv=9.0), _snap(conv=9.0),
+                    _snap(conv=0.01))
+    c.add_local("a", src)
+    eng = slo.SloEngine(slos=[
+        {"name": "converge_p99", "signal": "converge_p99_s",
+         "bound": 1.0}])
+    c.slo_engine = eng
+    c.scrape_once()                       # conv 0.01 -> ok
+    assert eng.verdicts["converge_p99"]["ok"] is True
+    assert eng.verdicts["converge_p99"]["transitions"] == 0
+    c.scrape_once()                       # second snapshot, still ok
+    c.scrape_once()                       # conv 9.0 -> breach
+    v = eng.verdicts["converge_p99"]
+    assert v["ok"] is False and v["transitions"] == 1
+    snap = metrics.snapshot()
+    assert snap.get("obs_slo_ok{slo=converge_p99}") == 0
+    assert snap.get("obs_slo_breaches{slo=converge_p99}") == 1
+    c.scrape_once()                       # still breached: no new event
+    assert eng.verdicts["converge_p99"]["transitions"] == 1
+    c.scrape_once()                       # recovered
+    v = eng.verdicts["converge_p99"]
+    assert v["ok"] is True and v["transitions"] == 2
+    snap = metrics.snapshot()
+    assert snap.get("obs_slo_ok{slo=converge_p99}") == 1
+    assert snap.get("obs_slo_breaches{slo=converge_p99}") == 1
+    verdict_events = [e for e in flightrec.events()
+                      if e["kind"] == "slo_verdict"]
+    assert len(verdict_events) == 2       # breach + recovery, no heartbeat
+
+
+def test_slo_delta_signals_baseline_at_attach():
+    """watchdog_clean judges NEW fires on this engine's watch — a fleet
+    with historical fires still starts ok, and a fresh fire breaches."""
+    c = FleetCollector(interval_s=0.02)
+    src = _scripted(_snap(watchdog=5), _snap(watchdog=5),
+                    _snap(watchdog=6))
+    c.add_local("a", src)
+    eng = slo.SloEngine(slos=[
+        {"name": "watchdog_clean", "signal": "watchdog_fires",
+         "bound": 0, "delta": True}])
+    c.scrape_once()
+    eng.evaluate(c)
+    assert eng.verdicts["watchdog_clean"]["ok"] is True
+    c.scrape_once()
+    eng.evaluate(c)
+    assert eng.verdicts["watchdog_clean"]["ok"] is True
+    c.scrape_once()                       # one NEW fire
+    eng.evaluate(c)
+    assert eng.verdicts["watchdog_clean"]["ok"] is False
+
+
+def test_slo_no_data_is_neither_ok_nor_breach():
+    c = FleetCollector(interval_s=0.02)
+    c.add_local("a", _scripted({}))       # no oplag, no anything
+    eng = slo.SloEngine(slos=[
+        {"name": "converge_p99", "signal": "converge_p99_s",
+         "bound": 1.0}])
+    c.scrape_once()
+    eng.evaluate(c)
+    assert eng.verdicts["converge_p99"]["ok"] is None
+    assert not [e for e in flightrec.events()
+                if e["kind"] == "slo_verdict"]
+
+
+def test_retrace_budget_from_history(tmp_path):
+    path = tmp_path / "hist.jsonl"
+    with open(path, "w") as f:
+        for compiles in (10, 12, 14):
+            f.write(json.dumps({"schema": 1, "backend": "cpu",
+                                "value": 100,
+                                "perf": {"compiles_total": compiles}})
+                    + "\n")
+    budget = slo.retrace_budget_from_history(str(path))
+    assert budget == pytest.approx(12 * 1.5 + 2)
+    # an empty ledger yields None and the default spec SKIPS the SLO
+    assert slo.retrace_budget_from_history(
+        str(tmp_path / "missing.jsonl")) is None
+    eng = slo.SloEngine(history_path=str(tmp_path / "missing.jsonl"))
+    c = FleetCollector(interval_s=0.02)
+    c.add_local("a", _scripted(_snap(retraced=999)))
+    c.scrape_once()
+    eng.evaluate(c)
+    assert eng.verdicts["retrace_stability"]["ok"] is None
+
+
+# ---------------------------------------------------------------------------
+# doctor post-mortem modes
+
+
+def test_doctor_dump_correlates_watchdog_with_holders():
+    dump = {
+        "reason": "watchdog:sync_hashes_fanout",
+        "metrics": _snap(ops=10, flush_s=0.2, flush_n=5, lockw=80.0,
+                         watchdog=1),
+        "watchdog_events": [{
+            "name": "sync_hashes_fanout", "budget_s": 120.0,
+            "elapsed_s": 130.0, "at": 1000.0, "spans": {},
+            "lock_holders": {"service": {
+                "thread": "amtpu-chaos-lockhold",
+                "site": "chaos.py:180", "held_s": 42.0}},
+        }],
+        "threads": {"amtpu-tcp-read-1": [
+            {"seq": 1, "t": 999.0, "thread": "amtpu-tcp-read-1",
+             "kind": "oplag_stage", "id": "aa", "stage": "converge",
+             "s": 4.2},
+            {"seq": 2, "t": 999.5, "thread": "amtpu-tcp-read-1",
+             "kind": "dispatch", "kernel": "apply_doc",
+             "retraced": True},
+        ]},
+    }
+    report = doctor.diagnose_dump(dump)
+    causes = {c["cause"]: c for c in report["causes"]}
+    assert report["causes"][0]["cause"] == "watchdog_stall"
+    # the join: the stalled watchdog names WHO held WHAT
+    assert any("amtpu-chaos-lockhold" in ev
+               for ev in causes["watchdog_stall"]["evidence"])
+    assert "lock_contention" in causes
+    kinds = [r["kind"] for r in report["timeline"]]
+    assert "watchdog_fire" in kinds and "oplag_spike" in kinds \
+        and "retrace" in kinds
+    # timeline is time-ordered
+    ts = [r["t"] for r in report["timeline"] if r.get("t")]
+    assert ts == sorted(ts)
+    lines = doctor.report_lines(report)
+    assert any("watchdog_stall" in line for line in lines)
+
+
+def test_doctor_detail_reports_gc_and_frame_loss():
+    detail = {"configs": {
+        "8": {"round_max_cause": "round 3: 2 GC collection(s) landed "
+                                 "in it",
+              "round_max_s": 1.4, "round_s": 0.2,
+              "metrics": _snap(ops=10, flush_s=1.0, flush_n=10)},
+        "11": {"metrics": _snap(ops=10, flush_s=0.01, flush_n=10,
+                                drops=25)},
+    }}
+    reports = doctor.diagnose_detail(detail)
+    assert len(reports) == 2
+    by_label = {r["label"]: r for r in reports}
+    causes8 = [c["cause"] for c in by_label["config 8"]["causes"]]
+    assert "gc_pressure" in causes8
+    causes11 = {c["cause"]: c for c in by_label["config 11"]["causes"]}
+    assert "frame_loss" in causes11
+    # config filter
+    only = doctor.diagnose_detail(detail, config="8")
+    assert [r["label"] for r in only] == ["config 8"]
+
+
+def test_doctor_cli_post_mortem_and_missing(tmp_path, capsys):
+    from automerge_tpu.perf.__main__ import main as perf_main
+
+    # a flight-recorder dump file round-trips through the CLI
+    dump_path = tmp_path / "dump.json"
+    with open(dump_path, "w") as f:
+        json.dump({"reason": "test", "metrics": _snap(drops=3),
+                   "watchdog_events": [], "threads": {}}, f)
+    rc = perf_main(["doctor", "--post-mortem", str(dump_path)])
+    out = capsys.readouterr().out
+    assert rc == 0 and "perf doctor" in out and "frame_loss" in out
+    # a missing default detail is a graceful no-op, not a failure
+    rc = perf_main(["doctor", "--post-mortem",
+                    str(tmp_path / "nope.json")])
+    assert rc == 0
+    assert "nothing to diagnose" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# perf top renderer
+
+
+def test_top_render_and_sparkline():
+    from automerge_tpu.perf.top import render, spark
+
+    assert spark([]) == ""
+    line = spark([0, 1, 2, 3])
+    assert len(line) == 4 and line[0] == "▁" and line[-1] == "█"
+
+    c = FleetCollector(interval_s=0.02, min_nodes=3)
+    c.add_local("a", _scripted(_snap(), _snap(ops=60, flush_s=0.06,
+                                              flush_n=30, conv=0.01)),
+                role="peer")
+    c.add_local("b", _scripted(_snap(), _snap(ops=60, flush_s=0.06,
+                                              flush_n=30, conv=0.01)),
+                role="peer")
+    c.add_local("x", _scripted(_snap(), _snap(ops=10, flush_s=4.0,
+                                              flush_n=10, conv=2.0)),
+                role="peer")
+    eng = slo.SloEngine(slos=[{"name": "converge_p99",
+                               "signal": "converge_p99_s", "bound": 1.0}])
+    c.slo_engine = eng
+    c.scrape_once()
+    time.sleep(0.02)
+    c.scrape_once()
+    lines = render(c, eng)
+    text = "\n".join(lines)
+    assert "STRAGGLER" in text and "x" in text
+    assert "BREACH" in text          # conv 2.0 > bound 1.0 fleet max
+    assert "straggler(s)" in lines[0]
+
+
+# ---------------------------------------------------------------------------
+# perf-history gate: collector scrape budget (config 11)
+
+
+def test_history_gate_scrape_budget(tmp_path):
+    path = tmp_path / "hist.jsonl"
+
+    def rec(scrape_p50):
+        return {"schema": 1, "at": 1.0, "source": "bench.py",
+                "backend": "cpu", "headline_config": "5", "value": 100,
+                "unit": "ops/sec", "configs": {
+                    "11": {"scrape_p50_s": scrape_p50,
+                           "faults_attributed": 3,
+                           "collector_overhead_pct": 0.9,
+                           "round_overhead_pct": 0.4}}}
+
+    with open(path, "w") as f:
+        f.write(json.dumps(rec(0.01)) + "\n")
+    code, lines = history.check(path=str(path))
+    text = "\n".join(lines)
+    assert code == 0 and "fleet-health scrape p50" in text
+    assert "3/3 fault classes attributed" in text
+
+    with open(path, "a") as f:
+        f.write(json.dumps(rec(history.SCRAPE_BUDGET_S * 2)) + "\n")
+    code, lines = history.check(path=str(path))
+    assert code == 1
+    assert any("SCRAPE OVER BUDGET" in line for line in lines)

@@ -208,6 +208,96 @@ def test_append_after_torn_tail_repairs_not_glues(tmp_path):
     assert metrics.snapshot().get("sync_archive_tail_repaired")
 
 
+def test_first_archive_append_fsyncs_directory(tmp_path, monkeypatch):
+    """ADVICE low #1 (landed r8, pinned here): the FIRST creation of a
+    doc's archive file must fsync the containing directory before
+    append() returns — archive_log_prefix truncates the RAM log right
+    after, so losing the brand-new directory entry in a crash would lose
+    the only copy of the archived prefix. Later appends to the existing
+    file must NOT re-pay the directory fsync."""
+    import os as _os
+
+    from automerge_tpu.sync.logarchive import LogArchive
+
+    d = history(6)
+    chs = changes_of(d)
+    arch = LogArchive(str(tmp_path / "a"))
+    dir_syncs = []
+    real = LogArchive._fsync_dir
+    monkeypatch.setattr(
+        LogArchive, "_fsync_dir",
+        lambda self: (dir_syncs.append(self.root), real(self))[1])
+    arch.append("d", chs[:3])
+    assert dir_syncs == [arch.root]     # first creation: directory synced
+    assert _os.path.exists(arch._path("d"))
+    arch.append("d", chs[3:])
+    assert dir_syncs == [arch.root]     # existing file: no re-sync
+    arch.append("d2", chs[:2])          # a NEW doc's file: synced again
+    assert dir_syncs == [arch.root, arch.root]
+
+
+def test_cold_read_parses_outside_lock_and_caches_prefix(tmp_path):
+    """ADVICE low #2 (landed r8, pinned here): repeated cold reads of an
+    unchanged archive are served from the parsed-prefix cache (one
+    parse, keyed by file identity), the cache invalidates when the file
+    grows, and the O(history) parse itself runs with the archive lock
+    RELEASED — a concurrent append must be able to take the lock while
+    a slow read is mid-parse."""
+    import threading
+
+    from automerge_tpu.sync import logarchive as la
+
+    metrics.reset()
+    d = history(8)
+    chs = changes_of(d)
+    arch = la.LogArchive(str(tmp_path / "a"))
+    arch.append("d", chs[:4])
+    assert len(arch.read("d")) == 4     # cold: parses
+    m0 = metrics.snapshot().get("sync_archive_reads_cached", 0)
+    assert len(arch.read("d")) == 4     # warm: cache hit
+    assert metrics.snapshot().get("sync_archive_reads_cached", 0) == m0 + 1
+    arch.append("d", chs[4:6])          # file identity moved
+    assert len(arch.read("d")) == 6     # re-parse, not a stale serve
+    assert metrics.snapshot().get("sync_archive_reads_cached", 0) == m0 + 1
+
+    # the parse runs outside the lock: stall the parse via a slow json
+    # decode and assert an append can acquire the archive lock meanwhile.
+    # Grow the file first so the stalled read is a genuine re-parse,
+    # not a cache hit.
+    arch.append("d", chs[6:8])
+    parse_started = threading.Event()
+    release_parse = threading.Event()
+    real_loads = la.json.loads
+    stall = {"on": False}
+
+    def slow_loads(s, *a, **kw):
+        if stall["on"]:
+            parse_started.set()
+            release_parse.wait(timeout=10.0)
+        return real_loads(s, *a, **kw)
+
+    la.json.loads = slow_loads
+    try:
+        stall["on"] = True
+        out: list = []
+        t = threading.Thread(
+            target=lambda: out.append(arch.read("d")),
+            name="amtpu-test-coldread", daemon=True)
+        t.start()
+        assert parse_started.wait(timeout=10.0)
+        # the reader is mid-parse NOW; the archive lock must be free
+        got_lock = arch._lock.acquire(timeout=5.0)
+        assert got_lock, "cold-read parse held the archive lock"
+        arch._lock.release()
+        stall["on"] = False
+        release_parse.set()
+        t.join(timeout=10.0)
+        assert not t.is_alive() and len(out[0]) == 8
+    finally:
+        la.json.loads = real_loads
+        release_parse.set()
+
+
 def test_post_rebuild_overlap_is_not_served_twice(tmp_path):
     """After a rebuild restores the full log to RAM, a later PARTIAL
     re-archive leaves the archive holding more than the horizon covers;
