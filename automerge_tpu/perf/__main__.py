@@ -1,13 +1,19 @@
 """CLI for the performance plane: `python -m automerge_tpu.perf
-{report,check,contention,doctor,top,roofline,resident}`
+{report,check,contention,doctor,explain,top,roofline,resident}`
 (docs/OBSERVABILITY.md "Performance plane" / "Contention & convergence
-lag" / "Fleet health").
+lag" / "Fleet health" / "Per-doc ledger & perf explain").
 
-- `doctor` — ranked root-cause report: live against a fleet
+- `doctor`  — ranked root-cause report: live against a fleet
   (--connect), or post-mortem against a BENCH_DETAIL.json / flight-
   recorder dump (--post-mortem; default: the repo BENCH_DETAIL.json).
-- `top`    — live terminal dashboard (fleet table, SLO verdict strip,
-  sparklines) driven by the fleet collector (perf/fleet.py).
+- `explain` — per-DOC causal convergence debugger over the docledger
+  sections: `perf explain <doc>` names the blocking cause (frame loss
+  at the sender, epoch-buffered, causal queue, stalled connection);
+  without a doc it lists the worst-lagging docs. Same three modes as
+  the doctor (local capture, --connect, --post-mortem).
+- `top`     — live terminal dashboard (fleet table, SLO verdict strip,
+  sparklines, per-doc hot list) driven by the fleet collector
+  (perf/fleet.py).
 
 Exit codes: 0 = ok (including a gracefully skipped check), 1 = the
 regression gate tripped, 2 = usage error.
@@ -165,6 +171,9 @@ def main(argv=None) -> int:
     if cmd == "doctor":
         from . import doctor
         return doctor.main(rest)
+    if cmd == "explain":
+        from . import explain
+        return explain.main(rest)
     if cmd == "top":
         from . import top
         return top.main(rest)
@@ -177,7 +186,8 @@ def main(argv=None) -> int:
         resident.main(rest)
         return 0
     print(f"unknown command {cmd!r}; expected one of "
-          "report, check, contention, doctor, top, roofline, resident",
+          "report, check, contention, doctor, explain, top, roofline, "
+          "resident",
           file=sys.stderr)
     return 2
 

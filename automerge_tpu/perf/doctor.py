@@ -30,6 +30,10 @@ Cause classes (stable identifiers — the bench asserts on them):
     retrace_storm    jit compile-cache misses on the hot path
     gc_pressure      GC passes landing inside timed regions
     watchdog_stall   a watched region overran its budget (with holders)
+    doc_stall        specific DOCS are behind a peer's advertised
+                     frontier (the docledger section) — the evidence
+                     names them and points at `perf explain <doc>` for
+                     the per-doc causal walk (perf/explain.py)
 
 CLI: `python -m automerge_tpu.perf doctor [--post-mortem PATH]
 [--config N] [--json] [--connect host:port,... --ticks N]`. With no
@@ -219,6 +223,22 @@ def diagnose_snapshot(snapshot: dict, label: str = "snapshot",
         _cause(causes, "frame_loss", None, float(drops), [
             f"{int(drops)} outgoing change frame(s) dropped before the "
             f"socket write ({int(sent)} sent)"])
+
+    # per-doc convergence join (sync/docledger.py): lagging docs in the
+    # snapshot's ledger section become a doc_stall cause whose evidence
+    # hands off to the per-doc debugger
+    from .explain import hot_docs, views_from_snapshot
+    rows = hot_docs(views_from_snapshot(snapshot), limit=4)
+    if rows:
+        ev = [f"doc {r['doc']!r} @ {r['node']}: {r['lag_changes']} "
+              f"change(s) / {r['lag_s']:.3f}s behind "
+              f"{r['behind_peer'] or '?'}"
+              + (f", {r['buffered']} buffered" if r["buffered"] else "")
+              for r in rows]
+        ev.append("run `perf explain <doc>` for the per-doc causal walk")
+        _cause(causes, "doc_stall", None,
+               sum(r["lag_s"] for r in rows)
+               + 0.1 * sum(r["lag_changes"] for r in rows), ev)
 
     retraced = sum(v for k, v in snapshot.items()
                    if isinstance(v, (int, float))

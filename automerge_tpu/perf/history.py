@@ -105,6 +105,14 @@ DEFAULT_FLATNESS_MAX = 1.5
 #: collector_overhead SLO default (perf/slo.py DEFAULT_SCRAPE_P50_S).
 SCRAPE_BUDGET_S = 0.25
 
+#: per-doc convergence-ledger gate (r11, config 12): the ledger's own
+#: duty cycle (mutation-path self time / traffic wall, worst node) must
+#: stay under this ABSOLUTE percentage — doc-granular observability that
+#: taxes the sync hot path more than 2% is not "observability", it is
+#: the workload. Absolute for the same reason as the scrape budget: the
+#: cost is a property of the ledger code, not of the traffic mix.
+LEDGER_BUDGET_PCT = 2.0
+
 #: config-8 fields copied into the history record's `fleet` section
 FLEET_KEYS = ("fleet_hashes_s", "fleet_hashes_first_s",
               "fleet_hashes_clean_shards", "fleet_hashes_dirty_shards",
@@ -200,7 +208,19 @@ def _norm_configs(raw) -> dict:
                                        "collector_duty_cycle_pct",
                                        "round_overhead_pct",
                                        "hashes_overhead_pct",
-                                       "faults_attributed")
+                                       "faults_attributed",
+                                       # per-doc sync observability
+                                       # (r11, config 12): lag
+                                       # percentiles, mesh redundancy,
+                                       # ledger duty cycle, explain
+                                       # attribution
+                                       "doc_lag_p50_s", "doc_lag_p99_s",
+                                       "doc_lag_max_s",
+                                       "redundancy_ratio",
+                                       "redundancy_floor",
+                                       "ledger_overhead_pct",
+                                       "explain_attributed",
+                                       "mesh_nodes")
                      if isinstance(v.get(k), (int, float, str))}
         elif isinstance(v, (int, float)):
             entry = {"speedup": v}
@@ -589,6 +609,43 @@ def check(path: str | None = None, record: dict | None = None,
                 f"  fleet-health: {att if att is not None else '?'}/3 "
                 "fault classes attributed; collector duty-cycle bound "
                 f"{ovh if ovh is not None else '?'}%")
+
+    # per-doc ledger gate (r11, config 12): the convergence ledger's own
+    # duty cycle must stay under the ABSOLUTE budget (LEDGER_BUDGET_PCT
+    # — a property of the ledger code, like the scrape budget).
+    # Skip-clean: runs without config 12 never fail. The redundancy
+    # ratio and explain attribution are reported alongside — the ratio
+    # is the full-mesh baseline partial replication will improve, so it
+    # is informational here, asserted against its analytic floor inside
+    # the bench config itself.
+    def _dl(r: dict):
+        return ((r.get("configs") or {}).get("12") or {})
+
+    cur_lp = _dl(current).get("ledger_overhead_pct")
+    if isinstance(cur_lp, (int, float)):
+        verdict = ("OK" if cur_lp <= LEDGER_BUDGET_PCT
+                   else "LEDGER OVER BUDGET")
+        lines.append(
+            f"  doc-ledger duty cycle (config 12): {cur_lp:.3f}% "
+            f"(budget <= {LEDGER_BUDGET_PCT}%) -> {verdict}")
+        if cur_lp > LEDGER_BUDGET_PCT:
+            rc = 1
+        red = _dl(current).get("redundancy_ratio")
+        fl = _dl(current).get("redundancy_floor")
+        att = _dl(current).get("explain_attributed")
+        extra = []
+        if isinstance(red, (int, float)):
+            extra.append(f"mesh redundancy x{red}"
+                         + (f" (analytic floor {fl})"
+                            if isinstance(fl, (int, float)) else ""))
+        p99 = _dl(current).get("doc_lag_p99_s")
+        if isinstance(p99, (int, float)):
+            extra.append(f"doc-lag p99 {p99}s")
+        if att is not None:
+            extra.append("explain attribution "
+                         + ("OK" if att else "MISS"))
+        if extra:
+            lines.append("  doc-ledger: " + "; ".join(extra))
 
     # keystroke-flatness gate (r8, config 7): latency at 4x document
     # length over 1x must stay under the ceiling. A RATIO is

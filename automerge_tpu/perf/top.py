@@ -10,7 +10,11 @@ One screen, three bands (docs/OBSERVABILITY.md "Fleet health"):
   scrape age (stale nodes are the collector's dead-peer signal);
 - **per-stage sparklines** — the ring history of the headline signals
   (converge p99, round-flush mean, ops/s) for the busiest node, so a
-  spike's shape is visible without leaving the terminal.
+  spike's shape is visible without leaving the terminal;
+- the **per-doc hot list** — the worst-lagging docs across every
+  scraped node's convergence ledger (the `"docledger"` snapshot
+  section, sync/docledger.py), with the `perf explain <doc>` handle for
+  the causal walk.
 
 Keys (tty only): `q` quit · `p` pause/resume scraping ·
 `d` dump a `perf doctor` live report to a file and show the path.
@@ -105,7 +109,32 @@ def render(collector, slo_engine=None, width: int = 100) -> list[str]:
             if series:
                 lines.append(f"{focus} {label:<9} {spark(series)} "
                              f"{_fmt(series[-1], nd=4)}")
+    lines.extend(hot_doc_lines(collector))
     return [line[:width] for line in lines]
+
+
+def hot_doc_lines(collector, limit: int = 5) -> list[str]:
+    """The per-doc hot-list band: worst converge lag across every
+    scraped node's ledger section (each NodeState keeps the node's last
+    full snapshot, so the panel costs no extra wire traffic). Empty when
+    no node ships a ledger — the band simply disappears."""
+    from .explain import hot_docs, merge_views, views_from_snapshot
+
+    parts = []
+    for st in collector.nodes.values():
+        if isinstance(st.last_snapshot, dict):
+            parts.append(views_from_snapshot(st.last_snapshot))
+    rows = hot_docs(merge_views(parts), limit=limit)
+    if not rows:
+        return []
+    lines = ["hot docs (converge lag; `perf explain <doc>`):"]
+    for r in rows:
+        lines.append(
+            f"  {str(r['doc'])[:24]:<24} @ {str(r['node'])[:10]:<10} "
+            f"{r['lag_changes']:>5} chg {_fmt(r['lag_s'], 's'):>9} "
+            f"behind {r['behind_peer'] or '?'}"
+            + (f"  [{r['buffered']} buffered]" if r["buffered"] else ""))
+    return lines
 
 
 def _read_key(timeout: float) -> str | None:

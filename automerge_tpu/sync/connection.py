@@ -28,8 +28,10 @@ from typing import Callable
 
 from ..core import clock as C
 from ..core.change import coerce_change
-from ..utils import metrics, oplag
-from .frames import OPLAG_KEY, TRACE_KEY, pack_trace, unpack_trace
+from ..utils import chaos, metrics, oplag
+from . import docledger
+from .frames import (OPLAG_KEY, TRACE_KEY, msg_kind, pack_trace,
+                     unpack_trace)
 
 
 class Connection:
@@ -57,6 +59,12 @@ class Connection:
         self.peer_metrics: dict | None = None
         self.peer_metrics_at: float | None = None
         self.peer_node: str | None = None
+        # operator-set peer name for the per-doc ledger's lanes (takes
+        # precedence over peer_node; unset peers get positional labels).
+        # Cross-node `perf explain` joins lanes by these labels, so a
+        # mesh that names its connections after the remote node gets
+        # exact sender-side attribution.
+        self.peer_label: str | None = None
         self.on_peer_metrics: Callable[[dict], None] | None = None
         # last span ring the peer shipped (request_metrics(spans=True)) —
         # merge with the local one via metrics.merge_timeline
@@ -80,6 +88,10 @@ class Connection:
         # whole receive->apply->gossip span.
         self._state_lock = contextlib.nullcontext()
         self._apply_lock = contextlib.nullcontext()
+        # per-doc convergence ledger (sync/docledger.py): shared with the
+        # doc_set's other connections, so one node's lanes live in one
+        # table. None when AMTPU_DOCLEDGER=0 — every hook below no-ops.
+        self._ledger = docledger.of(doc_set)
 
     # -- lifecycle (connection.js:49-56) ------------------------------------
 
@@ -102,6 +114,8 @@ class Connection:
         self._doc_set.unregister_handler(self.doc_changed)
         if self._floor_sink is not None:
             self._floor_sink.forget_peer(self)
+        if self._ledger is not None:
+            self._ledger.forget_conn(self)
 
     # -- sending (connection.js:58-79) --------------------------------------
 
@@ -119,18 +133,35 @@ class Connection:
         thread is already inside a span (a round flush, a serve-and-relay
         chain) INHERIT that trace — a change propagating A→B→C is one
         trace id across all three replicas."""
+        metrics.bump("sync_conn_msgs_sent", kind=msg_kind(msg))
         with metrics.trace("sync_msg_send") as span:
             msg[TRACE_KEY] = pack_trace({"tid": span.trace_id,
                                          "sid": span.span_id})
             self._send_msg(msg)
 
     def send_msg(self, doc_id: str, clock: dict, changes=None) -> None:
+        if changes is not None and chaos.stall_doc(
+                getattr(self._doc_set, "_chaos_node", None), doc_id):
+            # chaos per-doc stall (utils/chaos.py AMTPU_CHAOS_STALL_DOC):
+            # the CHANGES are dropped but the message degrades to a
+            # clock-only advert — chaos never blinds the instruments,
+            # and the advert is precisely what lets the peer's ledger
+            # SEE the frontier it cannot reach (the lag `perf explain`
+            # then walks back to this sender's drop counter). Counted on
+            # the same loss series the transport injector uses, and
+            # per-doc in the ledger.
+            metrics.bump("sync_frames_dropped")
+            if self._ledger is not None:
+                self._ledger.record_drop(doc_id, self)
+            changes = None
         msg: dict = {"docId": doc_id, "clock": dict(clock)}
         self._our_clock = self._clock_union(self._our_clock, doc_id, clock)
+        nbytes = None
         if changes is not None:
             if self._wire == "columnar":
                 from .frames import encode_frame
                 msg["frame"] = encode_frame(changes)
+                nbytes = len(msg["frame"])
                 metrics.bump("sync_frames_sent")
                 metrics.bump("sync_frame_bytes_sent", len(msg["frame"]))
             else:
@@ -141,6 +172,10 @@ class Connection:
             hdr = oplag.wire_header(doc_id)
             if hdr is not None:
                 msg[OPLAG_KEY] = hdr
+        if self._ledger is not None:
+            self._ledger.record_send(
+                doc_id, self, len(changes) if changes is not None else 0,
+                nbytes=nbytes)
         self._send_traced(msg)
 
     def maybe_send_changes(self, doc_id: str) -> None:
@@ -253,7 +288,43 @@ class Connection:
         with metrics.adopt_context(ctx), metrics.trace("sync_msg_serve"):
             return self._receive_msg(msg)
 
+    def _account_delivery(self, doc_id: str, pairs,
+                          nbytes: int | None) -> None:
+        """Split a delivered change batch into useful vs duplicate
+        against the pre-apply local clock and record both globally
+        (`sync_conn_changes_*` — the redundancy ratio's two legs) and
+        per (doc, peer) in the ledger. `pairs` is [(actor, seq), ...].
+        Changes ahead of the frontier count as useful even when they
+        park in the causal queue first — they are new information; only
+        already-covered (actor, seq) pairs are wasted wire work.
+
+        The frontier comes from the ledger's LOCK-FREE peek, never from
+        clock_of(): a locked read here would re-serialize the whole
+        receive hot path on the service lock (and inline-flush the epoch
+        buffer before every apply — exactly what concurrent_ingest
+        transports exist to avoid), with the cost invisible to the
+        ledger's own duty-cycle gate. An indeterminate peek (cold cache)
+        counts the whole batch useful — duplicates are only counted when
+        the frontier is cheaply known, so the redundancy ratio is a
+        LOWER bound, and a slightly stale cached clock errs the same
+        safe direction."""
+        if self._ledger is None:
+            return
+        pre = self._ledger._peek_local_clock(doc_id)
+        if pre is None:
+            dup = 0
+        else:
+            dup = sum(1 for a, s in pairs if s <= pre.get(a, 0))
+        useful = len(pairs) - dup
+        if useful:
+            metrics.bump("sync_conn_changes_delivered", useful)
+        if dup:
+            metrics.bump("sync_conn_changes_duplicate", dup)
+        self._ledger.record_receive(doc_id, self, useful, dup,
+                                    nbytes=nbytes)
+
     def _receive_msg(self, msg: dict):
+        metrics.bump("sync_conn_msgs_received", kind=msg_kind(msg))
         # metrics / audit serving touches only thread-safe surfaces (the
         # metrics registry; the engine's audit/hash caches) — served
         # outside the transport state lock, so one peer's audit pull no
@@ -273,11 +344,20 @@ class Connection:
                     self._their_clock, doc_id, msg["clock"])
             if self._floor_sink is not None:
                 self._floor_sink.note_peer_clock(self, doc_id, msg["clock"])
+            if self._ledger is not None:
+                # the ledger's frontier lane: what this peer claims to
+                # have, vs the local clock it peeks lock-free
+                self._ledger.record_advert(doc_id, self, msg["clock"])
         if msg.get("frame") is not None:
             from .frames import decode_frame
             metrics.bump("sync_frames_received")
             metrics.bump("sync_frame_bytes_received", len(msg["frame"]))
             cols = decode_frame(msg["frame"])
+            self._account_delivery(
+                doc_id,
+                [(cols.actors[int(a)], int(s))
+                 for a, s in zip(cols.change_actor, cols.change_seq)],
+                len(msg["frame"]))
             # DocSets exposing a column ingress get the decoded columns
             # as-is (the engine service's native-encoder seam); plain
             # DocSets materialize changes from them. The apply runs
@@ -293,9 +373,11 @@ class Connection:
             oplag.peer_applied(lag)
             return out
         if msg.get("changes") is not None:
+            chs = [coerce_change(c) for c in msg["changes"]]
+            self._account_delivery(doc_id,
+                                   [(c.actor, c.seq) for c in chs], None)
             with self._apply_lock:
-                out = self._doc_set.apply_changes(
-                    doc_id, [coerce_change(c) for c in msg["changes"]])
+                out = self._doc_set.apply_changes(doc_id, chs)
             oplag.peer_applied(lag)
             return out
 

@@ -191,6 +191,13 @@ class EpochIngestBuffer:
         or serve the pre-append snapshot — both linearizable outcomes)."""
         return doc_id in self._stripe_of(doc_id).doc_counts
 
+    def doc_count(self, doc_id: str) -> int:
+        """Un-sealed buffered entries for ONE doc (lock-free dict peek,
+        same linearizability argument as has()) — the per-doc ledger's
+        "parked in the epoch buffer" signal (sync/docledger.py) and a
+        `perf explain` blocking-cause input."""
+        return self._stripe_of(doc_id).doc_counts.get(doc_id, 0)
+
     def empty(self) -> bool:
         return all(not s.entries for s in self._stripes)
 

@@ -78,6 +78,24 @@ TRACE_KEY = "trace"
 OPLAG_KEY = "oplag"
 
 
+def msg_kind(msg: dict) -> str:
+    """Coarse protocol-message class: the label space of the per-kind
+    traffic accounting (`sync_conn_msgs_*{kind=...}` /
+    `sync_conn_bytes_*{kind=...}`) and of flight-recorder frame
+    breadcrumbs. Lives here (not sync/tcp.py, its original home) so the
+    transport-agnostic Connection classifies without a transport
+    import."""
+    if "metrics" in msg:
+        return f"metrics:{msg['metrics']}"
+    if "audit" in msg:
+        return f"audit:{msg['audit']}"
+    if msg.get("frame") is not None:
+        return "frame"
+    if msg.get("changes") is not None:
+        return "changes"
+    return "clock"
+
+
 def pack_trace(ctx: dict) -> str:
     """`{"tid": ..., "sid": ...}` -> compact `tid-sid` wire header."""
     return f"{ctx['tid']}-{ctx.get('sid') or ''}"

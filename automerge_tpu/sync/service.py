@@ -33,7 +33,7 @@ from ..core.change import Change
 from ..engine.resident import ResidentDocSet
 from ..engine.resident_rows import CompactionAnchorError, DeviceDispatchError
 from ..utils import chaos, flightrec, lockprof, metrics, oplag, perfscope
-from . import epochs
+from . import docledger, epochs
 
 
 class _HandleOpSet:
@@ -314,6 +314,12 @@ class EngineDocSet:
         # hooks are one cached check when AMTPU_CHAOS_* is unset.
         self._chaos_node: str | None = None
         self._chaos_holder = chaos.maybe_lock_holder(self._lock)
+        # Per-doc convergence ledger (sync/docledger.py): admissions are
+        # stamped at flush time, peer frontiers by the attached
+        # Connections, and the nested "docledger" snapshot section rides
+        # every metrics pull / flight-recorder dump this node serves.
+        # None when AMTPU_DOCLEDGER=0.
+        self.doc_ledger = docledger.of(self)
 
     # -- peer registry / compaction floor -----------------------------------
 
@@ -461,6 +467,8 @@ class EngineDocSet:
                 log.setdefault(c.actor, []).append(c)
             if admitted:
                 self._bump_read_vers_locked((doc_id,))
+                if self.doc_ledger is not None:
+                    self.doc_ledger.note_admit(doc_id, len(admitted))
             records = (diffs or {}).get(doc_id, [])
             if records:
                 from ..engine.diffs import MirrorDoc
@@ -938,6 +946,15 @@ class EngineDocSet:
                 d for d in pending if _changed(d))
             raise
         admitted = [d for d in pending if _changed(d)]
+        if self.doc_ledger is not None:
+            # per-doc admission stamps (counts only — the ledger's flush
+            # contract forbids clock reads here; lag restamps ride the
+            # read cache). Submitted-change counts, not post-dedup: the
+            # ledger's usefulness split happens at DELIVERY, this stamp
+            # marks frontier movement + recency.
+            for d in admitted:
+                self.doc_ledger.note_admit(
+                    d, sum(int(p.n_changes) for p in pending[d]))
         if self.handlers:
             # no registered handlers -> no notifications to queue: the
             # post-flush drain then needs no service-lock reacquisition
@@ -1053,6 +1070,10 @@ class EngineDocSet:
         if self._chaos_holder is not None:
             self._chaos_holder.stop()
             self._chaos_holder = None
+        # the closed node's ledger leaves the snapshot section (late
+        # hooks on still-attached connections keep working against the
+        # detached object)
+        docledger.detach(self)
 
     def batch(self):
         """Context manager: coalesce every ingress inside the block into

@@ -34,6 +34,8 @@ import threading
 
 from ..utils import chaos, lockprof
 from .connection import Connection
+from .frames import msg_kind as _msg_kind   # canonical home: frames.py
+
 
 def _sync_lock_of(doc_set) -> threading.RLock:
     """The doc_set-wide reentrant lock serializing transport entry points."""
@@ -83,26 +85,18 @@ def decode_msg(payload: bytes) -> dict:
     return msg
 
 
-def _msg_kind(msg: dict) -> str:
-    """Coarse message class for flight-recorder breadcrumbs."""
-    if "metrics" in msg:
-        return f"metrics:{msg['metrics']}"
-    if "audit" in msg:
-        return f"audit:{msg['audit']}"
-    if msg.get("frame") is not None:
-        return "frame"
-    if msg.get("changes") is not None:
-        return "changes"
-    return "clock"
-
-
 def send_frame(sock: socket.socket, msg: dict) -> None:
     from ..utils import flightrec, metrics
     payload = encode_msg(msg)
+    kind = _msg_kind(msg)
     sock.sendall(_HEADER.pack(len(payload)) + payload)
     metrics.bump("sync_msgs_sent")
     metrics.bump("sync_wire_bytes_sent", _HEADER.size + len(payload))
-    flightrec.record("frame_send", kind=_msg_kind(msg),
+    # per-kind wire accounting (the docledger plane's exact-bytes side:
+    # who pays for adverts vs changes vs audit vs metrics pulls)
+    metrics.bump("sync_conn_bytes_sent", _HEADER.size + len(payload),
+                 kind=kind)
+    flightrec.record("frame_send", kind=kind,
                      doc=msg.get("docId"), n=len(payload))
 
 
@@ -120,7 +114,10 @@ def recv_frame(sock: socket.socket) -> dict | None:
     metrics.bump("sync_msgs_received")
     metrics.bump("sync_wire_bytes_received", _HEADER.size + length)
     msg = decode_msg(payload)
-    flightrec.record("frame_recv", kind=_msg_kind(msg),
+    kind = _msg_kind(msg)
+    metrics.bump("sync_conn_bytes_received", _HEADER.size + length,
+                 kind=kind)
+    flightrec.record("frame_recv", kind=kind,
                      doc=msg.get("docId"), n=length)
     return msg
 

@@ -24,6 +24,12 @@ hooks matching the three failure classes the doctor distinguishes:
   Telemetry/audit/clock messages are never dropped — chaos degrades the
   data plane, not the instruments observing it (a fault injector that
   blinds the collector proves nothing).
+- **doc-stall** (`AMTPU_CHAOS_STALL_DOC=<doc_id>`): outgoing
+  change-bearing messages for EXACTLY one doc are suppressed at the
+  Connection layer (sync/connection.py `send_msg`) — the per-doc fault
+  class the convergence ledger + `perf explain` must localize (bench
+  config 12). Every other doc keeps syncing; the victim doc's clock
+  keeps being advertised, so peers SEE the frontier they cannot reach.
 
 Targeting: `AMTPU_CHAOS_NODE=<label>` restricts injection to services /
 transports whose owner set `_chaos_node` to that label — needed when
@@ -65,7 +71,7 @@ DEFAULT_HOLD_EVERY_S = 0.2
 
 class _Config:
     __slots__ = ("slow_apply_s", "lock_hold_s", "lock_hold_every_s",
-                 "drop_frames", "node", "any")
+                 "drop_frames", "stall_doc_id", "node", "any")
 
     def __init__(self):
         def _f(name, default=0.0):
@@ -78,9 +84,10 @@ class _Config:
         self.lock_hold_every_s = max(
             0.001, _f("AMTPU_CHAOS_LOCK_HOLD_EVERY_S", DEFAULT_HOLD_EVERY_S))
         self.drop_frames = min(1.0, max(0.0, _f("AMTPU_CHAOS_DROP_FRAMES")))
+        self.stall_doc_id = os.environ.get("AMTPU_CHAOS_STALL_DOC") or None
         self.node = os.environ.get("AMTPU_CHAOS_NODE") or None
         self.any = bool(self.slow_apply_s or self.lock_hold_s
-                        or self.drop_frames)
+                        or self.drop_frames or self.stall_doc_id)
 
 
 _config: _Config | None = None
@@ -142,6 +149,24 @@ def drop_frame(node: str | None = None, kind: str = "frame") -> bool:
     if random.random() >= c.drop_frames:
         return False
     _disclose("frame_drop", node, kind=kind)
+    return True
+
+
+def stall_doc(node: str | None, doc_id: str) -> bool:
+    """True when outgoing change-bearing messages for EXACTLY this doc
+    should be suppressed (`AMTPU_CHAOS_STALL_DOC=<doc_id>`): the per-doc
+    stall the doc-granular observability plane must localize — every
+    OTHER doc keeps syncing, clock adverts keep flowing, and only the
+    victim doc's changes die at the sender. Transport-agnostic: the hook
+    sits in Connection.send_msg, so in-process meshes degrade the same
+    way TCP fleets do. Caller counts the drop (sync_frames_dropped +
+    the ledger's per-doc drop lane)."""
+    c = _cfg()
+    if c.stall_doc_id is None or not _match(c, node):
+        return False
+    if doc_id != c.stall_doc_id:
+        return False
+    _disclose("doc_stall", node, doc=doc_id)
     return True
 
 

@@ -173,6 +173,37 @@ COUNTERS: dict[str, str] = {
     "sync_frames_dropped":
         "outgoing change-bearing messages dropped before the socket "
         "write (sync/tcp.py; transport failure or injected fault)",
+    # per-connection traffic accounting (sync/connection.py + sync/tcp.py
+    # + sync/docledger.py): protocol messages split by frame KIND
+    # (advert/changes/frame/audit/metrics — frames.msg_kind), and the
+    # delivered-change usefulness split the redundancy ratio reads off.
+    # Per-DOC splits live in the bounded docledger snapshot section, not
+    # in label space (doc ids are unbounded cardinality).
+    "sync_conn_msgs_sent":
+        "protocol messages sent by a Connection {kind=clock|changes|"
+        "frame|audit:*|metrics:*} (sync/connection.py; transport-"
+        "agnostic — counts in-process and TCP sends alike)",
+    "sync_conn_msgs_received":
+        "protocol messages received by a Connection {kind=...} "
+        "(sync/connection.py)",
+    "sync_conn_bytes_sent":
+        "framed wire bytes written, split by message kind {kind=...} "
+        "(sync/tcp.py send_frame; exact post-encode sizes)",
+    "sync_conn_bytes_received":
+        "framed wire bytes read, split by message kind {kind=...} "
+        "(sync/tcp.py recv_frame)",
+    "sync_conn_changes_delivered":
+        "received changes that advanced (or will advance) the local "
+        "frontier — NOT already covered by the local clock at delivery "
+        "(sync/connection.py; the redundancy ratio's denominator)",
+    "sync_conn_changes_duplicate":
+        "received changes already covered by the local clock at "
+        "delivery — wasted wire work the engine dedups away "
+        "(sync/connection.py; the redundancy ratio's numerator)",
+    # per-doc convergence ledger (sync/docledger.py)
+    "obs_doc_evictions":
+        "tracked docs evicted from the ledger's top-K table into the "
+        "aggregate bucket (sync/docledger.py; bounded-memory policy)",
     # obs — the observability subsystem's own signals
     "obs_watchdog_fired": "watchdog budget overruns {name=...}",
     "obs_budget_exceeded": "trace(budget_s=...) post-hoc overruns {name=...}",
@@ -180,7 +211,8 @@ COUNTERS: dict[str, str] = {
     # fleet health plane (perf/fleet.py, perf/slo.py, utils/chaos.py)
     "obs_chaos_injected":
         "chaos fault injections fired {fault=slow_apply|lock_hold|"
-        "frame_drop} (utils/chaos.py; inert unless AMTPU_CHAOS_* set)",
+        "frame_drop|doc_stall} (utils/chaos.py; inert unless "
+        "AMTPU_CHAOS_* set)",
     "obs_fleet_stragglers_flagged":
         "straggler flags raised by the fleet collector {node=...} "
         "(perf/fleet.py; counted on the transition into flagged)",
@@ -238,6 +270,28 @@ GAUGES: dict[str, str] = {
         "(perf/fleet.py; >= K sigma flags the node)",
     "obs_slo_ok":
         "current SLO verdict {slo=...} (perf/slo.py; 1 ok / 0 breach)",
+    # per-doc convergence ledger (sync/docledger.py): doc-population
+    # percentiles over the tracked top-K set, refreshed whenever the
+    # ledger snapshot section is exported (no doc-id labels — unbounded)
+    "obs_doc_tracked":
+        "docs tracked exactly by the convergence ledger "
+        "(sync/docledger.py; bounded at its top-K)",
+    "obs_doc_lagging":
+        "tracked docs currently behind some peer's advertised frontier "
+        "(sync/docledger.py)",
+    "obs_doc_converge_lag_p50_s":
+        "median per-doc convergence lag over tracked docs, seconds "
+        "behind the most-advanced peer advert (sync/docledger.py)",
+    "obs_doc_converge_lag_p99_s":
+        "p99 per-doc convergence lag over tracked docs "
+        "(sync/docledger.py)",
+    "obs_doc_converge_lag_max_s":
+        "max per-doc convergence lag over tracked docs "
+        "(sync/docledger.py)",
+    "obs_doc_redundancy_ratio":
+        "duplicate deliveries / useful deliveries since reset "
+        "(sync/docledger.py; the full-mesh fan-out waste partial "
+        "replication exists to shrink)",
 }
 
 HISTOGRAMS: dict[str, str] = {
@@ -262,6 +316,10 @@ HISTOGRAMS: dict[str, str] = {
     "obs_fleet_scrape_s":
         "wall seconds of one fleet-collector scrape tick (perf/fleet.py; "
         "the self-overhead the collector_overhead SLO bounds)",
+    "obs_doc_ledger_s":
+        "convergence-ledger self-time flushed per snapshot export "
+        "(sync/docledger.py; sum/elapsed = the duty-cycle bound the "
+        "config-12 perf-check gate holds under 2%)",
 }
 
 SPANS: dict[str, str] = {
@@ -573,6 +631,25 @@ def add_time(_name: str, _seconds: float, **labels) -> None:
     _global.add_time(_name, _seconds, **labels)
 
 
+# Extension snapshot sections: a subsystem that cannot live in utils/
+# (the per-doc ledger is sync-layer code) registers a provider here and
+# its nested section rides every snapshot() — and therefore every
+# metrics-pull answer, flight-recorder dump, and bench config capture —
+# without utils importing the owning package. Providers run OUTSIDE the
+# metrics lock (they may bump their own gauges), must return a
+# json.dumps-clean dict (or None/{} to skip), and must be PURE functions
+# of their subsystem's state: no wall-clock reads at export time, so two
+# back-to-back snapshots with no traffic in between compare equal.
+_section_providers: dict[str, object] = {}
+
+
+def register_snapshot_section(name: str, provider) -> None:
+    """Register (or replace) a nested snapshot section provider.
+    `provider()` is called by every snapshot(); a raising provider is
+    skipped — telemetry must never take down the caller."""
+    _section_providers[name] = provider
+
+
 def snapshot() -> dict:
     """Flat metrics view plus — when the performance plane has recorded
     anything since the last reset — a nested `"perf"` section
@@ -594,6 +671,13 @@ def snapshot() -> dict:
         lag = None
     if lag:
         out["oplag"] = lag
+    for name, provider in list(_section_providers.items()):
+        try:
+            sec = provider()
+        except Exception:
+            sec = None
+        if sec:
+            out[name] = sec
     return out
 
 
@@ -613,6 +697,24 @@ def reset() -> None:
         oplag.reset()
     except Exception:
         pass
+    # registered section providers observe the reset through their own
+    # reset hook, if they installed one (sync/docledger.py: clears every
+    # live ledger so a post-reset snapshot() is {} again)
+    for hook in list(_section_reset_hooks):
+        try:
+            hook()
+        except Exception:
+            pass
+
+
+_section_reset_hooks: list = []
+
+
+def register_reset_hook(hook) -> None:
+    """Subsystems whose snapshot section must clear on reset() (the
+    per-config bench captures depend on it) register a zero-arg hook."""
+    if hook not in _section_reset_hooks:
+        _section_reset_hooks.append(hook)
 
 
 def recent_spans() -> list[dict]:

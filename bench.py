@@ -1766,6 +1766,359 @@ def run_fleet_health_config(n_peers=3, traffic_s=6.0, interval_s=0.4):
     }
 
 
+# ---------------------------------------------------------------------------
+# config 12: per-doc sync observability — zipf mesh ledger + perf explain
+
+
+def _zipf_picker(n: int, s: float, rng):
+    """Doc picker with zipf(s) popularity over n docs (deterministic via
+    rng): real traffic is a few hot docs and a long cold tail — exactly
+    the interest skew partial replication (ROADMAP #3) will exploit, and
+    the shape that makes per-doc lag percentiles non-trivial."""
+    import bisect
+
+    weights = [1.0 / ((k + 1) ** s) for k in range(n)]
+    total = sum(weights)
+    cum, acc = [], 0.0
+    for w in weights:
+        acc += w / total
+        cum.append(acc)
+
+    def pick() -> int:
+        return min(n - 1, bisect.bisect_left(cum, rng.random()))
+    return pick
+
+
+class _MeshLinks:
+    """Round-stamped message queues for an in-process full mesh: each
+    directed (i, j) link delivers a message `delay[i][j]` traffic rounds
+    after it was sent. Deterministic latency without threads — the lag
+    the ledger measures is the queue depth times the round pacing, and
+    duplicate gossip arises exactly as it does on a real mesh (B relays
+    A's change to C before C's advert suppresses it)."""
+
+    def __init__(self, n: int, delay_fn):
+        from collections import deque
+        self.q = {(i, j): deque() for i in range(n) for j in range(n)
+                  if i != j}
+        self.delay = {(i, j): delay_fn(i, j) for (i, j) in self.q}
+        self.round = 0
+
+    def send(self, i: int, j: int, msg: dict) -> None:
+        self.q[(i, j)].append((self.round, msg))
+
+    def deliver_due(self, receive_fn) -> int:
+        """Deliver every message whose latency elapsed; returns count."""
+        n = 0
+        for (i, j), q in self.q.items():
+            lim = self.round - self.delay[(i, j)]
+            while q and q[0][0] <= lim:
+                _, msg = q.popleft()
+                receive_fn(i, j, msg)
+                n += 1
+        return n
+
+    def drain_all(self, receive_fn) -> None:
+        """Deliver everything regardless of latency, repeatedly (each
+        delivery can gossip new messages) until the mesh quiesces."""
+        for _ in range(10_000):
+            if not any(self.q.values()):
+                return
+            for (i, j), q in self.q.items():
+                while q:
+                    _, msg = q.popleft()
+                    receive_fn(i, j, msg)
+        raise AssertionError("mesh failed to quiesce (gossip loop?)")
+
+
+def _build_mesh(n_nodes: int, label_fn=None):
+    """n_nodes rows services fully connected through _MeshLinks. Returns
+    (services, conns[i][j], links). Connections are labeled with the
+    REMOTE node's name, so cross-node ledger joins (perf explain's
+    sender-side attribution) are exact."""
+    from automerge_tpu.sync.connection import Connection
+    from automerge_tpu.sync.service import EngineDocSet
+
+    label_fn = label_fn or (lambda k: f"n{k}")
+    svcs = []
+    for k in range(n_nodes):
+        svc = EngineDocSet(backend="rows")
+        svc._chaos_node = label_fn(k)
+        if svc.doc_ledger is not None:
+            svc.doc_ledger.label = label_fn(k)
+        svcs.append(svc)
+    links = _MeshLinks(n_nodes, lambda i, j: 1)
+    conns: dict = {}
+    for i in range(n_nodes):
+        for j in range(n_nodes):
+            if i == j:
+                continue
+            conn = Connection(svcs[i],
+                              (lambda m, i=i, j=j: links.send(i, j, m)),
+                              wire="columnar")
+            conn.peer_label = label_fn(j)
+            conns[(i, j)] = conn
+    for c in conns.values():
+        c.open()
+    return svcs, conns, links
+
+
+def run_doc_obs_config(n_nodes=4, n_docs=48, rounds=200, ops_per_round=3,
+                       zipf_s=1.1, round_sleep_s=0.004):
+    """Config 12: per-doc sync observability on a zipf-interest full
+    mesh. Four claims, each asserted in-run:
+
+    1. the convergence ledger reports per-doc converge-lag percentiles
+       (per-doc PEAK lag over the run, percentiles across the doc
+       population — hot zipf docs lag more on the slow link);
+    2. the full-mesh redundancy ratio (duplicate/useful deliveries) is
+       at least the analytic floor (n_nodes-2)/2 — naive full-mesh
+       flooding re-delivers each change to every non-origin node from up
+       to n-2 extra relays; clock-advert races suppress at most about
+       half, hence the half-credit floor. This is the baseline number
+       interest-based partial replication (ROADMAP #3) will improve;
+    3. `perf explain` names the correct blocking cause for a
+       chaos-injected per-doc stall (AMTPU_CHAOS_STALL_DOC on one node:
+       expected doc_frame_loss at that node);
+    4. the ledger's own duty cycle (mutation-path self time / traffic
+       wall, worst node) stays under 2% — gated again in `perf check`
+       (perf/history.py LEDGER_BUDGET_PCT).
+
+    The mesh is in-process with round-stamped link queues (one slow
+    link) — deterministic latency without subprocess flakiness; the
+    ledger/gossip code under test is byte-identical to the TCP posture
+    (Connection + EngineDocSet, columnar wire)."""
+    import random
+
+    from automerge_tpu.core.change import Change, Op
+    from automerge_tpu.core.ids import ROOT_ID
+    from automerge_tpu.perf import explain as explain_mod
+    from automerge_tpu.utils import metrics as metrics_mod
+
+    rng = random.Random(12)
+    pick = _zipf_picker(n_docs, zipf_s, rng)
+    svcs, conns, links = _build_mesh(n_nodes)
+    # one SLOW link pair: changes crossing it arrive 12 rounds late —
+    # the induced (honest, measured) converge lag the percentiles report
+    links.delay[(0, n_nodes - 1)] = 12
+    links.delay[(n_nodes - 1, 0)] = 12
+
+    def receive(i, j, msg):
+        conns[(j, i)].receive_msg(msg)
+
+    seqs: dict = {}
+    docs = [f"doc{d:03d}" for d in range(n_docs)]
+    peak_lag_s = {d: 0.0 for d in docs}
+    peak_lag_chg = {d: 0 for d in docs}
+    lag_samples = 0
+    total_ops = 0
+    try:
+        t0 = time.perf_counter()
+        with _quiet_traceback_dumps():
+            for r in range(rounds):
+                links.round = r
+                for _ in range(ops_per_round):
+                    node = rng.randrange(n_nodes)
+                    d = docs[pick()]
+                    key = (node, d)
+                    seqs[key] = seqs.get(key, 0) + 1
+                    svcs[node].apply_changes(d, [Change(
+                        actor=f"A{node}", seq=seqs[key], deps={},
+                        ops=[Op("set", ROOT_ID, key=f"f{r % 4}",
+                                value=r)])])
+                    total_ops += 1
+                links.deliver_due(receive)
+                if r % 8 == 7:
+                    # per-doc peak lag, live ages (behind_since -> now)
+                    now = time.time()
+                    lag_samples += 1
+                    for svc in svcs:
+                        led = svc.doc_ledger
+                        if led is None:
+                            continue
+                        sec = led.section() or {}
+                        for d, e in (sec.get("docs") or {}).items():
+                            bs = e.get("behind_since")
+                            if isinstance(bs, (int, float)):
+                                peak_lag_s[d] = max(
+                                    peak_lag_s.get(d, 0.0), now - bs)
+                            peak_lag_chg[d] = max(
+                                peak_lag_chg.get(d, 0),
+                                int(e.get("lag_changes") or 0))
+                time.sleep(round_sleep_s)
+            traffic_wall = time.perf_counter() - t0
+            # full drain to convergence (and assert it): the ledger must
+            # agree everything caught up
+            for _ in range(50):
+                links.round += 100
+                links.drain_all(receive)
+                for svc in svcs:
+                    svc.flush()
+                if not any(q for q in links.q.values()):
+                    break
+            hashes = [svc.hashes() for svc in svcs]
+            for h in hashes[1:]:
+                assert h == hashes[0], (
+                    "mesh failed to converge: per-doc hashes differ "
+                    f"({sum(1 for d in h if h.get(d) != hashes[0].get(d))}"
+                    " docs)")
+            views = explain_mod.gather_local()
+            still = explain_mod.hot_docs(views)
+            assert not still, f"ledger still reports lag at quiescence: {still}"
+
+        # redundancy, fleet-wide (per-config registry: the worker resets
+        # metrics before each config)
+        snap = metrics_mod.snapshot()
+        useful = int(snap.get("sync_conn_changes_delivered", 0))
+        dup = int(snap.get("sync_conn_changes_duplicate", 0))
+        assert useful > 0, "no useful deliveries recorded"
+        ratio = dup / useful
+        floor = (n_nodes - 2) / 2.0
+        assert ratio >= floor, (
+            f"full-mesh redundancy {ratio:.3f} below the analytic floor "
+            f"{floor} — duplicate accounting is under-counting")
+        # ledger duty cycle: worst single node's mutation-path self time
+        # over the traffic wall (one node per process in production)
+        self_s = [svc.doc_ledger.self_seconds() for svc in svcs
+                  if svc.doc_ledger is not None]
+        ledger_pct = round(100.0 * max(self_s) / traffic_wall, 3)
+        fleet_ledger_pct = round(100.0 * sum(self_s) / traffic_wall, 3)
+        assert ledger_pct < 2.0, (
+            f"ledger duty cycle {ledger_pct}% breaches the 2% budget")
+        kinds = {k: v for k, v in snap.items()
+                 if k.startswith("sync_conn_msgs_sent{")}
+        lag_vals = sorted(peak_lag_s[d] for d in docs)
+        n = len(lag_vals)
+    finally:
+        for c in conns.values():
+            try:
+                c.close()
+            except Exception:
+                pass
+        for svc in svcs:
+            svc.close()
+
+    explain_rec = _doc_obs_explain_subrun()
+    lagged = sum(1 for v in lag_vals if v > 0)
+    return {
+        "config": 12,
+        "name": CONFIGS[12][0],
+        "docs": n_docs,
+        "ops": total_ops,
+        "mesh_nodes": n_nodes,
+        "zipf_s": zipf_s,
+        "slow_link_delay_rounds": 12,
+        "doc_lag_p50_s": round(lag_vals[n // 2], 4),
+        "doc_lag_p99_s": round(lag_vals[min(n - 1,
+                                            int(0.99 * (n - 1)))], 4),
+        "doc_lag_max_s": round(lag_vals[-1], 4),
+        "doc_lag_docs_lagged": lagged,
+        "doc_lag_peak_changes_max": max(peak_lag_chg.values()),
+        "lag_samples": lag_samples,
+        "redundancy_ratio": round(ratio, 3),
+        "redundancy_floor": floor,
+        "redundancy_useful": useful,
+        "redundancy_duplicate": dup,
+        "redundancy_note": (
+            "duplicate/useful deliveries over the whole mesh run; the "
+            f"analytic floor (n-2)/2 = {floor} is naive full-mesh "
+            "flooding (each change re-delivered by up to n-2 relays) "
+            "half-credited for clock-advert suppression. This is the "
+            "BASELINE number interest-based partial replication "
+            "(ROADMAP #3) exists to shrink"),
+        "conn_msgs_by_kind": kinds,
+        "ledger_overhead_pct": ledger_pct,
+        "ledger_overhead_fleet_pct": fleet_ledger_pct,
+        "ledger_self_s": round(max(self_s), 5),
+        "traffic_wall_s": round(traffic_wall, 3),
+        "explain": explain_rec,
+        "explain_attributed": int(bool(explain_rec.get("attributed"))),
+        "engine_s": round(traffic_wall, 3),
+        "oracle_s": None,
+        "speedup": None,
+        "parity": True,
+    }
+
+
+def _doc_obs_explain_subrun(n_nodes=3, traffic_rounds=40):
+    """The induced-stall proof: a fresh mesh with AMTPU_CHAOS_STALL_DOC
+    set for one node (n1) and one doc — n1's change-bearing sends of
+    that doc are suppressed at the Connection layer while everything
+    else (other docs, clock adverts) keeps flowing. `perf explain` must
+    rank doc_frame_loss@n1 first for the victim doc."""
+    import random
+
+    from automerge_tpu.core.change import Change, Op
+    from automerge_tpu.core.ids import ROOT_ID
+    from automerge_tpu.perf import explain as explain_mod
+    from automerge_tpu.utils import chaos as chaos_mod
+
+    victim_doc, victim_node = "stalled-doc", "n1"
+    os.environ["AMTPU_CHAOS_STALL_DOC"] = victim_doc
+    os.environ["AMTPU_CHAOS_NODE"] = victim_node
+    chaos_mod.reload()
+    rng = random.Random(13)
+    svcs, conns, links = _build_mesh(n_nodes)
+
+    def receive(i, j, msg):
+        conns[(j, i)].receive_msg(msg)
+
+    seqs: dict = {}
+    try:
+        with _quiet_traceback_dumps():
+            for r in range(traffic_rounds):
+                links.round = r
+                # n1 keeps editing the victim doc (its sends stall) ...
+                seqs["v"] = seqs.get("v", 0) + 1
+                svcs[1].apply_changes(victim_doc, [Change(
+                    actor="A1", seq=seqs["v"], deps={},
+                    ops=[Op("set", ROOT_ID, key="k", value=r)])])
+                # ... while every node keeps normal traffic flowing
+                node = rng.randrange(n_nodes)
+                d = f"bg{rng.randrange(6)}"
+                key = (node, d)
+                seqs[key] = seqs.get(key, 0) + 1
+                svcs[node].apply_changes(d, [Change(
+                    actor=f"A{node}", seq=seqs[key], deps={},
+                    ops=[Op("set", ROOT_ID, key="k", value=r)])])
+                links.deliver_due(receive)
+                time.sleep(0.002)
+            links.round += 100
+            links.drain_all(receive)
+            views = explain_mod.gather_local()
+            report = explain_mod.explain_doc(victim_doc, views,
+                                             now=time.time())
+    finally:
+        del os.environ["AMTPU_CHAOS_STALL_DOC"]
+        del os.environ["AMTPU_CHAOS_NODE"]
+        chaos_mod.reload()
+        for c in conns.values():
+            try:
+                c.close()
+            except Exception:
+                pass
+        for svc in svcs:
+            svc.close()
+    top = (report["causes"] or [{}])[0]
+    attributed = (top.get("cause") == "doc_frame_loss"
+                  and top.get("node") == victim_node)
+    assert attributed, (
+        f"perf explain ranked {top.get('cause')}@{top.get('node')} "
+        f"first for the chaos-stalled doc, expected "
+        f"doc_frame_loss@{victim_node}; causes="
+        f"{[(c['cause'], c['node'], c['score']) for c in report['causes'][:4]]}")
+    return {
+        "doc": victim_doc,
+        "injected": "doc_stall@" + victim_node,
+        "top_cause": top.get("cause"),
+        "top_node": top.get("node"),
+        "top_score": top.get("score"),
+        "attributed": attributed,
+        "causes": [{k: c[k] for k in ("cause", "node", "score")}
+                   for c in (report["causes"] or [])[:4]],
+    }
+
+
 CONFIGS = {
     1: ("single-doc LWW storm (2 actors x 1000 sets)", gen_lww_storm),
     2: ("nested JSON card board (8 actors)", gen_trellis),
@@ -1780,6 +2133,8 @@ CONFIGS = {
          "(1% concurrent, span plane)", None),
     11: ("fleet health: fault injection, straggler + doctor attribution",
          None),
+    12: ("per-doc sync observability: zipf-mesh convergence ledger, "
+         "redundancy accounting + perf explain", None),
 }
 
 
@@ -2408,6 +2763,8 @@ def run_config(cfg: int, n_docs: int | None = None, oracle_cap_docs=12000):
         return run_bulk_merge_config()
     if cfg == 11:
         return run_fleet_health_config()
+    if cfg == 12:
+        return run_doc_obs_config()
     name, gen = CONFIGS[cfg]
     kwargs = {}
     if cfg == 5 and n_docs:
@@ -2659,6 +3016,22 @@ def _final_record(results_by_cfg: dict, backend: str | None, attempts: list):
                 "faults": r["faults"],
                 "protocol": r["protocol"]}
                if r.get("config") == 11 else {}),
+            **({"doc_lag_p50_s": r["doc_lag_p50_s"],
+                "doc_lag_p99_s": r["doc_lag_p99_s"],
+                "doc_lag_max_s": r["doc_lag_max_s"],
+                "doc_lag_docs_lagged": r["doc_lag_docs_lagged"],
+                "redundancy_ratio": r["redundancy_ratio"],
+                "redundancy_floor": r["redundancy_floor"],
+                "redundancy_useful": r["redundancy_useful"],
+                "redundancy_duplicate": r["redundancy_duplicate"],
+                "redundancy_note": r["redundancy_note"],
+                "ledger_overhead_pct": r["ledger_overhead_pct"],
+                "ledger_overhead_fleet_pct":
+                    r["ledger_overhead_fleet_pct"],
+                "mesh_nodes": r["mesh_nodes"],
+                "explain_attributed": r["explain_attributed"],
+                "explain": r["explain"]}
+               if r.get("config") == 12 else {}),
             **({"fleet_load_ops_per_s": r["fleet_load_ops_per_s"],
                 "round_ops_per_s": r["round_ops_per_s"],
                 "round_cost_scaling": r[
@@ -2981,6 +3354,12 @@ def worker_main(args):
                     f"attributed, scrape p50 {r['scrape_p50_s']}s, "
                     f"collector overhead {r['collector_overhead_pct']}%"
                     if r.get("faults_attributed") is not None else
+                    f"redundancy x{r['redundancy_ratio']} (floor "
+                    f"{r['redundancy_floor']}), doc-lag p99 "
+                    f"{r['doc_lag_p99_s']}s, explain "
+                    f"{'OK' if r['explain_attributed'] else 'MISS'}, "
+                    f"ledger {r['ledger_overhead_pct']}%"
+                    if r.get("redundancy_ratio") is not None else
                     f"{r.get('round_ops_per_s', 0)} round ops/s")
         print(f"# config {cfg} [{r['name']}]: {r['ops']} ops, "
               f"{ora_note}engine {r['engine_s']:.3f}s "

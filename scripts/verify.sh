@@ -36,6 +36,8 @@ JAX_PLATFORMS=cpu python -m automerge_tpu.perf contention \
     || echo "contention report unavailable (informational — not a failure)"
 JAX_PLATFORMS=cpu python -m automerge_tpu.perf doctor --post-mortem BENCH_DETAIL.json \
     || echo "perf doctor unavailable (informational — not a failure)"
+JAX_PLATFORMS=cpu python -m automerge_tpu.perf explain --post-mortem BENCH_DETAIL.json \
+    || echo "perf explain unavailable (informational — not a failure)"
 
 echo "== stage 3/3: tier-1 suite (ROADMAP.md) =="
 set -o pipefail

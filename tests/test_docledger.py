@@ -1,0 +1,340 @@
+"""Per-doc convergence ledger (sync/docledger.py): frontier lanes,
+usefulness/duplicate accounting, bounded memory, pure-state export, and
+the connection/service/tcp hooks that feed it."""
+
+import json
+import os
+import time
+
+import pytest
+
+from automerge_tpu.core.change import Change, Op
+from automerge_tpu.core.ids import ROOT_ID
+from automerge_tpu.sync import docledger
+from automerge_tpu.sync.connection import Connection
+from automerge_tpu.sync.docledger import DocLedger
+from automerge_tpu.sync.service import EngineDocSet
+from automerge_tpu.utils import metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+def _chg(actor, seq, value=1):
+    return Change(actor=actor, seq=seq, deps={},
+                  ops=[Op("set", ROOT_ID, key="k", value=value)])
+
+
+def _pair(wire="columnar"):
+    """Two rows services synced over in-process queue connections, with
+    labeled lanes (the cross-node join perf explain needs)."""
+    a, b = EngineDocSet(backend="rows"), EngineDocSet(backend="rows")
+    qa, qb = [], []
+    ca = Connection(a, qa.append, wire=wire)
+    cb = Connection(b, qb.append, wire=wire)
+    ca.peer_label, cb.peer_label = "B", "A"
+    a.doc_ledger.label, b.doc_ledger.label = "A", "B"
+    ca.open()
+    cb.open()
+
+    def drain():
+        for _ in range(50):
+            if not (qa or qb):
+                return
+            while qa:
+                cb.receive_msg(qa.pop(0))
+            while qb:
+                ca.receive_msg(qb.pop(0))
+        raise AssertionError("pair failed to quiesce")
+    return a, b, ca, cb, drain
+
+
+def _close(*svcs):
+    for s in svcs:
+        s.close()
+
+
+# -- core lane mechanics ----------------------------------------------------
+
+
+def test_advert_vs_local_frontier_builds_lag_then_clears():
+    led = DocLedger(label="n")
+
+    class _Conn:
+        peer_label = "W"
+    conn = _Conn()
+    led.record_advert("d", conn, {"x": 3})
+    sec = led.section()
+    e = sec["docs"]["d"]
+    # no doc_set attached: local frontier indeterminate -> no deficit
+    # invented (lag stays 0 rather than lying)
+    assert e["lag_changes"] == 0
+
+    svc = EngineDocSet(backend="rows")
+    try:
+        led2 = svc.doc_ledger
+        led2.label = "n2"
+        led2.record_advert("d", conn, {"x": 3})
+        e = led2.section()["docs"]["d"]
+        # the service does NOT hold doc "d" at all: frontier {} by
+        # definition, the whole advert is deficit
+        assert e["lag_changes"] == 3
+        assert e["behind_peer"] == "W"
+        assert e["behind_since"] is not None
+        # catch up: admit the changes, then the export-time catchup
+        # (post-read cache warm) must clear the deficit
+        for s in (1, 2, 3):
+            svc.apply_changes("d", [_chg("x", s)])
+        svc.clock_of("d")               # warm the snapshot read cache
+        e = led2.section()["docs"]["d"]
+        assert e["lag_changes"] == 0
+        assert e["behind_since"] is None
+        assert e["lag_s"] == 0.0
+    finally:
+        _close(svc)
+
+
+def test_receive_split_counts_duplicates_and_redundancy():
+    a, b, ca, cb, drain = _pair()
+    try:
+        a.apply_changes("d", [_chg("x", 1)])
+        drain()
+        # re-deliver the same change out of band: the clock covers it,
+        # so it must count as duplicate wire work, not useful
+        from automerge_tpu.sync.frames import encode_frame
+        cb.receive_msg({"docId": "d", "clock": {"x": 1},
+                        "frame": encode_frame([_chg("x", 1)])})
+        snap = metrics.snapshot()
+        assert snap["sync_conn_changes_delivered"] >= 1
+        assert snap["sync_conn_changes_duplicate"] == 1
+        red = b.doc_ledger.redundancy()
+        assert red["duplicate"] == 1
+        assert red["ratio"] == round(1 / red["useful"], 4)
+        lane = b.doc_ledger.section()["docs"]["d"]["peers"]["A"]
+        assert lane["recv_duplicate"] == 1
+        assert lane["bytes_received"] > 0
+    finally:
+        _close(a, b)
+
+
+def test_changes_ahead_of_frontier_count_useful_not_duplicate():
+    """A causally-early delivery (seq 2 before seq 1) is NEW information
+    — it parks in the causal queue but is not wasted wire work."""
+    a, b, ca, cb, drain = _pair(wire="json")
+    try:
+        cb.receive_msg({"docId": "d", "clock": {"x": 2},
+                        "changes": [_chg("x", 2).to_dict()]})
+        snap = metrics.snapshot()
+        assert snap.get("sync_conn_changes_delivered") == 1
+        assert "sync_conn_changes_duplicate" not in snap
+    finally:
+        _close(a, b)
+
+
+def test_bounded_memory_evicts_lru_into_aggregate_keeping_laggards():
+    led = DocLedger(label="n", top_k=8)
+
+    class _Conn:
+        peer_label = "W"
+    conn = _Conn()
+    # make doc "behind0" permanently lagging (no doc_set -> use explicit
+    # receive counts only; mark behind via the entry directly)
+    for i in range(8):
+        led.record_receive(f"cold{i}", conn, 1, 0)
+    with led._lock:
+        led._docs["cold0"].behind_since = time.time()   # the laggard
+    for i in range(6):
+        led.record_receive(f"hot{i}", conn, 2, 1)
+    sec = led.section()
+    assert sec["tracked"] <= 8
+    assert sec["evictions"] == 6
+    assert metrics.snapshot()["obs_doc_evictions"] == 6
+    # the lagging doc survived every eviction scan; the evicted docs'
+    # counts folded into the aggregate bucket
+    assert "cold0" in sec["docs"]
+    assert sec["aggregate"]["docs"] == 6
+    assert sec["aggregate"]["recv_useful"] == 6
+    # global redundancy counters survive eviction untouched
+    assert sec["redundancy"]["useful"] == 8 + 12
+    assert sec["redundancy"]["duplicate"] == 6
+
+
+def test_section_is_pure_and_json_clean_and_resets():
+    a, b, ca, cb, drain = _pair()
+    try:
+        for s in (1, 2):
+            a.apply_changes("d", [_chg("x", s)])
+            drain()
+        s1 = metrics.snapshot()
+        s2 = metrics.snapshot()
+        assert s1 == s2, "snapshot export must be pure (no wall reads)"
+        assert json.loads(json.dumps(s1)) == s1
+        nodes = s1["docledger"]["nodes"]
+        assert set(nodes) == {"A", "B"}
+        assert nodes["B"]["docs"]["d"]["peers"]["A"]["recv_useful"] == 2
+        metrics.reset()
+        assert metrics.snapshot() == {}
+        # a still-live service re-registers on its next mutation
+        a.apply_changes("d", [_chg("x", 3)])
+        drain()
+        assert "docledger" in metrics.snapshot()
+    finally:
+        _close(a, b)
+
+
+def test_gauges_refresh_on_mutation_cadence():
+    led = DocLedger(label="n")
+
+    class _Conn:
+        peer_label = "W"
+    conn = _Conn()
+    for i in range(docledger.GAUGE_REFRESH):
+        led.record_receive("d", conn, 1, 1)
+    snap = metrics.snapshot()
+    assert snap["obs_doc_tracked"] == 1
+    assert snap["obs_doc_redundancy_ratio"] == 1.0
+    assert snap["obs_doc_ledger_s_count"] >= 1
+    assert snap["obs_doc_ledger_s_sum"] > 0
+
+
+def test_epoch_buffer_visibility_and_doc_count():
+    from automerge_tpu.native.wire import changes_to_columns
+    from automerge_tpu.sync.epochs import EpochIngestBuffer
+    buf = EpochIngestBuffer()
+    cols = changes_to_columns([_chg("x", 1)])
+    buf.append("d", cols, None)
+    buf.append("d", cols, None)
+    buf.append("e", cols, None)
+    assert buf.doc_count("d") == 2
+    assert buf.doc_count("e") == 1
+    assert buf.doc_count("zz") == 0
+    entries = buf.seal()
+    EpochIngestBuffer.resolve([e.ticket for e in entries])
+    assert buf.doc_count("d") == 0
+
+
+def test_disabled_plane_is_inert(monkeypatch):
+    monkeypatch.setenv("AMTPU_DOCLEDGER", "0")
+    docledger._reload_for_tests()
+    try:
+        svc = EngineDocSet(backend="rows")
+        try:
+            assert svc.doc_ledger is None
+            q = []
+            conn = Connection(svc, q.append, wire="columnar")
+            assert conn._ledger is None
+            conn.open()
+            svc.apply_changes("d", [_chg("x", 1)])
+            snap = metrics.snapshot()
+            assert "docledger" not in snap
+            assert not any(k.startswith("obs_doc_") for k in snap)
+            assert not any(k.startswith("sync_conn_changes_")
+                           for k in snap)
+        finally:
+            svc.close()
+    finally:
+        monkeypatch.delenv("AMTPU_DOCLEDGER")
+        docledger._reload_for_tests()
+
+
+def test_service_admission_stamps_and_forget_conn():
+    a, b, ca, cb, drain = _pair()
+    try:
+        a.apply_changes("d", [_chg("x", 1)])
+        drain()
+        e = a.doc_ledger.section()["docs"]["d"]
+        assert e["admitted"] == 1
+        assert e["last_admit_at"] is not None
+        assert "B" in e["peers"]
+        ca.close()
+        assert "B" not in a.doc_ledger.section()["docs"]["d"]["peers"]
+    finally:
+        _close(a, b)
+
+
+def test_tcp_per_kind_byte_accounting():
+    """Exact wire bytes split by kind over a real TCP pair, plus the
+    ledger lanes riding the same sync."""
+    from automerge_tpu.sync.tcp import TcpSyncClient, TcpSyncServer
+    a, b = EngineDocSet(backend="rows"), EngineDocSet(backend="rows")
+    server = TcpSyncServer(a, wire="columnar").start()
+    client = TcpSyncClient(b, "127.0.0.1", server.port,
+                           wire="columnar").start()
+    try:
+        b.apply_changes("d", [_chg("x", 1)])
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if a.clock_of("d") if "d" in a.doc_ids else {}:
+                break
+            time.sleep(0.02)
+        assert a.clock_of("d") == {"x": 1}
+        snap = metrics.snapshot()
+        by_kind = {k: v for k, v in snap.items()
+                   if k.startswith("sync_conn_bytes_")}
+        assert "sync_conn_bytes_sent{kind=frame}" in by_kind
+        assert "sync_conn_bytes_sent{kind=clock}" in by_kind
+        assert by_kind["sync_conn_bytes_sent{kind=frame}"] > \
+            by_kind["sync_conn_bytes_sent{kind=clock}"] / 10
+    finally:
+        client.close()
+        server.close()
+        _close(a, b)
+
+
+def test_refresh_clocks_restamps_against_locked_read():
+    svc = EngineDocSet(backend="rows")
+    try:
+        led = svc.doc_ledger
+
+        class _Conn:
+            peer_label = "W"
+        for s in (1, 2):
+            svc.apply_changes("d", [_chg("x", s)])
+        led.record_advert("d", _Conn(), {"x": 5})
+        # peek may or may not be warm; the explicit refresh must settle
+        # the deficit exactly against the locked read
+        assert led.refresh_clocks() >= 1
+        e = led.section()["docs"]["d"]
+        assert e["lag_changes"] == 3
+    finally:
+        _close(svc)
+
+
+def test_chaos_doc_stall_counts_and_adverts_still_flow(monkeypatch):
+    from automerge_tpu.utils import chaos
+    monkeypatch.setenv("AMTPU_CHAOS_STALL_DOC", "victim")
+    chaos.reload()
+    try:
+        a, b, ca, cb, drain = _pair()
+        try:
+            a.apply_changes("victim", [_chg("x", 1)])
+            a.apply_changes("ok", [_chg("x", 1)])
+            drain()
+            # the untouched doc synced; the victim's changes never left,
+            # but its clock advert DID (chaos never blinds instruments)
+            assert b.clock_of("ok") == {"x": 1}
+            assert "victim" not in b.doc_ids
+            snap = metrics.snapshot()
+            assert snap["sync_frames_dropped"] >= 1
+            assert snap["obs_chaos_injected{fault=doc_stall}"] >= 1
+            lane_b = b.doc_ledger.section()["docs"]["victim"]
+            assert lane_b["lag_changes"] == 1
+            lane_a = a.doc_ledger.section()["docs"]["victim"]
+            assert lane_a["peers"]["B"]["drops"] >= 1
+        finally:
+            _close(a, b)
+    finally:
+        monkeypatch.delenv("AMTPU_CHAOS_STALL_DOC")
+        chaos.reload()
+
+
+def test_chaos_stall_doc_inert_when_unset():
+    from automerge_tpu.utils import chaos
+    assert os.environ.get("AMTPU_CHAOS_STALL_DOC") is None
+    chaos.reload()
+    assert chaos.stall_doc(None, "any") is False
+    assert not chaos.enabled()

@@ -1,0 +1,291 @@
+"""`perf explain` — the per-doc causal convergence debugger
+(automerge_tpu/perf/explain.py): cause ranking over synthetic views,
+live in-process attribution of a chaos-injected doc stall, post-mortem
+reads, the doctor's doc_stall join, and the CLI contract."""
+
+import json
+import subprocess
+import sys
+import time
+
+import pytest
+
+from automerge_tpu.core.change import Change, Op
+from automerge_tpu.core.ids import ROOT_ID
+from automerge_tpu.perf import explain
+from automerge_tpu.utils import metrics
+
+NOW = 1_000_000.0
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+def _view(docs):
+    return {"label": "x", "tracked": len(docs), "top_k": 128,
+            "exported": len(docs), "evictions": 0,
+            "aggregate": {}, "redundancy": {}, "lag": {}, "docs": docs}
+
+
+def _entry(admitted=0, lag=0, behind=None, behind_since=None,
+           buffered=0, peers=None):
+    return {"admitted": admitted, "last_admit_at": None,
+            "buffered": buffered, "lag_changes": lag, "lag_s": 0.0,
+            "behind_since": behind_since, "behind_peer": behind,
+            "peers": peers or {}}
+
+
+def _lane(**kw):
+    lane = {"advert_total": 0, "advert_clock": {}, "last_advert_at": None,
+            "sent": 0, "last_send_at": None, "recv_useful": 0,
+            "recv_duplicate": 0, "last_recv_at": None, "bytes_sent": 0,
+            "bytes_received": 0, "drops": 0}
+    lane.update(kw)
+    return lane
+
+
+# -- cause ranking over synthetic views -------------------------------------
+
+
+def test_frame_loss_at_sender_ranks_first():
+    views = {
+        "Y": _view({"d": _entry(lag=3, behind="W", behind_since=NOW - 2,
+                                peers={"W": _lane(advert_total=3)})}),
+        "W": _view({"d": _entry(admitted=3,
+                                peers={"Y": _lane(drops=5, sent=0)})}),
+    }
+    rep = explain.explain_doc("d", views, now=NOW)
+    assert rep["causes"][0]["cause"] == "doc_frame_loss"
+    assert rep["causes"][0]["node"] == "W"
+    assert "DROPPED 5" in rep["causes"][0]["evidence"][0]
+    assert rep["frontiers"]["Y"]["lag_s"] == 2.0
+
+
+def test_epoch_buffered_and_causal_queue_causes():
+    views = {"Y": _view({
+        "d": _entry(admitted=1, lag=2, behind="W", behind_since=NOW - 1,
+                    buffered=4,
+                    peers={"W": _lane(recv_useful=3)})})}
+    rep = explain.explain_doc("d", views, now=NOW)
+    causes = {c["cause"]: c for c in rep["causes"]}
+    assert "doc_epoch_buffered" in causes
+    assert causes["doc_epoch_buffered"]["node"] == "Y"
+    assert "doc_causal_queue" in causes
+    assert "RECEIVED 2 more" in causes["doc_causal_queue"]["evidence"][0]
+
+
+def test_in_flight_vs_stalled_connection_split_on_recency():
+    fresh = {
+        "Y": _view({"d": _entry(lag=2, behind="W", behind_since=NOW - 1,
+                                peers={"W": _lane()})}),
+        "W": _view({"d": _entry(peers={
+            "Y": _lane(sent=2, last_send_at=NOW - 0.5)})}),
+    }
+    rep = explain.explain_doc("d", fresh, now=NOW)
+    assert rep["causes"][0]["cause"] == "doc_unacked_in_flight"
+    assert rep["causes"][0]["node"] == "W"
+
+    stalled = {
+        "Y": _view({"d": _entry(
+            lag=2, behind="W", behind_since=NOW - 30,
+            peers={"W": _lane(last_advert_at=NOW - 1,
+                              last_recv_at=NOW - 30)})}),
+    }
+    rep = explain.explain_doc("d", stalled, now=NOW)
+    assert rep["causes"][0]["cause"] == "doc_connection_stalled"
+    assert "still adverts" in rep["causes"][0]["evidence"][0]
+
+
+def test_never_framed_is_not_replicated():
+    views = {
+        "Y": _view({"d": _entry(lag=2, behind="W", behind_since=NOW - 9,
+                                peers={"W": _lane()})}),
+        "W": _view({"d": _entry(admitted=2,
+                                peers={"Y": _lane(sent=0)})}),
+    }
+    rep = explain.explain_doc("d", views, now=NOW)
+    assert rep["causes"][0]["cause"] == "doc_not_replicated"
+    assert rep["causes"][0]["node"] == "W"
+
+
+def test_converged_and_unseen_docs():
+    views = {"Y": _view({"d": _entry(admitted=3)})}
+    rep = explain.explain_doc("d", views, now=NOW)
+    assert rep["converged"] is True
+    assert rep["causes"] == []
+    assert "CONVERGED" in "\n".join(explain.report_lines(rep))
+
+    rep = explain.explain_doc("ghost", views, now=NOW)
+    assert rep["seen"] is False
+    assert "not present" in "\n".join(explain.report_lines(rep))
+
+
+def test_same_cause_same_node_rows_merge():
+    views = {
+        "Y": _view({"d": _entry(lag=3, behind="W", behind_since=NOW - 2,
+                                peers={"W": _lane()})}),
+        "Z": _view({"d": _entry(lag=2, behind="W", behind_since=NOW - 1,
+                                peers={"W": _lane()})}),
+        "W": _view({"d": _entry(
+            admitted=3, peers={"Y": _lane(drops=4),
+                               "Z": _lane(drops=4)})}),
+    }
+    rep = explain.explain_doc("d", views, now=NOW)
+    fl = [c for c in rep["causes"] if c["cause"] == "doc_frame_loss"]
+    assert len(fl) == 1, "two receivers blaming one sender merge"
+    assert len(fl[0]["evidence"]) == 2
+
+
+def test_hot_docs_ranking_and_lines():
+    views = {
+        "Y": _view({"a": _entry(lag=5, behind="W", behind_since=NOW - 3),
+                    "b": _entry(lag=1, behind="W", behind_since=NOW - 1),
+                    "c": _entry()}),
+    }
+    rows = explain.hot_docs(views, now=NOW)
+    assert [r["doc"] for r in rows] == ["a", "b"]
+    assert rows[0]["lag_s"] == 3.0
+    lines = "\n".join(explain.hot_lines(views))
+    assert "'a' @ Y: 5 change(s)" in lines
+    assert explain.hot_docs({}) == []
+
+
+def test_views_asof_uses_newest_stamp():
+    views = {"Y": _view({"d": _entry(
+        behind_since=NOW - 10,
+        peers={"W": _lane(last_advert_at=NOW)})})}
+    assert explain.views_asof(views) == NOW
+
+
+# -- live in-process + chaos ------------------------------------------------
+
+
+def _mesh_pair(monkeypatch):
+    from automerge_tpu.sync.connection import Connection
+    from automerge_tpu.sync.service import EngineDocSet
+    from automerge_tpu.utils import chaos
+    monkeypatch.setenv("AMTPU_CHAOS_STALL_DOC", "victim")
+    monkeypatch.setenv("AMTPU_CHAOS_NODE", "A")
+    chaos.reload()
+    a, b = EngineDocSet(backend="rows"), EngineDocSet(backend="rows")
+    a._chaos_node, b._chaos_node = "A", "B"
+    qa, qb = [], []
+    ca = Connection(a, qa.append, wire="columnar")
+    cb = Connection(b, qb.append, wire="columnar")
+    ca.peer_label, cb.peer_label = "B", "A"
+    a.doc_ledger.label, b.doc_ledger.label = "A", "B"
+    ca.open()
+    cb.open()
+
+    def drain():
+        for _ in range(50):
+            if not (qa or qb):
+                return
+            while qa:
+                cb.receive_msg(qa.pop(0))
+            while qb:
+                ca.receive_msg(qb.pop(0))
+    return a, b, drain
+
+
+def test_gather_local_attributes_injected_doc_stall(monkeypatch):
+    from automerge_tpu.utils import chaos
+    a, b, drain = _mesh_pair(monkeypatch)
+    try:
+        for s in (1, 2, 3):
+            a.apply_changes("victim", [Change(
+                actor="x", seq=s, deps={},
+                ops=[Op("set", ROOT_ID, key="k", value=s)])])
+            drain()
+        views = explain.gather_local()
+        rep = explain.explain_doc("victim", views, now=time.time())
+        top = rep["causes"][0]
+        assert (top["cause"], top["node"]) == ("doc_frame_loss", "A")
+        assert rep["frontiers"]["B"]["lag_changes"] == 3
+    finally:
+        monkeypatch.delenv("AMTPU_CHAOS_STALL_DOC")
+        monkeypatch.delenv("AMTPU_CHAOS_NODE")
+        chaos.reload()
+        a.close()
+        b.close()
+
+
+# -- post-mortem + doctor join + CLI ----------------------------------------
+
+
+def _stalled_snapshot():
+    return {"docledger": {"nodes": {
+        "Y": _view({"d": _entry(lag=3, behind="W",
+                                behind_since=NOW - 2,
+                                peers={"W": _lane()})}),
+        "W": _view({"d": _entry(admitted=3,
+                                peers={"Y": _lane(drops=5)})}),
+    }}}
+
+
+def test_post_mortem_views_from_dump_and_detail(tmp_path):
+    dump = dict(_stalled_snapshot())
+    p = tmp_path / "dump.json"
+    p.write_text(json.dumps({"reason": "test", "metrics": dump}))
+    sets = explain._post_mortem_view_sets(str(p))
+    assert len(sets) == 1 and sets[0][0] == "test"
+    views = sets[0][1]
+    assert set(views) == {"Y", "W"}
+    rep = explain.explain_doc("d", views)
+    assert rep["causes"][0]["cause"] == "doc_frame_loss"
+
+    # a BENCH_DETAIL yields one view set PER CONFIG, labels verbatim —
+    # decorating them would break the behind_peer sender-side join
+    detail = {"configs": {"12": {"metrics": dump},
+                          "11": {"metrics": {}}}}
+    p2 = tmp_path / "detail.json"
+    p2.write_text(json.dumps(detail))
+    sets = explain._post_mortem_view_sets(str(p2))
+    assert [s[0] for s in sets] == ["config 12"]
+    assert set(sets[0][1]) == {"Y", "W"}
+    rep = explain.explain_doc("d", sets[0][1])
+    assert rep["causes"][0]["cause"] == "doc_frame_loss", (
+        "the sender-side join must survive the detail post-mortem path")
+
+
+def test_doctor_snapshot_join_emits_doc_stall():
+    from automerge_tpu.perf.doctor import diagnose_snapshot
+    rep = diagnose_snapshot(_stalled_snapshot(), label="t")
+    causes = {c["cause"] for c in rep["causes"]}
+    assert "doc_stall" in causes
+    ds = next(c for c in rep["causes"] if c["cause"] == "doc_stall")
+    assert any("perf explain" in ev for ev in ds["evidence"])
+    assert any("'d' @ Y" in ev for ev in ds["evidence"])
+
+
+def test_cli_explain_contract(tmp_path):
+    dump = {"reason": "test", "metrics": _stalled_snapshot()}
+    p = tmp_path / "dump.json"
+    p.write_text(json.dumps(dump))
+    env = {"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+           "HOME": "/tmp"}
+    out = subprocess.run(
+        [sys.executable, "-m", "automerge_tpu.perf", "explain", "d",
+         "--post-mortem", str(p), "--json"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert out.returncode == 0, out.stderr
+    rep = json.loads(out.stdout)
+    assert rep["causes"][0]["cause"] == "doc_frame_loss"
+    # hot-list mode (no doc), plain rendering
+    out = subprocess.run(
+        [sys.executable, "-m", "automerge_tpu.perf", "explain",
+         "--post-mortem", str(p)],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "hot docs" in out.stdout
+    # absent file: graceful exit 0 (verify.sh stage-2 contract)
+    out = subprocess.run(
+        [sys.executable, "-m", "automerge_tpu.perf", "explain",
+         "--post-mortem", str(tmp_path / "missing.json")],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert out.returncode == 0
+    assert "nothing to read" in out.stdout

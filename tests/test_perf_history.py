@@ -440,3 +440,55 @@ def test_norm_configs_carries_span_plane_fields():
     assert out["configs"]["10"]["merge_ops_per_s"] == 9100
     assert out["configs"]["10"]["merge_speedup_vs_perop"] == 3.1
     assert out["configs"]["10"]["span_merge_s"] == 1.2
+
+
+def test_ledger_gate_budget_ok_over_and_absent(tmp_path):
+    """Config-12 doc-ledger duty-cycle gate (LEDGER_BUDGET_PCT): absolute
+    budget like the scrape gate — over fails, under passes, runs without
+    config 12 skip cleanly."""
+    p = str(tmp_path / "h.jsonl")
+
+    def lrec(pct, source="test"):
+        return _rec(1000, source=source,
+                    configs={"12": {"ledger_overhead_pct": pct,
+                                    "redundancy_ratio": 1.8,
+                                    "redundancy_floor": 1.0,
+                                    "doc_lag_p99_s": 0.09,
+                                    "explain_attributed": 1}})
+
+    _write(p, [lrec(0.5), lrec(0.9, source="ok")])
+    rc, lines = history.check(path=p)
+    assert rc == 0, lines
+    assert any("doc-ledger duty cycle" in ln and "OK" in ln
+               for ln in lines)
+    assert any("mesh redundancy x1.8" in ln and "floor 1.0" in ln
+               for ln in lines)
+    assert any("explain attribution OK" in ln for ln in lines)
+
+    _write(p, [lrec(0.5), lrec(3.7, source="heavy")])
+    rc, lines = history.check(path=p)
+    assert rc == 1
+    assert any("LEDGER OVER BUDGET" in ln for ln in lines)
+
+    _write(p, [lrec(0.5), _rec(1000, source="no-cfg12")])
+    rc, lines = history.check(path=p)
+    assert rc == 0
+    assert not any("doc-ledger" in ln for ln in lines)
+
+
+def test_norm_configs_carries_doc_obs_fields():
+    rec = {"backend": "cpu", "value": 10, "configs": {
+        "12": {"doc_lag_p50_s": 0.0, "doc_lag_p99_s": 0.09,
+               "doc_lag_max_s": 0.13, "redundancy_ratio": 1.85,
+               "redundancy_floor": 1.0, "ledger_overhead_pct": 0.56,
+               "explain_attributed": 1, "mesh_nodes": 4,
+               "redundancy_note": "dropped (string, non-numeric keys "
+                                  "only ride the detail sidecar)"}}}
+    out = history.record_from_bench(rec)
+    c12 = out["configs"]["12"]
+    assert c12["doc_lag_p99_s"] == 0.09
+    assert c12["redundancy_ratio"] == 1.85
+    assert c12["redundancy_floor"] == 1.0
+    assert c12["ledger_overhead_pct"] == 0.56
+    assert c12["explain_attributed"] == 1
+    assert c12["mesh_nodes"] == 4

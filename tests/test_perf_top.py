@@ -1,0 +1,203 @@
+"""Deterministic render tests for the `perf top` dashboard
+(automerge_tpu/perf/top.py): the SLO verdict strip, the fleet table
+(straggler/stale marks, column values), unicode sparklines, and the
+per-doc hot-list panel fed by the convergence ledger — all against a
+synthetic collector state, no TTY required."""
+
+import time
+
+import pytest
+
+from automerge_tpu.perf import slo
+from automerge_tpu.perf.fleet import FleetCollector
+from automerge_tpu.perf.top import hot_doc_lines, render, spark
+from automerge_tpu.utils import flightrec, metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_metrics():
+    metrics.reset()
+    flightrec.reset()
+    yield
+    metrics.reset()
+    flightrec.reset()
+
+
+def _snap(ops=0, flush_s=0.0, flush_n=0, lockw=0.0, drops=0, conv=None,
+          docledger=None):
+    out = {
+        "sync_ops_ingested": ops,
+        "sync_frames_dropped": drops,
+        "sync_round_flush_s": flush_s,
+        "sync_round_flush_count": flush_n,
+        "sync_lock_wait_s{lock=service}_sum": lockw,
+        "sync_lock_wait_s{lock=service}_count": 10,
+        "sync_lock_hold_s{lock=service}_sum": lockw * 1.5,
+    }
+    if conv is not None:
+        out["oplag"] = {"sample_rate": 4, "stages": {
+            "converge": {"count": 8, "p50_s": conv / 2, "p90_s": conv,
+                         "p99_s": conv, "max_s": conv}}}
+    if docledger is not None:
+        out["docledger"] = docledger
+    return out
+
+
+def _scripted(*snaps):
+    seq = list(snaps)
+
+    def fn():
+        return seq.pop(0) if len(seq) > 1 else seq[0]
+    return fn
+
+
+def _ledger_section(doc, lag_changes, lag_s, behind="w", buffered=0,
+                    label="y"):
+    return {"nodes": {label: {
+        "label": label, "tracked": 1, "top_k": 128, "exported": 1,
+        "evictions": 0, "aggregate": {}, "redundancy": {},
+        "lag": {}, "docs": {doc: {
+            "admitted": 0, "last_admit_at": None, "buffered": buffered,
+            "lag_changes": lag_changes, "lag_s": lag_s,
+            "behind_since": None, "behind_peer": behind, "peers": {}}}}}}
+
+
+def _three_node_collector(straggler_conv=2.0, docledger=None):
+    c = FleetCollector(interval_s=0.02, min_nodes=3)
+    c.add_local("a", _scripted(_snap(), _snap(ops=60, flush_s=0.06,
+                                              flush_n=30, conv=0.01)),
+                role="peer")
+    c.add_local("b", _scripted(_snap(), _snap(ops=60, flush_s=0.06,
+                                              flush_n=30, conv=0.01)),
+                role="peer")
+    c.add_local("x", _scripted(_snap(), _snap(ops=10, flush_s=4.0,
+                                              flush_n=10,
+                                              conv=straggler_conv,
+                                              docledger=docledger)),
+                role="peer")
+    c.scrape_once()
+    time.sleep(0.02)
+    c.scrape_once()
+    return c
+
+
+# -- sparkline --------------------------------------------------------------
+
+
+def test_spark_shape_and_bounds():
+    assert spark([]) == ""
+    line = spark([0, 1, 2, 3])
+    assert len(line) == 4
+    assert line[0] == "▁" and line[-1] == "█"
+    # constant series renders the low block, not a crash (span 0 guard)
+    assert set(spark([5, 5, 5])) == {"▁"}
+    # width cap keeps the panel one line
+    assert len(spark(list(range(100)), width=24)) == 24
+
+
+# -- SLO strip --------------------------------------------------------------
+
+
+def test_slo_strip_cells_ok_breach_and_nodata():
+    eng = slo.SloEngine(slos=[
+        {"name": "converge_p99", "signal": "converge_p99_s", "bound": 1.0},
+        {"name": "ops_floor", "signal": "ops_per_s", "bound": 1e9},
+        {"name": "ghost", "signal": "never_recorded", "bound": 1.0},
+    ])
+    c = _three_node_collector()
+    c.slo_engine = eng
+    c.scrape_once()
+    lines = render(c, eng)
+    slo_line = next(line for line in lines if line.startswith("SLO: "))
+    assert "[BREACH] converge_p99" in slo_line
+    assert "[OK] ops_floor" in slo_line
+    assert "[--] ghost" in slo_line
+
+
+# -- fleet table ------------------------------------------------------------
+
+
+def test_fleet_table_columns_straggler_and_header():
+    c = _three_node_collector()
+    lines = render(c)
+    text = "\n".join(lines)
+    header = next(line for line in lines if line.startswith("node"))
+    for col in ("ops/s", "conv p99", "flush", "lockw/s", "drops/s",
+                "score", "age"):
+        assert col in header
+    xrow = next(line for line in lines if line.startswith("x "))
+    assert "<< STRAGGLER" in xrow
+    assert "2.000s" in xrow          # conv p99 column
+    arow = next(line for line in lines if line.startswith("a "))
+    assert "STRAGGLER" not in arow
+    assert "3 node(s)" in lines[0]
+    assert "1 straggler(s)" in lines[0]
+
+
+def test_fleet_table_marks_stale_nodes():
+    c = FleetCollector(interval_s=0.01, min_nodes=3)
+    c.add_local("live", _scripted(_snap(), _snap(ops=10, flush_s=0.01,
+                                                 flush_n=5)))
+    c.scrape_once()
+    st = c._node("dead", "node")
+    st.add_sample(time.time() - 60.0, _snap())
+    time.sleep(0.01)
+    c.scrape_once()
+    lines = render(c)
+    dead = next(line for line in lines if line.startswith("dead"))
+    assert "(stale)" in dead
+
+
+def test_sparkline_band_follows_busiest_node():
+    c = _three_node_collector()
+    lines = render(c)
+    text = "\n".join(lines)
+    # the straggler is focused; its ring history renders as sparklines
+    assert any(line.startswith("x conv p99") or
+               line.startswith("x flush") or
+               line.startswith("x ops/s") for line in lines), text
+
+
+# -- per-doc hot list (the docledger panel) ---------------------------------
+
+
+def test_hot_doc_panel_renders_ledger_rows():
+    sec = _ledger_section("orders-007", 12, 3.25, behind="w1",
+                          buffered=2, label="y")
+    c = _three_node_collector(docledger=sec)
+    lines = render(c)
+    text = "\n".join(lines)
+    assert "hot docs (converge lag; `perf explain <doc>`):" in text
+    row = next(line for line in lines if "orders-007" in line)
+    assert "@ y" in row
+    assert "12 chg" in row
+    assert "behind w1" in row
+    assert "[2 buffered]" in row
+
+
+def test_hot_doc_panel_absent_without_ledgers():
+    c = _three_node_collector()
+    assert hot_doc_lines(c) == []
+    assert not any("hot docs" in line for line in render(c))
+
+
+def test_hot_doc_panel_ranks_and_caps():
+    nodes = {}
+    for k in range(8):
+        nodes[f"n{k}"] = _ledger_section(
+            f"doc{k}", k + 1, float(k), label=f"n{k}")["nodes"][f"n{k}"]
+    sec = {"nodes": nodes}
+    c = FleetCollector(interval_s=0.01, min_nodes=3)
+    c.add_local("hub", _scripted(_snap(docledger=sec)))
+    c.scrape_once()
+    lines = hot_doc_lines(c, limit=3)
+    assert len(lines) == 1 + 3
+    # worst lag first
+    assert "doc7" in lines[1] and "doc6" in lines[2] and "doc5" in lines[3]
+
+
+def test_render_width_clamp():
+    sec = _ledger_section("x" * 120, 3, 1.0)
+    c = _three_node_collector(docledger=sec)
+    for line in render(c, width=80):
+        assert len(line) <= 80
