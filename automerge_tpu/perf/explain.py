@@ -27,6 +27,13 @@ them, most-specific first):
     doc_connection_stalled  the lagging node still hears clock adverts
                             from the ahead peer but change-bearing
                             messages stopped arriving
+    doc_unsubscribed        the lagging node EXPLICITLY unsubscribed the
+                            doc from the ahead peer (sync/connection.py
+                            subscribe(remove=...)) — the lag is chosen,
+                            not a fault; heavy sub_events churn on the
+                            lane is called out (the sub_flap chaos
+                            class). Unsubscribed lag is EXPLAINED here
+                            but never FLAGGED in the hot list.
     doc_not_replicated      the ahead peer never framed the doc's changes
                             for this lane at all (no interest, or a
                             wedged gossip handler)
@@ -90,11 +97,13 @@ def merge_views(parts: list[dict]) -> dict:
     return out
 
 
-def gather_local() -> dict:
+def gather_local(k: int | None = None) -> dict:
     """Views from every live ledger in THIS process (the in-process mesh
     posture). Refreshes each ledger's tracked clocks first — explain is
     a diagnostic caller that owns its context, so the locked read is
-    allowed here (unlike in snapshot providers)."""
+    allowed here (unlike in snapshot providers). `k` overrides each
+    ledger's export cap (the `--k` flag; default: the ledger's own
+    export_k, which honors AMTPU_DOCLEDGER_K)."""
     from ..sync import docledger
 
     parts = []
@@ -103,7 +112,7 @@ def gather_local() -> dict:
             led.refresh_clocks()
         except Exception:
             pass
-        sec = led.section()
+        sec = led.section(k=k)
         if sec:
             parts.append({sec["label"]: sec})
     return merge_views(parts)
@@ -178,6 +187,20 @@ def explain_doc(doc_id: str, views: dict, now: float | None = None) -> dict:
                 f"{lag_live:.3f}s")
         # the lagging node's own receive lane for the ahead peer
         pv = (e.get("peers") or {}).get(w) if w else None
+        if pv is not None and pv.get("unsubscribed"):
+            # the lag is CHOSEN: this node unsubscribed the doc from the
+            # ahead peer, whose adverts keep the deficit honest — rank
+            # it as its own cause so nobody chases a phantom stall
+            flaps = int(pv.get("sub_events") or 0)
+            churn = (f" (interest churn: {flaps} subscribe/unsubscribe "
+                     "toggles on the lane — sub_flap chaos or an "
+                     "over-eager interest manager)"
+                     if flaps >= 3 else "")
+            _cause(causes, "doc_unsubscribed", label, 6.0 + deficit, [
+                head + f"; {label} explicitly UNSUBSCRIBED {doc_id!r} "
+                f"from {w} — frames stopped by choice, adverts keep the "
+                "frontier visible; resubscribe to backfill" + churn])
+            continue
         recv_total = sum(int(p.get("recv_useful") or 0)
                          for p in (e.get("peers") or {}).values())
         admitted = int(e.get("admitted") or 0)
@@ -270,6 +293,14 @@ def hot_docs(views: dict, limit: int = 8,
             deficit = int(e.get("lag_changes") or 0)
             buffered = int(e.get("buffered") or 0)
             if deficit <= 0 and not buffered:
+                continue
+            bp = (e.get("peers") or {}).get(e.get("behind_peer") or "")
+            if deficit > 0 and not buffered and bp \
+                    and bp.get("unsubscribed"):
+                # chosen lag (the node unsubscribed this doc): explained
+                # by `perf explain <doc>` (doc_unsubscribed), never
+                # flagged in the hot list — a deliberate opt-out must
+                # not page anyone
                 continue
             bs = e.get("behind_since")
             rows.append({
@@ -398,8 +429,16 @@ def main(argv=None) -> int:
     ap.add_argument("--interval", type=float, default=0.3)
     ap.add_argument("--limit", type=int, default=8,
                     help="hot-list rows (no-doc mode)")
+    ap.add_argument("--k", type=int, default=None,
+                    help="per-ledger doc export cap override (default: "
+                         "the ledger's export_k, which honors "
+                         "AMTPU_DOCLEDGER_K); also raises the hot-list "
+                         "row limit")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
+    if args.k is not None:
+        # a caller asking for a deeper export wants to SEE it too
+        args.limit = max(args.limit, args.k)
 
     now = None
     if args.connect:

@@ -113,6 +113,23 @@ SCRAPE_BUDGET_S = 0.25
 #: cost is a property of the ledger code, not of the traffic mix.
 LEDGER_BUDGET_PCT = 2.0
 
+#: partial-replication gates (r12, config 13). All ABSOLUTE — each is a
+#: property of the subscription/relay code, not of the host:
+#: relay-tree total fan-out bytes must grow sublinearly in subscriber
+#: count (growth exponent over N=8..128 strictly under 1.0; the bench
+#: asserts a tighter 0.9 in-run),
+SUB_GROWTH_EXP_MAX = 1.0
+#: relay bytes/subscriber must stay under this fraction of the flat
+#: full-sync baseline's bytes/subscriber,
+SUB_FANOUT_MESH_FRACTION_MAX = 0.5
+#: the relay tree's duplicate/useful delivery ratio must stay under
+#: 1.2 — against the 1.85 full-mesh ratio config 12 recorded as the
+#: baseline partial replication improves,
+SUB_REDUNDANCY_MAX = 1.2
+#: and subscribed-doc converge-p99 must stay within the default
+#: converge SLO (mirrors perf/slo.py DEFAULT_CONVERGE_P99_S).
+SUB_CONVERGE_P99_BUDGET_S = 2.0
+
 #: config-8 fields copied into the history record's `fleet` section
 FLEET_KEYS = ("fleet_hashes_s", "fleet_hashes_first_s",
               "fleet_hashes_clean_shards", "fleet_hashes_dirty_shards",
@@ -220,7 +237,19 @@ def _norm_configs(raw) -> dict:
                                        "redundancy_floor",
                                        "ledger_overhead_pct",
                                        "explain_attributed",
-                                       "mesh_nodes")
+                                       "mesh_nodes",
+                                       # partial replication (r12,
+                                       # config 13): relay fan-out
+                                       # sublinearity + redundancy +
+                                       # subscribed-doc SLO + backfill
+                                       "fanout_bytes_per_sub",
+                                       "mesh_bytes_per_sub",
+                                       "fanout_vs_mesh_fraction",
+                                       "fanout_growth_exponent",
+                                       "sub_redundancy_ratio",
+                                       "sub_converge_p99_s",
+                                       "sub_slo_bound_s",
+                                       "sub_backfill_ok")
                      if isinstance(v.get(k), (int, float, str))}
         elif isinstance(v, (int, float)):
             entry = {"speedup": v}
@@ -646,6 +675,65 @@ def check(path: str | None = None, record: dict | None = None,
                          + ("OK" if att else "MISS"))
         if extra:
             lines.append("  doc-ledger: " + "; ".join(extra))
+
+    # partial-replication gates (r12, config 13): fan-out sublinearity,
+    # bytes/subscriber ceiling vs the flat baseline, relay redundancy,
+    # and subscribed-doc converge-p99 — all absolute (properties of the
+    # subscription/relay code). Skip-clean: runs without config 13
+    # never fail. Ratios/exponents are host-normalized, so no host
+    # scoping applies.
+    def _pr(r: dict):
+        return ((r.get("configs") or {}).get("13") or {})
+
+    # each gate checks its own field independently — a record missing
+    # one field (renamed, dropped by a future writer) must not silently
+    # vacate the OTHER four gates
+    cur_exp = _pr(current).get("fanout_growth_exponent")
+    if isinstance(cur_exp, (int, float)):
+        verdict = ("OK" if cur_exp < SUB_GROWTH_EXP_MAX
+                   else "FAN-OUT NOT SUBLINEAR")
+        lines.append(
+            f"  relay fan-out growth (config 13, N=8..128): exponent "
+            f"{cur_exp:.3f} (must be < {SUB_GROWTH_EXP_MAX}) "
+            f"-> {verdict}")
+        if cur_exp >= SUB_GROWTH_EXP_MAX:
+            rc = 1
+    frac = _pr(current).get("fanout_vs_mesh_fraction")
+    if isinstance(frac, (int, float)):
+        verdict = ("OK" if frac <= SUB_FANOUT_MESH_FRACTION_MAX
+                   else "FAN-OUT OVER MESH CEILING")
+        lines.append(
+            f"  relay bytes/subscriber vs flat baseline: x{frac:.4f}"
+            f" (ceiling x{SUB_FANOUT_MESH_FRACTION_MAX}) "
+            f"-> {verdict}")
+        if frac > SUB_FANOUT_MESH_FRACTION_MAX:
+            rc = 1
+    red = _pr(current).get("sub_redundancy_ratio")
+    if isinstance(red, (int, float)):
+        verdict = ("OK" if red <= SUB_REDUNDANCY_MAX
+                   else "RELAY REDUNDANCY OVER BUDGET")
+        lines.append(
+            f"  relay redundancy ratio: x{red} (budget <= "
+            f"{SUB_REDUNDANCY_MAX}; full-mesh baseline 1.85) "
+            f"-> {verdict}")
+        if red > SUB_REDUNDANCY_MAX:
+            rc = 1
+    p99 = _pr(current).get("sub_converge_p99_s")
+    if isinstance(p99, (int, float)):
+        verdict = ("OK" if p99 <= SUB_CONVERGE_P99_BUDGET_S
+                   else "SUBSCRIBED-DOC SLO BREACH")
+        lines.append(
+            f"  subscribed-doc converge p99: {p99}s (SLO <= "
+            f"{SUB_CONVERGE_P99_BUDGET_S}s) -> {verdict}")
+        if p99 > SUB_CONVERGE_P99_BUDGET_S:
+            rc = 1
+    bf = _pr(current).get("sub_backfill_ok")
+    if bf is not None:
+        lines.append("  late-subscribe backfill: "
+                     + ("OK (auditor green, unsubscribed lanes "
+                        "silent)" if bf else "MISS"))
+        if not bf:
+            rc = 1
 
     # keystroke-flatness gate (r8, config 7): latency at 4x document
     # length over 1x must stay under the ceiling. A RATIO is

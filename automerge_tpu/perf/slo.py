@@ -130,6 +130,13 @@ class SloEngine:
         self.verdicts: dict[str, dict] = {}
         self._baselines: dict[str, float] = {}
         self._membership: frozenset = frozenset()
+        # SLO-coupled admission control (sync/epochs.IngressGovernor,
+        # attached to a service via attach_governor): every evaluate()
+        # pass feeds the converge_p99 value into the governor's judge,
+        # closing the backpressure loop — sustained breach -> the epoch
+        # plane delays/sheds low-priority ingress, disclosed on the
+        # sync_shed_* series. None = observe-only (the default).
+        self.governor = None
 
     def _value(self, slo: Slo, state: dict) -> float | None:
         if slo.signal in ("scrape_p50_s", "scrape_p99_s"):
@@ -166,6 +173,14 @@ class SloEngine:
                     self._baselines.pop(slo.name, None)
         for slo in self.slos:
             value = self._value(slo, state)
+            if slo.name == "converge_p99" and self.governor is not None:
+                # the backpressure loop's forward edge: breach state is
+                # the governor's to decide (it owns sustain/bound); a
+                # None value never transitions it
+                try:
+                    self.governor.judge(value)
+                except Exception:
+                    pass   # a broken governor must not stop the judging
             ok: bool | None
             if value is None or slo.bound is None:
                 ok = None               # no data / no baseline: skip

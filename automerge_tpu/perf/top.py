@@ -124,7 +124,8 @@ def hot_doc_lines(collector, limit: int = 5) -> list[str]:
     for st in collector.nodes.values():
         if isinstance(st.last_snapshot, dict):
             parts.append(views_from_snapshot(st.last_snapshot))
-    rows = hot_docs(merge_views(parts), limit=limit)
+    views = merge_views(parts)
+    rows = hot_docs(views, limit=limit)
     if not rows:
         return []
     lines = ["hot docs (converge lag; `perf explain <doc>`):"]
@@ -134,6 +135,14 @@ def hot_doc_lines(collector, limit: int = 5) -> list[str]:
             f"{r['lag_changes']:>5} chg {_fmt(r['lag_s'], 's'):>9} "
             f"behind {r['behind_peer'] or '?'}"
             + (f"  [{r['buffered']} buffered]" if r["buffered"] else ""))
+    # a truncated export must SAY so: docs beyond the per-node cap are
+    # invisible here, not healthy (satellite of the export-cap fix)
+    truncated = sum(max(0, int(v.get("truncated") or 0))
+                    for v in views.values())
+    if truncated:
+        lines.append(f"  (+{truncated} tracked doc(s) beyond the export "
+                     "cap — raise AMTPU_DOCLEDGER_K or pass --k to "
+                     "perf explain)")
     return lines
 
 

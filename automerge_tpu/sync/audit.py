@@ -69,22 +69,66 @@ def state_digest(hashes: dict[str, int]) -> int:
         sorted((d, int(h)) for d, h in hashes.items())).encode())
 
 
+def _peer_interest_filter(conn):
+    """The requesting peer's explicit interest set, or None for full
+    interest — audit digests are filtered to the intersection of the
+    peer's subscriptions and local holdings, so a partial replica's
+    digest compares equal to the serving side's digest over the SAME doc
+    subset (a full-holdings digest would mismatch forever and bisect
+    every round)."""
+    interest = getattr(conn, "_peer_interest", None)
+    if interest is not None and getattr(interest, "narrowed", False):
+        return interest
+    return None
+
+
+def _filtered_audit_state(ds, interest) -> dict:
+    """Per-shard digests over only the docs the peer subscribed — the
+    partial-replication twin of audit_state(), read through the partial
+    hashes_for plane (never reconciles unsubscribed docs)."""
+    docs = sorted(d for d in ds.doc_ids if interest.covers(d))
+    h = (ds.hashes_for(docs) if hasattr(ds, "hashes_for")
+         else {d: v for d, v in ds.hashes().items() if d in set(docs)})
+    groups: dict[str, dict] = {}
+    for d, v in h.items():
+        if hasattr(ds, "shard_of"):
+            lbl = ds.shard_of(d)._audit_label
+        else:
+            lbl = getattr(ds, "_audit_label", "0")
+        groups.setdefault(lbl, {})[d] = v
+    return {lbl: {"digest": state_digest(hh), "docs": len(hh)}
+            for lbl, hh in groups.items()}
+
+
 def handle_audit_msg(conn, msg: dict) -> None:
     """Serve/route one `{"audit": ...}` protocol message for a Connection.
     Serving needs only the doc_set's audit surface (audit_state /
     audit_shard_state — EngineDocSet and ShardedEngineDocSet); responses
-    are routed to the attached ConvergenceAuditor, if any."""
+    are routed to the attached ConvergenceAuditor, if any. A peer with
+    an explicit interest set (partial replication) is served digests
+    over the subscribed-doc intersection only."""
     kind = msg.get("audit")
     ds = conn._doc_set
     if kind == "pull":
         metrics.bump("sync_audit_pulls")
-        if hasattr(ds, "audit_state"):
-            conn._send_traced({"audit": "state", "state": ds.audit_state()})
-        else:   # interpretive DocSet: no engine hashes to audit
+        interest = _peer_interest_filter(conn)
+        if not hasattr(ds, "audit_state"):
+            # interpretive DocSet: no engine hashes to audit
             conn._send_traced({"audit": "unsupported"})
+        elif interest is not None:
+            conn._send_traced({"audit": "state",
+                               "state": _filtered_audit_state(ds, interest)})
+        else:
+            conn._send_traced({"audit": "state", "state": ds.audit_state()})
     elif kind == "shard_pull":
         if hasattr(ds, "audit_shard_state"):
             st = ds.audit_shard_state(str(msg.get("shard")))
+            interest = _peer_interest_filter(conn)
+            if interest is not None:
+                st = {"hashes": {d: h for d, h in st["hashes"].items()
+                                 if interest.covers(d)},
+                      "clocks": {d: c for d, c in st["clocks"].items()
+                                 if interest.covers(d)}}
             conn._send_traced({"audit": "shard",
                                "shard": str(msg.get("shard")), **st})
     elif kind == "state":
@@ -155,6 +199,20 @@ class ConvergenceAuditor:
             except Exception:
                 log.exception("audit round failed")
 
+    def _local_audit_state(self) -> dict:
+        """Local digests, filtered to this side's OWN explicit interest
+        when it has one: the serving peer filters its digests to our
+        subscription (covers() — advert-only docs excluded, since their
+        local state froze the moment frames stopped and would mismatch
+        forever), so the local digest must cover the SAME doc subset or
+        every round degrades to a full bisect."""
+        interest = getattr(self.conn, "_local_interest", None)
+        if interest is not None \
+                and getattr(interest, "narrowed", False) \
+                and hasattr(self.doc_set, "doc_ids"):
+            return _filtered_audit_state(self.doc_set, interest)
+        return self.doc_set.audit_state()
+
     def audit_once(self) -> None:
         """Fire one audit round (also usable without start()). The local
         digest snapshot is taken HERE — on the calling/audit thread —
@@ -162,7 +220,7 @@ class ConvergenceAuditor:
         ingress, but a stale digest only costs a doc-level bisect whose
         clock guard filters the lag (never a false report)."""
         self.last_audit_at = time.time()
-        self._local_state = self.doc_set.audit_state()
+        self._local_state = self._local_audit_state()
         self.conn.request_audit()
 
     # -- peer answers (delivered on the transport reader thread) -------------
@@ -178,7 +236,7 @@ class ConvergenceAuditor:
     # on the reader thread (handle_audit_msg); same caveat applies.
 
     def on_peer_state(self, conn, peer_state: dict) -> None:
-        local = self._local_state or self.doc_set.audit_state()
+        local = self._local_state or self._local_audit_state()
         # a shard label the local node cannot confirm — digest mismatch,
         # or a label only one side has (heterogeneous n_shards) — gets
         # bisected to doc level; the doc compare below is partition-

@@ -28,15 +28,150 @@ from typing import Callable
 
 from ..core import clock as C
 from ..core.change import coerce_change
-from ..utils import chaos, metrics, oplag
+from ..utils import chaos, flightrec, metrics, oplag
 from . import docledger
-from .frames import (OPLAG_KEY, TRACE_KEY, msg_kind, pack_trace,
+from .frames import (OPLAG_KEY, SUB_KEY, TRACE_KEY, msg_kind, pack_trace,
                      unpack_trace)
+
+
+class InterestSet:
+    """Doc-granular interest: which docs one side of a connection wants
+    synced (the `{"sub": ...}` protocol message's state).
+
+    Three states per doc id:
+
+    - **covered** (mode "all", an explicit doc id, or a prefix match):
+      change frames AND clock adverts flow;
+    - **advert-only** (explicitly removed after having been covered):
+      clock adverts keep flowing — the peer still sees the frontier it
+      chose to ignore, so a later resubscribe is an informed decision
+      and `perf explain` can name the lag `doc_unsubscribed` instead of
+      flagging a stall — but change frames stop;
+    - **unknown** (explicit mode, never added): nothing is sent at all.
+
+    The default is full interest (mode "all"): a peer that never speaks
+    the sub protocol syncs the whole DocSet exactly as before — the
+    subscription layer is strictly opt-in. Two working styles follow
+    from the first delta a peer sends:
+
+    - **narrowing** (the first delta carries adds/prefixes on a
+      pristine "all" set): the set flips to explicit-with-only-these;
+    - **exclusion** (the first delta is remove-only): the set STAYS
+      "all" and the removed docs become advert-only — full sync minus
+      opt-outs, so a pure unsubscribe can never silently darken the
+      whole connection."""
+
+    __slots__ = ("mode", "docs", "prefixes", "advert_only")
+
+    def __init__(self):
+        self.mode = "all"
+        self.docs: set[str] = set()
+        self.prefixes: tuple[str, ...] = ()
+        self.advert_only: set[str] = set()
+
+    @property
+    def explicit(self) -> bool:
+        return self.mode == "explicit"
+
+    @property
+    def narrowed(self) -> bool:
+        """True when this set filters ANYTHING (explicit mode, or an
+        exclusion-style "all" with opted-out docs) — the condition under
+        which audit digests must be computed over the covered subset."""
+        return self.mode == "explicit" or bool(self.advert_only)
+
+    def covers(self, doc_id: str) -> bool:
+        """True when change frames for this doc should flow."""
+        if self.mode == "all":
+            return doc_id not in self.advert_only
+        return doc_id in self.docs \
+            or any(doc_id.startswith(p) for p in self.prefixes)
+
+    def wants_adverts(self, doc_id: str) -> bool:
+        """True when at least clock adverts should flow (covered docs
+        plus explicitly-unsubscribed ones)."""
+        return self.covers(doc_id) or doc_id in self.advert_only
+
+    def apply(self, add=(), prefixes=(), remove=(),
+              remove_prefixes=(), mode: str | None = None
+              ) -> tuple[list[str], list[str]]:
+        """Apply one sub delta. Returns (newly covered doc ids, newly
+        added prefixes) — the serving side's targeted-backfill set.
+        mode="all" resets to full interest FIRST (removes in the same
+        delta then re-apply as exclusions — the resubscribe wire form
+        of an exclusion-style set). Adds/prefixes on a PRISTINE "all"
+        set (no exclusions yet) switch it to explicit-with-only-these;
+        on an exclusion-style "all" set they just lift exclusions."""
+        if mode == "all":
+            self.mode = "all"
+            self.docs.clear()
+            self.prefixes = ()
+            self.advert_only.clear()
+        elif mode == "explicit":
+            # reset-form replay of an explicit set: stay explicit even
+            # when the replayed set is empty (an emptied subscription
+            # must not resurrect as full interest)
+            self.mode = "explicit"
+        if self.mode == "all" and (add or prefixes) \
+                and not self.advert_only:
+            self.mode = "explicit"
+        new_docs: list[str] = []
+        for d in add or ():
+            if self.mode == "all":
+                # exclusion style: a re-add lifts the opt-out — it was
+                # dark for frames, so it IS newly covered (backfill)
+                if d in self.advert_only:
+                    self.advert_only.discard(d)
+                    new_docs.append(d)
+                continue
+            self.advert_only.discard(d)
+            if d not in self.docs:
+                if not self.covers(d):
+                    new_docs.append(d)
+                self.docs.add(d)
+        new_prefixes: list[str] = []
+        for p in prefixes or ():
+            if self.mode == "all":
+                continue   # everything is covered already
+            if p not in self.prefixes:
+                new_prefixes.append(p)
+                self.prefixes = self.prefixes + (p,)
+        for d in remove or ():
+            if self.mode == "all":
+                # exclusion style (also the remove-only first delta):
+                # stay "all", degrade just this doc to advert-only
+                self.advert_only.add(d)
+            elif d in self.docs:
+                self.docs.discard(d)
+                self.advert_only.add(d)
+            # a doc covered only by a prefix stays covered until the
+            # prefix itself is removed — doc-id removes never override
+            # a broader prefix subscription (the cover-set merge rule)
+        for p in remove_prefixes or ():
+            if p in self.prefixes:
+                self.prefixes = tuple(x for x in self.prefixes if x != p)
+        return new_docs, new_prefixes
+
+    def to_wire(self) -> dict:
+        """The FULL current interest as one sub delta (reset form) —
+        what `resubscribe()` sends after a re-home."""
+        if self.mode == "all":
+            out = {"mode": "all"}
+            if self.advert_only:
+                out["remove"] = sorted(self.advert_only)
+            return out
+        out = {"reset": True, "mode": "explicit",
+               "add": sorted(self.docs)}
+        if self.prefixes:
+            out["prefixes"] = list(self.prefixes)
+        if self.advert_only:
+            out["remove"] = sorted(self.advert_only)
+        return out
 
 
 class Connection:
     def __init__(self, doc_set, send_msg: Callable[[dict], None],
-                 wire: str = "json"):
+                 wire: str = "json", local_interest=None):
         """wire="json" sends changes as reference-protocol per-op dicts;
         wire="columnar" sends them as one binary columnar frame per message
         (msg["frame"], see sync/frames.py). automerge_tpu receivers
@@ -92,6 +227,22 @@ class Connection:
         # doc_set's other connections, so one node's lanes live in one
         # table. None when AMTPU_DOCLEDGER=0 — every hook below no-ops.
         self._ledger = docledger.of(doc_set)
+        # Interest sets (the subscription layer): _peer_interest is what
+        # the PEER subscribed to — every outgoing advert/frame/gossip/
+        # audit digest is filtered against it; _local_interest is what
+        # THIS side subscribed to from the peer (subscribe() below).
+        # Both default to full interest, so a connection that never
+        # speaks the sub protocol syncs the whole DocSet unchanged.
+        # `local_interest` seeds the local set (the re-home path: a new
+        # connection carrying a dead hub's child interest, replayed to
+        # the adopting peer via resubscribe()).
+        self._peer_interest = InterestSet()
+        self._local_interest = (local_interest if local_interest
+                                is not None else InterestSet())
+        # relay hook (sync/relay.py): fires after the peer's interest
+        # changed — (conn, {"added", "added_prefixes", "removed",
+        # "removed_prefixes"}) — so a hub can re-merge its cover set
+        self.on_sub_change: Callable | None = None
 
     # -- lifecycle (connection.js:49-56) ------------------------------------
 
@@ -179,9 +330,26 @@ class Connection:
         self._send_traced(msg)
 
     def maybe_send_changes(self, doc_id: str) -> None:
+        interest = self._peer_interest
+        frames_ok = interest.covers(doc_id)
+        if not frames_ok and not interest.wants_adverts(doc_id):
+            # the peer never subscribed this doc: nothing is sent at all
+            # — no advert, no frame. This is THE wire saving of partial
+            # replication (counted once per suppressed gossip event).
+            metrics.bump("sync_sub_frames_suppressed")
+            return
         doc = self._doc_set.get_doc(doc_id)
         opset = doc._doc.opset
         clock = opset.clock
+
+        if not frames_ok:
+            # advert-only (explicitly unsubscribed): the peer keeps
+            # seeing the frontier it opted out of, but frames stop
+            if doc_id not in self._our_clock or \
+                    not C.equal(clock, self._our_clock[doc_id]):
+                metrics.bump("sync_sub_frames_suppressed")
+                self.send_msg(doc_id, clock)
+            return
 
         if doc_id in self._their_clock:
             changes = opset.get_missing_changes(self._their_clock[doc_id])
@@ -219,6 +387,157 @@ class Connection:
                 raise ValueError(
                     "Cannot pass an old state object to a connection")
             self.maybe_send_changes(doc_id)
+
+    # -- subscriptions (SUB message type; sync partial replication) ---------
+
+    def subscribe(self, docs=(), prefixes=(), remove=(),
+                  remove_prefixes=(), everything: bool = False) -> None:
+        """Declare interest to the peer: only subscribed docs are framed
+        back to us. `docs`/`prefixes` add; `remove`/`remove_prefixes`
+        drop (removed docs degrade to advert-only — the peer keeps
+        advertising their clocks so we still see the frontier we opted
+        out of). `everything=True` resets to full-DocSet sync.
+
+        For each explicitly-added doc we already hold, our current
+        clock rides along (`"clocks"`), so the serving side backfills
+        exactly the missing suffix through its `missing_changes`
+        snapshot read plane — a late subscribe never costs a
+        full-DocSet replay."""
+        with self._state_lock:
+            if everything:
+                self._local_interest.apply(mode="all")
+                msg = {"mode": "all"}
+            else:
+                self._local_interest.apply(
+                    add=docs, prefixes=prefixes, remove=remove,
+                    remove_prefixes=remove_prefixes)
+                msg = {}
+                if docs:
+                    msg["add"] = list(docs)
+                    msg["clocks"] = self._held_clocks(docs)
+                if prefixes:
+                    msg["prefixes"] = list(prefixes)
+                if remove:
+                    msg["remove"] = list(remove)
+                if remove_prefixes:
+                    msg["remove_prefixes"] = list(remove_prefixes)
+            if self._ledger is not None:
+                for d in docs or ():
+                    self._ledger.record_sub(d, self, True)
+                for d in remove or ():
+                    self._ledger.record_sub(d, self, False)
+        self._send_traced({SUB_KEY: msg})
+
+    def resubscribe(self) -> None:
+        """Re-send the FULL current local interest (reset form, clocks
+        included) — the re-home path: a child whose relay hub died
+        reattaches elsewhere and replays its interest, and the new hub
+        backfills whatever the child missed in between."""
+        metrics.bump("sync_sub_resubscribes")
+        with self._state_lock:
+            msg = self._local_interest.to_wire()
+            if msg.get("add"):
+                msg["clocks"] = self._held_clocks(msg["add"])
+        self._send_traced({SUB_KEY: msg})
+
+    def _held_clocks(self, doc_ids) -> dict:
+        """Current local clocks for the held docs among `doc_ids` (the
+        subscribe-time backfill anchors); unheld docs report {} — the
+        whole history is missing."""
+        out = {}
+        for d in doc_ids:
+            doc = self._doc_set.get_doc(d)
+            out[d] = dict(doc._doc.opset.clock) if doc is not None else {}
+        return out
+
+    def _handle_sub_msg(self, msg: dict) -> bool:
+        sub = msg.get(SUB_KEY)
+        if sub is None:
+            return False
+        add = list(sub.get("add") or ())
+        prefixes = list(sub.get("prefixes") or ())
+        removed = list(sub.get("remove") or ())
+        removed_prefixes = list(sub.get("remove_prefixes") or ())
+        if add or prefixes:
+            metrics.bump("sync_sub_adds", len(add) + len(prefixes))
+        if removed or removed_prefixes:
+            metrics.bump("sync_sub_removes",
+                         len(removed) + len(removed_prefixes))
+        # `removed*` as applied to the interest set stays the wire
+        # delta; `report_removed*` (what on_sub_change / the hub's
+        # refcounts see) additionally carries a reset's WHOLE old set —
+        # a reset REPLACES the interest, and a hub that re-counted the
+        # re-declared entries without releasing the old ones would pin
+        # the cover forever after the child departs. (_merge_delta
+        # applies adds before removes in one call, so kept entries net
+        # to zero with no upstream churn.)
+        report_removed = list(removed)
+        report_removed_prefixes = list(removed_prefixes)
+        with self._state_lock:
+            if sub.get("reset"):
+                old = self._peer_interest
+                self._peer_interest = InterestSet()
+                if old.explicit:
+                    report_removed += sorted(old.docs)
+                    report_removed_prefixes += list(old.prefixes)
+            new_docs, new_prefixes = self._peer_interest.apply(
+                add=add, prefixes=prefixes, remove=removed,
+                remove_prefixes=removed_prefixes, mode=sub.get("mode"))
+        flightrec.record("sub_change", added=len(new_docs),
+                         prefixes=len(new_prefixes),
+                         removed=len(report_removed))
+        if self.on_sub_change is not None:
+            self.on_sub_change(self, {
+                "added": new_docs, "added_prefixes": new_prefixes,
+                "removed": report_removed,
+                "removed_prefixes": report_removed_prefixes})
+        self._backfill(new_docs, new_prefixes, sub.get("clocks") or {})
+        return True
+
+    def _backfill(self, new_docs, new_prefixes, clocks: dict) -> None:
+        """Targeted late-subscribe backfill: push each newly-covered
+        held doc's missing suffix (vs the subscriber's declared clock,
+        else its last advert, else {} = full history of THAT doc) via
+        the existing missing_changes snapshot read plane. Prefix adds
+        only ADVERTISE matching held docs — the subscriber answers with
+        its clock and the ordinary anti-entropy flow ships the delta —
+        so a broad prefix never triggers a speculative bulk push."""
+        targets = [d for d in new_docs
+                   if self._doc_set.get_doc(d) is not None]
+        with self._state_lock:
+            for d in targets:
+                known = clocks.get(d)
+                if known is not None:
+                    self._their_clock = self._clock_union(
+                        self._their_clock, d, known)
+                elif d not in self._their_clock:
+                    self._their_clock = self._clock_union(
+                        self._their_clock, d, {})
+                metrics.bump("sync_sub_backfills")
+                self.maybe_send_changes(d)
+            if new_prefixes:
+                for d in self._doc_set.doc_ids:
+                    if d in targets or self._doc_set.get_doc(d) is None:
+                        continue
+                    if any(d.startswith(p) for p in new_prefixes):
+                        self.maybe_send_changes(d)
+
+    def _maybe_sub_flap(self, doc_id: str) -> None:
+        """Chaos `sub_flap` (utils/chaos.py AMTPU_CHAOS_SUB_FLAP_DOC):
+        subscribe/unsubscribe churn on exactly one doc, injected on the
+        SUBSCRIBER side of an explicit-interest connection — the
+        interest-plane fault class `perf explain` must attribute
+        (doc_unsubscribed with a churn note) instead of flagging a
+        stall. Inert (one cached check) unless the knob is set."""
+        if not self._local_interest.narrowed:
+            return
+        if not chaos.sub_flap(getattr(self._doc_set, "_chaos_node", None),
+                              doc_id):
+            return
+        if self._local_interest.covers(doc_id):
+            self.subscribe(remove=[doc_id])
+        else:
+            self.subscribe(docs=[doc_id])
 
     # -- metrics pull (METRICS message type; no reference counterpart) ------
 
@@ -334,6 +653,8 @@ class Connection:
             return None
         if self._handle_audit_msg(msg):
             return None
+        if self._handle_sub_msg(msg):
+            return None
         # op-lifecycle provenance: records the wire lag now, the
         # peer-apply + convergence lag once the apply below finishes
         lag = oplag.wire_receive(msg.pop(OPLAG_KEY, None))
@@ -348,6 +669,7 @@ class Connection:
                 # the ledger's frontier lane: what this peer claims to
                 # have, vs the local clock it peeks lock-free
                 self._ledger.record_advert(doc_id, self, msg["clock"])
+            self._maybe_sub_flap(doc_id)
         if msg.get("frame") is not None:
             from .frames import decode_frame
             metrics.bump("sync_frames_received")

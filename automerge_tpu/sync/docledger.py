@@ -99,7 +99,7 @@ class _PeerView:
     __slots__ = ("advert_clock", "advert_total", "last_advert_at",
                  "sent_changes", "last_send_at", "recv_useful",
                  "recv_duplicate", "last_recv_at", "bytes_sent",
-                 "bytes_received", "drops")
+                 "bytes_received", "drops", "unsubscribed", "sub_events")
 
     def __init__(self):
         self.advert_clock: dict[str, int] = {}
@@ -113,6 +113,14 @@ class _PeerView:
         self.bytes_sent = 0
         self.bytes_received = 0
         self.drops = 0
+        # interest state (sync/connection.py subscribe/unsubscribe):
+        # True while THIS side has explicitly unsubscribed the doc from
+        # this peer — the peer's adverts keep the lag honest, and
+        # `perf explain` reads the flag as doc_unsubscribed (chosen lag,
+        # not a fault). sub_events counts toggles: churn evidence for
+        # the sub_flap chaos class.
+        self.unsubscribed = False
+        self.sub_events = 0
 
 
 class _DocEntry:
@@ -141,13 +149,20 @@ class DocLedger:
 
     def __init__(self, doc_set=None, label: str | None = None,
                  top_k: int | None = None):
+        env_k = os.environ.get("AMTPU_DOCLEDGER_K")
         if top_k is None:
             try:
-                top_k = int(os.environ.get("AMTPU_DOCLEDGER_K",
-                                           str(DEFAULT_TOP_K)))
+                top_k = int(env_k) if env_k else DEFAULT_TOP_K
             except ValueError:
                 top_k = DEFAULT_TOP_K
         self.top_k = max(4, top_k)
+        # Export cap: EXPORT_K (32) by default so a metrics pull stays
+        # bounded — but an operator who EXPLICITLY sized the table
+        # (AMTPU_DOCLEDGER_K) asked for that many docs, and silently
+        # truncating the export at 32 would hide the tail they paid to
+        # track. section(k=...) overrides per call (perf explain --k).
+        self.export_k = (self.top_k if env_k
+                         else min(EXPORT_K, self.top_k))
         self.label = label
         self._ds = (weakref.ref(doc_set) if doc_set is not None
                     else (lambda: None))
@@ -443,6 +458,23 @@ class DocLedger:
             pv.drops += 1
             self._self_s += time.perf_counter() - t0
 
+    def record_sub(self, doc_id: str, conn, subscribed: bool) -> None:
+        """This side subscribed (True) or unsubscribed (False) the doc
+        from the peer (sync/connection.py subscribe()). The lane flag
+        lets `perf explain` name a lagging-but-unsubscribed doc
+        doc_unsubscribed instead of flagging a stall; the toggle count
+        is the sub_flap churn evidence."""
+        t0 = time.perf_counter()
+        lbl = self.conn_label(conn)
+        with self._lock:
+            e = self._entry_locked(doc_id)
+            pv = e.peers.get(lbl)
+            if pv is None:
+                pv = e.peers[lbl] = _PeerView()
+            pv.unsubscribed = not subscribed
+            pv.sub_events += 1
+            self._self_s += time.perf_counter() - t0
+
     def note_admit(self, doc_id: str, n_changes: int) -> None:
         """A flush admitted changes for a doc. Called under the service
         lock — counts and stamps ONLY (dict math, no clock reads: the
@@ -537,12 +569,15 @@ class DocLedger:
                     e.lag_changes = worst
                     e.behind_peer = worst_peer
 
-    def section(self) -> dict | None:
+    def section(self, k: int | None = None) -> dict | None:
         """This ledger's share of the `"docledger"` snapshot section:
         pure state (absolute stamps, as-of-update lag), worst-lag-first
-        doc export capped at EXPORT_K, aggregate bucket, redundancy.
-        Returns None when nothing was ever recorded (a freshly reset or
-        idle node adds no section).
+        doc export capped at `k` (default: export_k — EXPORT_K unless
+        AMTPU_DOCLEDGER_K was explicitly set, see __init__), aggregate
+        bucket, redundancy. `truncated` counts the tracked docs the cap
+        cut (perf top's hot-doc panel discloses it). Returns None when
+        nothing was ever recorded (a freshly reset or idle node adds no
+        section).
 
         The export is READ-ONLY against the metrics registry (gauges and
         the obs_doc_ledger_s histogram refresh on the mutation path,
@@ -564,8 +599,9 @@ class DocLedger:
         # exported, however cold
         entries.sort(key=lambda kv: (-(kv[1].lag_changes or 0),
                                      -(kv[1].touches)))
+        cap = self.export_k if k is None else max(1, int(k))
         docs_out = {}
-        for d, e in entries[:EXPORT_K]:
+        for d, e in entries[:cap]:
             peers = {}
             for lbl, pv in e.peers.items():
                 peers[lbl] = {
@@ -581,6 +617,12 @@ class DocLedger:
                     "bytes_received": pv.bytes_received,
                     "drops": pv.drops,
                 }
+                # interest lane state: exported only when it carries
+                # information (keeps idle-snapshot pins byte-stable)
+                if pv.unsubscribed:
+                    peers[lbl]["unsubscribed"] = True
+                if pv.sub_events:
+                    peers[lbl]["sub_events"] = pv.sub_events
             docs_out[d] = {
                 "admitted": e.admitted,
                 "last_admit_at": e.last_admit_at,
@@ -597,6 +639,7 @@ class DocLedger:
             "tracked": len(entries),
             "top_k": self.top_k,
             "exported": len(docs_out),
+            "truncated": max(0, len(entries) - len(docs_out)),
             "evictions": evictions,
             "aggregate": agg,
             "redundancy": {"useful": u, "duplicate": dup,

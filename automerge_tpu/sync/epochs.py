@@ -52,7 +52,7 @@ import threading
 import time
 import zlib
 
-from ..utils import metrics
+from ..utils import flightrec, metrics
 
 #: stripes per buffer (power of two; bounds stripe-lock contention for
 #: concurrent writers of different docs)
@@ -253,6 +253,92 @@ class EpochIngestBuffer:
         for w in waits:
             if w is not None:
                 metrics.observe("sync_commit_wait_s", w)
+
+
+class IngressShedError(RuntimeError):
+    """A low-priority ingress was shed by the admission governor
+    (mode="shed") during a sustained converge-SLO breach. The change was
+    NOT admitted; the sender's ordinary anti-entropy cycle re-offers it
+    once its clock advert next crosses the wire — at-least-once
+    redelivery, idempotent under the engine's (actor, seq) dedup."""
+
+
+class IngressGovernor:
+    """SLO-coupled admission control for the epoch-buffer plane (the
+    degrade-gracefully half of arxiv 1303.7462): when the fleet's
+    converge-p99 breaches its bound for `sustain_s` seconds, LOW-
+    PRIORITY ingress is delayed (mode="delay", default — each append
+    sleeps `delay_s` before buffering, throttling writers without
+    breaking the synchronous apply contract) or shed outright
+    (mode="shed" — the append raises IngressShedError, disclosed on
+    `sync_shed_dropped`; opt-in because the caller must own the retry).
+
+    `judge(converge_p99_s)` is the feed — wired to the SLO engine's
+    converge_p99 verdict (perf/slo.py SloEngine.governor) or driven
+    directly from the per-doc ledger's lag percentiles. Transitions are
+    disclosed: `sync_shed_active` gauge, `sync_shed_transitions`
+    counter, and a `shed_transition` flight-recorder event — shed load
+    must never be silent. `high_priority` (doc_id -> bool) protects the
+    ingress classes that must keep flowing (interactive docs, control
+    planes); everything else is "low priority".
+    """
+
+    def __init__(self, bound_s: float = 2.0, sustain_s: float = 1.0,
+                 delay_s: float = 0.02, mode: str = "delay",
+                 high_priority=None):
+        if mode not in ("delay", "shed"):
+            raise ValueError(f"unknown governor mode {mode!r}")
+        self.bound_s = bound_s
+        self.sustain_s = sustain_s
+        self.delay_s = delay_s
+        self.mode = mode
+        self.high_priority = high_priority or (lambda doc_id: False)
+        self.shedding = False
+        self._breach_since: float | None = None
+        self._lock = threading.Lock()
+
+    def judge(self, converge_p99_s: float | None,
+              now: float | None = None) -> bool:
+        """Feed one converge-p99 observation; returns the (possibly
+        updated) shedding state. None (no data) never transitions."""
+        if converge_p99_s is None:
+            return self.shedding
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if converge_p99_s > self.bound_s:
+                if self._breach_since is None:
+                    self._breach_since = now
+                if not self.shedding \
+                        and now - self._breach_since >= self.sustain_s:
+                    self._transition_locked(True, converge_p99_s)
+            else:
+                self._breach_since = None
+                if self.shedding:
+                    self._transition_locked(False, converge_p99_s)
+            return self.shedding
+
+    def _transition_locked(self, shedding: bool, p99: float) -> None:
+        self.shedding = shedding
+        metrics.gauge("sync_shed_active", 1 if shedding else 0)
+        metrics.bump("sync_shed_transitions")
+        flightrec.record("shed_transition", shedding=shedding,
+                         p99_s=round(float(p99), 4), bound_s=self.bound_s,
+                         mode=self.mode)
+
+    def admit(self, doc_id: str) -> float:
+        """Admission decision for one ingress: 0.0 = admit now; a
+        positive value = delay that many seconds before buffering;
+        raises IngressShedError in shed mode. One attribute check on
+        the un-governed steady state."""
+        if not self.shedding or self.high_priority(doc_id):
+            return 0.0
+        if self.mode == "shed":
+            metrics.bump("sync_shed_dropped")
+            raise IngressShedError(
+                f"ingress for {doc_id!r} shed under sustained "
+                f"converge-p99 breach (bound {self.bound_s}s)")
+        metrics.bump("sync_shed_delayed")
+        return self.delay_s
 
 
 class Flusher:

@@ -77,6 +77,20 @@ TRACE_KEY = "trace"
 # convergence lag").
 OPLAG_KEY = "oplag"
 
+# Subscription (interest) protocol message (sync/connection.py): a peer
+# declares WHICH docs it wants synced instead of the whole DocSet —
+# `{"sub": {"add": [...], "prefixes": [...], "remove": [...],
+# "remove_prefixes": [...], "reset": bool, "mode": "all"?,
+# "clocks": {doc: clock}}}`. Plain JSON, so it crosses the TCP envelope
+# and any reference-framing relay unchanged; peers that predate the
+# message keep full-DocSet sync (interest defaults to everything). The
+# optional `clocks` map carries the subscriber's current frontiers for
+# explicitly-added docs — the serving side backfills exactly the
+# missing suffix through the ordinary `missing_changes` snapshot read
+# plane, never a full-DocSet replay (docs/INTERNALS.md "Interest-based
+# partial replication").
+SUB_KEY = "sub"
+
 
 def msg_kind(msg: dict) -> str:
     """Coarse protocol-message class: the label space of the per-kind
@@ -89,6 +103,8 @@ def msg_kind(msg: dict) -> str:
         return f"metrics:{msg['metrics']}"
     if "audit" in msg:
         return f"audit:{msg['audit']}"
+    if "sub" in msg:
+        return "sub"
     if msg.get("frame") is not None:
         return "frame"
     if msg.get("changes") is not None:

@@ -320,6 +320,13 @@ class EngineDocSet:
         # every metrics pull / flight-recorder dump this node serves.
         # None when AMTPU_DOCLEDGER=0.
         self.doc_ledger = docledger.of(self)
+        # SLO-coupled admission control (sync/epochs.IngressGovernor):
+        # when attached, every epoch-path ingress consults it BEFORE
+        # buffering — under a sustained converge-p99 breach low-priority
+        # ingress is delayed (backpressure on the writer thread, off
+        # every lock) or shed with IngressShedError. None = ungoverned
+        # (one attribute check on the admission path).
+        self.ingress_governor: epochs.IngressGovernor | None = None
 
     # -- peer registry / compaction floor -----------------------------------
 
@@ -608,11 +615,28 @@ class EngineDocSet:
                                                 claimed=True)).wait()
         return self.get_doc(doc_id)
 
+    def attach_governor(self, governor) -> None:
+        """Attach an epochs.IngressGovernor: the SLO engine (or any
+        converge-lag feed) drives its judge(); governed admission then
+        delays or sheds low-priority epoch-path ingress while the
+        breach sustains. Detach with attach_governor(None)."""
+        self.ingress_governor = governor
+
     def _epoch_append(self, doc_id: str, cols, claimed: bool = False):
-        """Shared epoch admission: oplag-admit, one stripe-lock append,
-        kick the flusher. Both the synchronous and the pipelined ingress
+        """Shared epoch admission: governor check (SLO-coupled shedding,
+        see attach_governor), oplag-admit, one stripe-lock append, kick
+        the flusher. Both the synchronous and the pipelined ingress
         park on the returned ticket via PendingIngress.wait, so the
         wait/drain/re-raise contract lives in exactly one place."""
+        gov = self.ingress_governor
+        if gov is not None:
+            # delay happens HERE — on the writer thread, before any
+            # buffer or lock is touched, so backpressure lands on the
+            # low-priority sender alone (shed mode raises instead; the
+            # change is re-offered by the sender's next advert cycle)
+            d = gov.admit(doc_id)
+            if d:
+                _time.sleep(d)
         tok = oplag.admit(doc_id)
         ticket = self._epoch.append(doc_id, cols, tok, claimed=claimed)
         self._kick_or_flush()

@@ -30,6 +30,13 @@ hooks matching the three failure classes the doctor distinguishes:
   class the convergence ledger + `perf explain` must localize (bench
   config 12). Every other doc keeps syncing; the victim doc's clock
   keeps being advertised, so peers SEE the frontier they cannot reach.
+- **sub-flap** (`AMTPU_CHAOS_SUB_FLAP_DOC=<doc_id>`, cadence
+  `AMTPU_CHAOS_SUB_FLAP_EVERY`): subscribe/unsubscribe churn on one doc
+  at the SUBSCRIBER side of an explicit-interest connection
+  (sync/connection.py `_maybe_sub_flap`) — the interest-plane fault
+  class; the victim doc's lag must come out of `perf explain` as
+  doc_unsubscribed (with the churn noted from the ledger's sub_events
+  lane), never as a transport stall.
 
 Targeting: `AMTPU_CHAOS_NODE=<label>` restricts injection to services /
 transports whose owner set `_chaos_node` to that label — needed when
@@ -68,10 +75,15 @@ _sleep = time.sleep
 #: default seconds between two chaos lock holds
 DEFAULT_HOLD_EVERY_S = 0.2
 
+#: default sub_flap cadence: one subscribe/unsubscribe toggle per this
+#: many eligible received messages of the victim doc
+DEFAULT_FLAP_EVERY = 4
+
 
 class _Config:
     __slots__ = ("slow_apply_s", "lock_hold_s", "lock_hold_every_s",
-                 "drop_frames", "stall_doc_id", "node", "any")
+                 "drop_frames", "stall_doc_id", "sub_flap_doc_id",
+                 "sub_flap_every", "node", "any")
 
     def __init__(self):
         def _f(name, default=0.0):
@@ -85,9 +97,14 @@ class _Config:
             0.001, _f("AMTPU_CHAOS_LOCK_HOLD_EVERY_S", DEFAULT_HOLD_EVERY_S))
         self.drop_frames = min(1.0, max(0.0, _f("AMTPU_CHAOS_DROP_FRAMES")))
         self.stall_doc_id = os.environ.get("AMTPU_CHAOS_STALL_DOC") or None
+        self.sub_flap_doc_id = (os.environ.get("AMTPU_CHAOS_SUB_FLAP_DOC")
+                                or None)
+        self.sub_flap_every = max(
+            1, int(_f("AMTPU_CHAOS_SUB_FLAP_EVERY", DEFAULT_FLAP_EVERY)))
         self.node = os.environ.get("AMTPU_CHAOS_NODE") or None
         self.any = bool(self.slow_apply_s or self.lock_hold_s
-                        or self.drop_frames or self.stall_doc_id)
+                        or self.drop_frames or self.stall_doc_id
+                        or self.sub_flap_doc_id)
 
 
 _config: _Config | None = None
@@ -107,6 +124,7 @@ def reload() -> None:
     handle)."""
     global _config
     _config = None
+    _flap_counts.clear()
 
 
 def enabled() -> bool:
@@ -167,6 +185,35 @@ def stall_doc(node: str | None, doc_id: str) -> bool:
     if doc_id != c.stall_doc_id:
         return False
     _disclose("doc_stall", node, doc=doc_id)
+    return True
+
+
+# per-(node, doc) eligible-event counters for the sub_flap cadence —
+# cleared by reload() so per-case env flips restart the rhythm
+_flap_counts: dict = {}
+
+
+def sub_flap(node: str | None, doc_id: str) -> bool:
+    """True when the subscriber-side connection should TOGGLE its
+    subscription for exactly this doc (`AMTPU_CHAOS_SUB_FLAP_DOC=<doc>`,
+    cadence `AMTPU_CHAOS_SUB_FLAP_EVERY`, default one toggle per 4
+    eligible events): subscribe/unsubscribe churn — the interest-plane
+    fault class whose induced lag `perf explain` must attribute as
+    doc_unsubscribed-with-churn instead of flagging a stall. The hook
+    sits in Connection's receive path and only fires on connections
+    with an explicit local interest; every toggle is disclosed
+    (obs_chaos_injected{fault=sub_flap} + a chaos_inject event)."""
+    c = _cfg()
+    if c.sub_flap_doc_id is None or not _match(c, node):
+        return False
+    if doc_id != c.sub_flap_doc_id:
+        return False
+    key = (node, doc_id)
+    n = _flap_counts.get(key, 0) + 1
+    _flap_counts[key] = n
+    if n % c.sub_flap_every:
+        return False
+    _disclose("sub_flap", node, doc=doc_id)
     return True
 
 

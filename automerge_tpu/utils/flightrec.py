@@ -105,6 +105,16 @@ EVENT_KINDS: dict[str, str] = {
     "slo_verdict": "an SLO verdict transition (perf/slo.py; "
                    "slo/ok/value/bound — recorded on CHANGE, so the ring "
                    "shows when health flipped, not a heartbeat)",
+    # subscription / relay / shedding plane (sync/connection.py,
+    # sync/relay.py, sync/epochs.py — r12)
+    "sub_change": "a peer's interest set changed via a {'sub': ...} "
+                  "message (sync/connection.py; added/prefixes/removed)",
+    "relay_rehome": "a relay hub adopted an orphaned downstream "
+                    "connection after its previous hub died "
+                    "(sync/relay.py; node)",
+    "shed_transition": "the admission governor flipped between open and "
+                       "shedding (sync/epochs.IngressGovernor; "
+                       "shedding/p99_s/bound_s/mode)",
 }
 
 

@@ -200,6 +200,43 @@ COUNTERS: dict[str, str] = {
         "received changes already covered by the local clock at "
         "delivery — wasted wire work the engine dedups away "
         "(sync/connection.py; the redundancy ratio's numerator)",
+    # subscription layer (sync/connection.py InterestSet) + relay fabric
+    # (sync/relay.py) + SLO-coupled admission shedding (sync/epochs.py
+    # IngressGovernor): interest-based partial replication's control and
+    # disclosure plane (docs/INTERNALS.md "Interest-based partial
+    # replication")
+    "sync_sub_adds":
+        "interest entries (doc ids + prefixes) added to a peer's "
+        "subscription via {'sub': ...} messages (sync/connection.py)",
+    "sync_sub_removes":
+        "interest entries removed from a peer's subscription "
+        "(sync/connection.py; removed docs degrade to advert-only)",
+    "sync_sub_backfills":
+        "targeted late-subscribe backfills served — missing-suffix "
+        "pushes through the missing_changes snapshot read plane, never "
+        "a full-DocSet replay (sync/connection.py)",
+    "sync_sub_frames_suppressed":
+        "gossip events where interest filtering suppressed the "
+        "change-frame channel toward a peer (sync/connection.py; the "
+        "wire partial replication saves)",
+    "sync_sub_resubscribes":
+        "full-interest replays after a re-home (Connection."
+        "resubscribe; sync/relay.py adoption path)",
+    "sync_relay_sub_deduped":
+        "upstream subscription entries a relay hub suppressed because "
+        "its merged cover already held them (sync/relay.py; the "
+        "dedup-upward half of the fan-out tree)",
+    "sync_shed_delayed":
+        "low-priority epoch-path ingresses delayed by the admission "
+        "governor during a sustained converge-SLO breach "
+        "(sync/epochs.IngressGovernor mode='delay')",
+    "sync_shed_dropped":
+        "low-priority ingresses shed (IngressShedError) by the "
+        "admission governor (sync/epochs.IngressGovernor mode='shed')",
+    "sync_shed_transitions":
+        "admission-governor state transitions (open <-> shedding) "
+        "(sync/epochs.IngressGovernor; each also a shed_transition "
+        "flight-recorder event)",
     # per-doc convergence ledger (sync/docledger.py)
     "obs_doc_evictions":
         "tracked docs evicted from the ledger's top-K table into the "
@@ -211,7 +248,7 @@ COUNTERS: dict[str, str] = {
     # fleet health plane (perf/fleet.py, perf/slo.py, utils/chaos.py)
     "obs_chaos_injected":
         "chaos fault injections fired {fault=slow_apply|lock_hold|"
-        "frame_drop|doc_stall} (utils/chaos.py; inert unless "
+        "frame_drop|doc_stall|sub_flap} (utils/chaos.py; inert unless "
         "AMTPU_CHAOS_* set)",
     "obs_fleet_stragglers_flagged":
         "straggler flags raised by the fleet collector {node=...} "
@@ -292,6 +329,13 @@ GAUGES: dict[str, str] = {
         "duplicate deliveries / useful deliveries since reset "
         "(sync/docledger.py; the full-mesh fan-out waste partial "
         "replication exists to shrink)",
+    # subscription / relay / shedding plane (r12)
+    "sync_relay_cover_docs":
+        "entries (doc ids + prefixes) in a relay hub's merged "
+        "downstream cover set {node=...} (sync/relay.py)",
+    "sync_shed_active":
+        "admission governor state: 1 while low-priority ingress is "
+        "being delayed/shed, else 0 (sync/epochs.IngressGovernor)",
 }
 
 HISTOGRAMS: dict[str, str] = {

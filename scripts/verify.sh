@@ -11,11 +11,14 @@
 # Stage 2: perf report (INFORMATIONAL): the bench-history trajectory the
 #          regression gate reads, plus the contention & convergence-lag
 #          section (per-lock wait/hold, sampled op-lag stages — the
-#          baseline ROADMAP #1's ingestion refactor lands against) and
-#          the perf-doctor post-mortem over the last bench detail (ranked
+#          baseline ROADMAP #1's ingestion refactor lands against), the
+#          perf-doctor post-mortem over the last bench detail (ranked
 #          root causes per config — docs/OBSERVABILITY.md "Fleet
-#          health"). Never fails verify — a CPU-only image or a
-#          missing/empty history must not block the build
+#          health"), and the per-doc `perf explain` post-mortem beside
+#          it (one view set per captured config, incl. config 13's
+#          relay-tree run — docs/OBSERVABILITY.md "Partial replication,
+#          relay fan-out & shedding"). Never fails verify — a CPU-only
+#          image or a missing/empty history must not block the build
 #          (TUNNEL_DIAGNOSIS.md: TPU absence is an environment fact, not
 #          a code defect). Run `make perfcheck` for the enforcing gate.
 # Stage 3: the tier-1 pytest line EXACTLY as ROADMAP.md specifies it,

@@ -492,3 +492,85 @@ def test_norm_configs_carries_doc_obs_fields():
     assert c12["ledger_overhead_pct"] == 0.56
     assert c12["explain_attributed"] == 1
     assert c12["mesh_nodes"] == 4
+
+
+def test_sub_relay_gates_ok_over_and_absent(tmp_path):
+    """Config-13 partial-replication gates: growth exponent, bytes/sub
+    ceiling vs the flat baseline, relay redundancy, subscribed-doc SLO,
+    backfill — all absolute; runs without config 13 skip cleanly."""
+    p = str(tmp_path / "h.jsonl")
+
+    def srec(exp=0.74, frac=0.18, red=0.0, p99=0.07, bf=1,
+             source="test"):
+        return _rec(1000, source=source,
+                    configs={"13": {"fanout_growth_exponent": exp,
+                                    "fanout_vs_mesh_fraction": frac,
+                                    "sub_redundancy_ratio": red,
+                                    "sub_converge_p99_s": p99,
+                                    "sub_backfill_ok": bf}})
+
+    _write(p, [srec(), srec(source="ok")])
+    rc, lines = history.check(path=p)
+    assert rc == 0, lines
+    assert any("relay fan-out growth" in ln and "OK" in ln
+               for ln in lines)
+    assert any("bytes/subscriber vs flat baseline" in ln and "OK" in ln
+               for ln in lines)
+    assert any("relay redundancy ratio" in ln and "OK" in ln
+               for ln in lines)
+    assert any("subscribed-doc converge p99" in ln and "OK" in ln
+               for ln in lines)
+    assert any("late-subscribe backfill: OK" in ln for ln in lines)
+
+    _write(p, [srec(), srec(exp=1.02, source="linear")])
+    rc, lines = history.check(path=p)
+    assert rc == 1
+    assert any("FAN-OUT NOT SUBLINEAR" in ln for ln in lines)
+
+    _write(p, [srec(), srec(frac=0.8, source="fat")])
+    rc, lines = history.check(path=p)
+    assert rc == 1
+    assert any("FAN-OUT OVER MESH CEILING" in ln for ln in lines)
+
+    _write(p, [srec(), srec(red=1.5, source="dup")])
+    rc, lines = history.check(path=p)
+    assert rc == 1
+    assert any("RELAY REDUNDANCY OVER BUDGET" in ln for ln in lines)
+
+    _write(p, [srec(), srec(p99=3.0, source="slow")])
+    rc, lines = history.check(path=p)
+    assert rc == 1
+    assert any("SUBSCRIBED-DOC SLO BREACH" in ln for ln in lines)
+
+    _write(p, [srec(), srec(bf=0, source="nofill")])
+    rc, lines = history.check(path=p)
+    assert rc == 1
+    assert any("late-subscribe backfill: MISS" in ln for ln in lines)
+
+    _write(p, [srec(), _rec(1000, source="no-cfg13")])
+    rc, lines = history.check(path=p)
+    assert rc == 0
+    assert not any("relay fan-out" in ln for ln in lines)
+
+
+def test_norm_configs_carries_sub_relay_fields():
+    rec = {"backend": "cpu", "value": 10, "configs": {
+        "13": {"fanout_bytes_per_sub": 6662.5,
+               "mesh_bytes_per_sub": 36847.0,
+               "fanout_vs_mesh_fraction": 0.18,
+               "fanout_growth_exponent": 0.735,
+               "sub_redundancy_ratio": 0.0,
+               "sub_converge_p99_s": 0.066,
+               "sub_slo_bound_s": 2.0,
+               "sub_backfill_ok": 1,
+               "backfill": {"dropped": "(dict fields only ride the "
+                                       "detail sidecar)"}}}}
+    out = history.record_from_bench(rec)
+    c13 = out["configs"]["13"]
+    assert c13["fanout_growth_exponent"] == 0.735
+    assert c13["fanout_vs_mesh_fraction"] == 0.18
+    assert c13["mesh_bytes_per_sub"] == 36847.0
+    assert c13["sub_redundancy_ratio"] == 0.0
+    assert c13["sub_converge_p99_s"] == 0.066
+    assert c13["sub_backfill_ok"] == 1
+    assert "backfill" not in c13

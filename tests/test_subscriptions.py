@@ -1,0 +1,788 @@
+"""Subscription layer + relay fabric + SLO-coupled shedding (round 12).
+
+Covers the interest-based partial-replication plane end to end:
+
+- InterestSet semantics (cover/advert-only/unknown, prefix merge rule);
+- sender-side filtering: unsubscribed docs are never framed, never
+  advertised; explicitly-removed docs keep clock adverts but stop
+  frames;
+- late-subscribe backfill equals full-history convergence (hashes +
+  ConvergenceAuditor), via the missing_changes plane;
+- relay hubs: cover-set merge, deduped upward subscriptions, interest-
+  filtered fan-down, crash re-homing of downstream interest;
+- interest filtering composing with the chaos doc_stall fault, and the
+  new sub_flap chaos class (inert-unset pinned);
+- the admission governor: sustained converge-p99 breach -> delay/shed
+  low-priority ingress, disclosed on sync_shed_*; SLO-engine coupling;
+- the ledger's sub lanes + `perf explain` doc_unsubscribed cause + the
+  export-cap satellite (AMTPU_DOCLEDGER_K honored, --k, truncation
+  disclosed).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+import pytest
+
+from automerge_tpu.core.change import Change, Op
+from automerge_tpu.core.ids import ROOT_ID
+from automerge_tpu.sync import epochs
+from automerge_tpu.sync.connection import Connection, InterestSet
+from automerge_tpu.sync.docset import DocSet
+from automerge_tpu.sync.relay import RelayHub
+from automerge_tpu.sync.service import EngineDocSet
+from automerge_tpu.utils import chaos, metrics
+
+
+# ---------------------------------------------------------------------------
+# plumbing
+
+
+class Pair:
+    """Two Connections cross-wired through deques, pumped on demand."""
+
+    def __init__(self, ds_a, ds_b, wire="columnar", label_a=None,
+                 label_b=None):
+        self.qa, self.qb = deque(), deque()  # a->b, b->a
+        self.a = Connection(ds_a, self.qa.append, wire=wire)
+        self.b = Connection(ds_b, self.qb.append, wire=wire)
+        if label_a:
+            self.b.peer_label = label_a
+        if label_b:
+            self.a.peer_label = label_b
+
+    def pump(self):
+        for _ in range(10_000):
+            if not self.qa and not self.qb:
+                return
+            while self.qa:
+                self.b.receive_msg(self.qa.popleft())
+            while self.qb:
+                self.a.receive_msg(self.qb.popleft())
+        raise AssertionError("pair failed to quiesce")
+
+    def open(self):
+        self.a.open()
+        self.b.open()
+        self.pump()
+
+    def close(self):
+        for c in (self.a, self.b):
+            try:
+                c.close()
+            except Exception:
+                pass
+
+
+def _write(ds, doc, actor, seqs, n=1):
+    for _ in range(n):
+        seqs[(actor, doc)] = seqs.get((actor, doc), 0) + 1
+        ds.apply_changes(doc, [Change(
+            actor=actor, seq=seqs[(actor, doc)], deps={},
+            ops=[Op("set", ROOT_ID, key="k",
+                    value=seqs[(actor, doc)])])])
+
+
+@pytest.fixture(autouse=True)
+def _clean_metrics():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+# ---------------------------------------------------------------------------
+# InterestSet semantics
+
+
+def test_interest_defaults_to_everything():
+    it = InterestSet()
+    assert it.covers("anything") and it.wants_adverts("anything")
+    assert not it.explicit
+
+
+def test_interest_explicit_cover_advert_unknown():
+    it = InterestSet()
+    it.apply(add=["a"], prefixes=["chat/"])
+    assert it.explicit
+    assert it.covers("a") and it.covers("chat/7")
+    assert not it.covers("b") and not it.wants_adverts("b")
+    it.apply(remove=["a"])
+    assert not it.covers("a")          # frames stop...
+    assert it.wants_adverts("a")       # ...adverts keep flowing
+    # prefix-covered docs are NOT removable by doc id (prefix wins)
+    it.apply(remove=["chat/7"])
+    assert it.covers("chat/7")
+    it.apply(remove_prefixes=["chat/"])
+    assert not it.covers("chat/7")
+    # mode="all" resets everything
+    it.apply(mode="all")
+    assert it.covers("b") and not it.explicit
+
+
+def test_interest_apply_reports_newly_covered_only():
+    it = InterestSet()
+    new, newp = it.apply(add=["a", "b"])
+    assert new == ["a", "b"]
+    new, _ = it.apply(add=["a", "c"])   # a already covered
+    assert new == ["c"]
+    _, newp = it.apply(prefixes=["p/"])
+    assert newp == ["p/"]
+    new, _ = it.apply(add=["p/x"])      # under the prefix: not "new"
+    assert new == []
+
+
+# ---------------------------------------------------------------------------
+# sender-side filtering + backfill (engine services, columnar wire)
+
+
+def test_unsubscribed_docs_never_framed_never_advertised():
+    a, b = EngineDocSet(backend="rows"), EngineDocSet(backend="rows")
+    p = Pair(a, b)
+    seqs = {}
+    try:
+        p.b.subscribe(docs=["d0"])
+        p.pump()
+        p.open()
+        _write(a, "d0", "A", seqs, 3)
+        _write(a, "d1", "A", seqs, 3)
+        p.pump()
+        assert b.doc_ids == ["d0"]
+        assert b.clock_of("d0") == a.clock_of("d0")
+        # the ledger agrees: zero traffic lanes for d1 on b's side
+        if b.doc_ledger is not None:
+            sec = b.doc_ledger.section() or {}
+            assert "d1" not in (sec.get("docs") or {})
+        assert int(metrics.snapshot()
+                   .get("sync_sub_frames_suppressed", 0)) > 0
+    finally:
+        p.close()
+        a.close()
+        b.close()
+
+
+def test_unsubscribe_stops_frames_keeps_adverts():
+    a, b = EngineDocSet(backend="rows"), EngineDocSet(backend="rows")
+    p = Pair(a, b)
+    seqs = {}
+    try:
+        p.b.subscribe(docs=["d0", "d1"])
+        p.pump()
+        p.open()
+        _write(a, "d0", "A", seqs, 2)
+        _write(a, "d1", "A", seqs, 2)
+        p.pump()
+        assert b.clock_of("d0") == {"A": 2}
+        p.b.subscribe(remove=["d0"])
+        p.pump()
+        _write(a, "d0", "A", seqs, 3)
+        _write(a, "d1", "A", seqs, 1)
+        p.pump()
+        # frames stopped: b's d0 frontier froze; d1 kept syncing
+        assert b.clock_of("d0") == {"A": 2}
+        assert b.clock_of("d1") == {"A": 3}
+        # adverts kept flowing: b's ledger SEES the unreachable frontier
+        led = b.doc_ledger
+        assert led is not None
+        sec = led.section() or {}
+        lane = sec["docs"]["d0"]["peers"]
+        (pv,) = lane.values()
+        assert pv["advert_clock"] == {"A": 5}
+        assert pv["unsubscribed"] is True
+        assert sec["docs"]["d0"]["lag_changes"] == 3
+    finally:
+        p.close()
+        a.close()
+        b.close()
+
+
+def test_late_subscribe_backfill_equals_full_history():
+    a, b = EngineDocSet(backend="rows"), EngineDocSet(backend="rows")
+    p = Pair(a, b)
+    seqs = {}
+    try:
+        p.b.subscribe(docs=["warm"])
+        p.pump()
+        p.open()
+        _write(a, "warm", "A", seqs, 2)
+        for _ in range(10):
+            _write(a, "deep", "A", seqs, 3)
+            p.pump()
+        assert b.doc_ids == ["warm"]
+        backfills0 = int(metrics.snapshot().get("sync_sub_backfills", 0))
+        p.b.subscribe(docs=["deep"])
+        p.pump()
+        # byte-identical state: equal engine hashes on the shared docs
+        assert a.hashes_for(["deep", "warm"]) \
+            == b.hashes_for(["deep", "warm"])
+        assert b.clock_of("deep") == {"A": 30}
+        assert int(metrics.snapshot()
+                   .get("sync_sub_backfills", 0)) > backfills0
+        # and the auditor agrees (digests filtered to the intersection)
+        from automerge_tpu.sync.audit import ConvergenceAuditor
+        auditor = ConvergenceAuditor(b, p.b, period_s=0)
+        auditor.audit_once()
+        p.pump()
+        assert auditor.rounds_clean >= 1
+        assert not auditor.divergences
+    finally:
+        p.close()
+        a.close()
+        b.close()
+
+
+def test_prefix_subscription_and_backfill():
+    a, b = EngineDocSet(backend="rows"), EngineDocSet(backend="rows")
+    p = Pair(a, b)
+    seqs = {}
+    try:
+        p.open()
+        # b starts with explicit empty-ish interest
+        p.b.subscribe(docs=["other"])
+        p.pump()
+        for k in range(3):
+            _write(a, f"chat/{k}", "A", seqs, 2)
+        _write(a, "misc", "A", seqs, 2)
+        p.pump()
+        assert not any(d.startswith("chat/") for d in b.doc_ids)
+        p.b.subscribe(prefixes=["chat/"])
+        p.pump()
+        for k in range(3):
+            assert b.clock_of(f"chat/{k}") == {"A": 2}
+        assert "misc" not in b.doc_ids
+    finally:
+        p.close()
+        a.close()
+        b.close()
+
+
+def test_interest_composes_with_chaos_doc_stall(monkeypatch):
+    """A chaos-stalled doc inside the SUBSCRIBED set degrades to
+    adverts exactly as on a full-sync connection, while interest keeps
+    filtering everything else — the two planes compose."""
+    a, b = EngineDocSet(backend="rows"), EngineDocSet(backend="rows")
+    monkeypatch.setenv("AMTPU_CHAOS_STALL_DOC", "stalled")
+    chaos.reload()
+    p = Pair(a, b)
+    seqs = {}
+    try:
+        p.b.subscribe(docs=["stalled", "fine"])
+        p.pump()
+        p.open()
+        _write(a, "stalled", "A", seqs, 3)
+        _write(a, "fine", "A", seqs, 3)
+        _write(a, "unsub", "A", seqs, 3)
+        p.pump()
+        assert b.clock_of("fine") == {"A": 3}
+        assert "unsub" not in b.doc_ids          # interest filtered
+        assert "stalled" not in b.doc_ids or \
+            b.clock_of("stalled") == {}          # chaos suppressed
+        # ...but the advert got through: the ledger sees the frontier
+        sec = (b.doc_ledger.section() or {}).get("docs", {})
+        assert sec.get("stalled", {}).get("lag_changes", 0) >= 3
+        assert int(metrics.snapshot().get("sync_frames_dropped", 0)) > 0
+    finally:
+        monkeypatch.delenv("AMTPU_CHAOS_STALL_DOC", raising=False)
+        chaos.reload()
+        p.close()
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# relay fabric
+
+
+def _tree(n_leaves=4):
+    """root -> hub -> leaves, all plain DocSets, pump-on-demand."""
+    msgs = deque()
+    conns = {}
+
+    def link(ds_a, ds_b, name):
+        a = Connection(ds_a, lambda m, n=name: msgs.append((n + ".b", m)),
+                       wire="columnar")
+        b = Connection(ds_b, lambda m, n=name: msgs.append((n + ".a", m)),
+                       wire="columnar")
+        conns[name + ".a"], conns[name + ".b"] = a, b
+        return a, b
+
+    def pump():
+        for _ in range(100_000):
+            if not msgs:
+                return
+            name, m = msgs.popleft()
+            conns[name].receive_msg(m)
+        raise AssertionError("tree failed to quiesce")
+
+    root, hubds = DocSet(), DocSet()
+    hub = RelayHub(hubds, label="hub")
+    root_hub, hub_root = link(root, hubds, "rh")
+    hub.set_upstream(hub_root)
+    leaves, leaf_conns = [], []
+    for i in range(n_leaves):
+        leaf = DocSet()
+        hub_side, leaf_side = link(hubds, leaf, f"hl{i}")
+        hub.attach_child(hub_side)
+        leaves.append(leaf)
+        leaf_conns.append(leaf_side)
+    return root, hub, leaves, leaf_conns, conns, msgs, pump, link
+
+
+def test_relay_cover_merge_and_upward_dedup():
+    root, hub, leaves, leaf_conns, conns, msgs, pump, _link = _tree(3)
+    leaf_conns[0].subscribe(docs=["hot", "a"])
+    pump()
+    deduped0 = int(metrics.snapshot().get("sync_relay_sub_deduped", 0))
+    leaf_conns[1].subscribe(docs=["hot", "b"])
+    leaf_conns[2].subscribe(docs=["hot"])
+    pump()
+    docs, prefixes = hub.cover()
+    assert docs == {"hot", "a", "b"} and not prefixes
+    # "hot" went upstream ONCE; the two later adds were deduped
+    assert int(metrics.snapshot()
+               .get("sync_relay_sub_deduped", 0)) >= deduped0 + 2
+    # root's hub-facing peer interest is the merged cover
+    assert conns["rh.a"]._peer_interest.docs == {"hot", "a", "b"}
+
+
+def test_relay_fan_down_filtered_and_dedup_proven_by_lanes():
+    root, hub, leaves, leaf_conns, conns, msgs, pump, _link = _tree(3)
+    leaf_conns[0].subscribe(docs=["hot", "a"])
+    leaf_conns[1].subscribe(docs=["hot", "b"])
+    leaf_conns[2].subscribe(docs=["hot"])
+    pump()
+    for c in conns.values():
+        c.open()
+    pump()
+    seqs = {}
+    for d in ("hot", "a", "b", "cold"):
+        _write(root, d, "R", seqs, 2)
+        pump()
+    assert sorted(leaves[0].doc_ids) == ["a", "hot"]
+    assert sorted(leaves[1].doc_ids) == ["b", "hot"]
+    assert leaves[2].doc_ids == ["hot"]
+    assert "cold" not in hub.doc_set.doc_ids
+    for leaf in leaves:
+        assert leaf.get_doc("hot")._doc.opset.clock == {"R": 2}
+    snap = metrics.snapshot()
+    # the dedup proof: every delivery was useful — zero duplicates
+    assert int(snap.get("sync_conn_changes_delivered", 0)) > 0
+    assert int(snap.get("sync_conn_changes_duplicate", 0) or 0) == 0
+
+
+def test_relay_prefix_absorbs_doc_subscriptions_upstream():
+    root, hub, leaves, leaf_conns, conns, msgs, pump, _link = _tree(2)
+    leaf_conns[0].subscribe(docs=["chat/1"])
+    pump()
+    assert "chat/1" in conns["rh.a"]._peer_interest.docs
+    leaf_conns[1].subscribe(prefixes=["chat/"])
+    pump()
+    up = conns["rh.a"]._peer_interest
+    # the prefix went up; the absorbed doc-id sub was withdrawn
+    assert "chat/" in up.prefixes
+    assert up.covers("chat/1") and up.covers("chat/999")
+
+
+def test_relay_crash_rehomes_downstream_interest():
+    root, hub, leaves, leaf_conns, conns, msgs, pump, link = _tree(2)
+    leaf_conns[0].subscribe(docs=["hot"])
+    leaf_conns[1].subscribe(docs=["hot", "b"])
+    pump()
+    for c in conns.values():
+        c.open()
+    pump()
+    seqs = {}
+    _write(root, "hot", "R", seqs, 2)
+    _write(root, "b", "R", seqs, 2)
+    pump()
+    # hub dies: close its connections; leaf 1 re-homes DIRECTLY to root
+    for name in ("hl1.a", "hl1.b", "rh.a", "rh.b"):
+        conns[name].close()
+    orphan_interest = leaf_conns[1]._local_interest
+    root_side, leaf_side = link(root, leaves[1], "rehome")
+    leaf_side._local_interest = orphan_interest
+    leaf_side.resubscribe()
+    pump()
+    root_side.open()
+    leaf_side.open()
+    pump()
+    _write(root, "hot", "R", seqs, 2)
+    _write(root, "b", "R", seqs, 1)
+    pump()
+    assert leaves[1].get_doc("hot")._doc.opset.clock == {"R": 4}
+    assert leaves[1].get_doc("b")._doc.opset.clock == {"R": 3}
+    assert int(metrics.snapshot().get("sync_sub_resubscribes", 0)) == 1
+
+
+def test_relay_detach_child_releases_cover():
+    root, hub, leaves, leaf_conns, conns, msgs, pump, _link = _tree(2)
+    leaf_conns[0].subscribe(docs=["hot", "a"])
+    leaf_conns[1].subscribe(docs=["hot"])
+    pump()
+    hub.detach_child(conns["hl0.a"])
+    pump()
+    docs, _ = hub.cover()
+    assert docs == {"hot"}      # "a" released; "hot" still refcounted
+    up = conns["rh.a"]._peer_interest
+    assert not up.covers("a") and up.covers("hot")
+
+
+# ---------------------------------------------------------------------------
+# chaos sub_flap
+
+
+def test_sub_flap_inert_unset():
+    chaos.reload()
+    assert chaos.sub_flap(None, "any-doc") is False
+    assert "obs_chaos_injected{fault=sub_flap}" not in metrics.snapshot()
+
+
+def test_sub_flap_churns_subscription_and_is_disclosed(monkeypatch):
+    monkeypatch.setenv("AMTPU_CHAOS_SUB_FLAP_DOC", "victim")
+    monkeypatch.setenv("AMTPU_CHAOS_SUB_FLAP_EVERY", "2")
+    chaos.reload()
+    a, b = EngineDocSet(backend="rows"), EngineDocSet(backend="rows")
+    p = Pair(a, b)
+    seqs = {}
+    try:
+        p.b.subscribe(docs=["victim", "fine"])
+        p.pump()
+        p.open()
+        for _ in range(8):
+            _write(a, "victim", "A", seqs, 1)
+            _write(a, "fine", "A", seqs, 1)
+            p.pump()
+        snap = metrics.snapshot()
+        assert int(snap.get("obs_chaos_injected{fault=sub_flap}", 0)) > 0
+        # the ledger lane carries the churn evidence
+        sec = (b.doc_ledger.section() or {}).get("docs", {})
+        lane = next(iter(sec["victim"]["peers"].values()))
+        assert int(lane.get("sub_events") or 0) >= 2
+        assert b.clock_of("fine") == a.clock_of("fine")
+    finally:
+        chaos.reload()
+        p.close()
+        a.close()
+        b.close()
+
+
+def test_explain_names_doc_unsubscribed_not_a_stall():
+    """A lagging-but-unsubscribed doc is EXPLAINED (doc_unsubscribed),
+    never flagged in the hot list — the satellite contract."""
+    from automerge_tpu.perf import explain as ex
+
+    a, b = EngineDocSet(backend="rows"), EngineDocSet(backend="rows")
+    if a.doc_ledger is not None:
+        a.doc_ledger.label = "na"
+    if b.doc_ledger is not None:
+        b.doc_ledger.label = "nb"
+    p = Pair(a, b, label_a="na", label_b="nb")
+    seqs = {}
+    try:
+        p.b.subscribe(docs=["d0"])
+        p.pump()
+        p.open()
+        _write(a, "d0", "A", seqs, 2)
+        p.pump()
+        p.b.subscribe(remove=["d0"])
+        p.pump()
+        _write(a, "d0", "A", seqs, 3)
+        p.pump()
+        views = ex.gather_local()
+        rep = ex.explain_doc("d0", views, now=time.time())
+        causes = [c["cause"] for c in rep["causes"]]
+        assert causes and causes[0] == "doc_unsubscribed", causes
+        assert ex.hot_docs(views) == []
+    finally:
+        p.close()
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# admission governor (SLO-coupled shedding)
+
+
+def _cols(doc, seq):
+    from automerge_tpu.native.wire import changes_to_columns
+    return changes_to_columns([Change(
+        actor="W", seq=seq, deps={},
+        ops=[Op("set", ROOT_ID, key="k", value=seq)])])
+
+
+def test_governor_delays_low_priority_only_and_discloses():
+    svc = EngineDocSet(backend="rows")
+    gov = epochs.IngressGovernor(
+        bound_s=2.0, sustain_s=0.0, delay_s=0.03,
+        high_priority=lambda d: d.startswith("vip"))
+    svc.attach_governor(gov)
+    try:
+        assert gov.judge(0.5) is False
+        assert gov.judge(9.0) is True
+        t0 = time.perf_counter()
+        svc.apply_columns("low", _cols("low", 1))
+        slow = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        svc.apply_columns("vip-doc", _cols("vip-doc", 1))
+        vip = time.perf_counter() - t0
+        assert slow >= 0.03 and vip < slow
+        assert gov.judge(0.2) is False   # recovery transition
+        snap = metrics.snapshot()
+        assert int(snap.get("sync_shed_delayed", 0)) == 1
+        assert int(snap.get("sync_shed_transitions", 0)) == 2
+        assert snap.get("sync_shed_active") == 0
+    finally:
+        svc.close()
+
+
+def test_governor_sustain_window_filters_transients():
+    gov = epochs.IngressGovernor(bound_s=1.0, sustain_s=10.0)
+    now = time.monotonic()
+    assert gov.judge(5.0, now=now) is False          # breach starts
+    assert gov.judge(5.0, now=now + 5) is False      # not sustained yet
+    assert gov.judge(0.5, now=now + 6) is False      # recovered: reset
+    assert gov.judge(5.0, now=now + 7) is False      # new breach window
+    assert gov.judge(5.0, now=now + 18) is True      # sustained
+    assert gov.admit("anything") > 0
+
+
+def test_governor_shed_mode_raises_and_recovers():
+    svc = EngineDocSet(backend="rows")
+    gov = epochs.IngressGovernor(bound_s=1.0, sustain_s=0.0, mode="shed")
+    svc.attach_governor(gov)
+    try:
+        gov.judge(9.0)
+        with pytest.raises(epochs.IngressShedError):
+            svc.apply_columns("low", _cols("low", 1))
+        assert int(metrics.snapshot().get("sync_shed_dropped", 0)) == 1
+        gov.judge(0.1)
+        svc.apply_columns("low", _cols("low", 1))
+        assert svc.clock_of("low") == {"W": 1}
+    finally:
+        svc.close()
+
+
+def test_slo_engine_drives_governor():
+    from automerge_tpu.perf.slo import SloEngine
+
+    class FakeCollector:
+        def __init__(self, p99):
+            self.p99 = p99
+
+        def fleet_state(self):
+            return {"rollup": {"converge_p99_s": self.p99,
+                               "watchdog_fires": 0, "retraced": 0},
+                    "scrape": {"p50_s": 0.001}, "nodes": {}}
+
+    eng = SloEngine()
+    eng.governor = epochs.IngressGovernor(bound_s=2.0, sustain_s=0.0)
+    eng.evaluate(FakeCollector(9.0))
+    assert eng.governor.shedding is True
+    eng.evaluate(FakeCollector(0.1))
+    assert eng.governor.shedding is False
+
+
+# ---------------------------------------------------------------------------
+# export-cap satellite (AMTPU_DOCLEDGER_K / --k / truncation disclosure)
+
+
+def test_export_cap_default_32_and_truncation_disclosed(monkeypatch):
+    from automerge_tpu.sync import docledger
+    monkeypatch.delenv("AMTPU_DOCLEDGER_K", raising=False)
+    ds = DocSet()
+    led = docledger.DocLedger(ds, top_k=64)
+    assert led.export_k == 32
+    conn = object()
+    for k in range(50):
+        led.record_send(f"doc{k:03d}", conn, 1)
+    sec = led.section()
+    assert sec["exported"] == 32
+    assert sec["truncated"] == 18
+    # per-call override (the --k path)
+    sec_k = led.section(k=50)
+    assert sec_k["exported"] == 50 and sec_k["truncated"] == 0
+
+
+def test_export_cap_honors_explicit_env_k(monkeypatch):
+    from automerge_tpu.sync import docledger
+    monkeypatch.setenv("AMTPU_DOCLEDGER_K", "48")
+    ds = DocSet()
+    led = docledger.DocLedger(ds)
+    assert led.top_k == 48 and led.export_k == 48
+    conn = object()
+    for k in range(48):
+        led.record_send(f"doc{k:03d}", conn, 1)
+    sec = led.section()
+    assert sec["exported"] == 48 and sec["truncated"] == 0
+
+
+def test_perf_top_hot_doc_panel_states_truncation():
+    from automerge_tpu.perf.top import hot_doc_lines
+
+    class St:
+        def __init__(self, snap):
+            self.last_snapshot = snap
+
+    class Coll:
+        def __init__(self, snap):
+            self.nodes = {"n0": St(snap)}
+
+    snap = {"docledger": {"nodes": {"n0": {
+        "tracked": 40, "exported": 32, "truncated": 8,
+        "docs": {"d0": {"lag_changes": 5, "lag_s": 1.0, "buffered": 0,
+                        "behind_since": None, "behind_peer": "n1",
+                        "peers": {}}}}}}}
+    lines = hot_doc_lines(Coll(snap))
+    assert any("+8 tracked doc(s) beyond the export cap" in line
+               for line in lines)
+
+
+# ---------------------------------------------------------------------------
+# review-hardening regression pins (r12 post-review)
+
+
+def test_pure_remove_on_full_interest_never_darkens_connection():
+    """A remove-only first delta on a default full-interest connection
+    keeps mode 'all' (exclusion style): ONLY the removed doc degrades
+    to advert-only; every other doc keeps full sync. (Pre-fix, the set
+    flipped to explicit-empty and the whole connection went dark.)"""
+    it = InterestSet()
+    it.apply(remove=["noisy"])
+    assert not it.explicit
+    assert not it.covers("noisy") and it.wants_adverts("noisy")
+    assert it.covers("anything-else")
+    # a re-add lifts the exclusion and reports it newly covered
+    new, _ = it.apply(add=["noisy"])
+    assert new == ["noisy"] and it.covers("noisy")
+
+    # end-to-end: frames stop for the removed doc only
+    a, b = EngineDocSet(backend="rows"), EngineDocSet(backend="rows")
+    p = Pair(a, b)
+    seqs = {}
+    try:
+        p.open()
+        p.b.subscribe(remove=["noisy"])
+        p.pump()
+        _write(a, "noisy", "A", seqs, 2)
+        _write(a, "fine", "A", seqs, 2)
+        p.pump()
+        assert b.clock_of("fine") == {"A": 2}
+        assert "noisy" not in b.doc_ids
+        # adverts kept flowing: the exclusion is visible as honest lag
+        sec = (b.doc_ledger.section() or {}).get("docs", {})
+        assert sec.get("noisy", {}).get("lag_changes", 0) >= 2
+    finally:
+        p.close()
+        a.close()
+        b.close()
+
+
+def test_reset_resubscribe_does_not_inflate_hub_refcounts():
+    """A reset-form sub on the SAME connection (resubscribe after a
+    transient hiccup) must replace the peer interest, not double-count
+    it: when the child later detaches, the cover releases fully."""
+    root, hub, leaves, leaf_conns, conns, msgs, pump, _link = _tree(2)
+    leaf_conns[0].subscribe(docs=["hot"])
+    leaf_conns[1].subscribe(docs=["hot", "b"])
+    pump()
+    leaf_conns[1].resubscribe()      # same conn, reset form
+    pump()
+    docs, _ = hub.cover()
+    assert docs == {"hot", "b"}
+    hub.detach_child(conns["hl1.a"])
+    pump()
+    docs, _ = hub.cover()
+    assert docs == {"hot"}           # "b" fully released, "hot" kept
+    up = conns["rh.a"]._peer_interest
+    assert not up.covers("b") and up.covers("hot")
+
+
+def test_reset_to_empty_interest_stays_explicit():
+    it = InterestSet()
+    it.apply(add=["a"])
+    it.apply(remove=["a"])           # explicit, empty docs
+    wire = it.to_wire()
+    fresh = InterestSet()
+    fresh.apply(add=wire.get("add"), prefixes=wire.get("prefixes"),
+                remove=wire.get("remove"), mode=wire.get("mode"))
+    assert fresh.explicit
+    assert not fresh.covers("unrelated")
+
+
+def test_prefix_removal_restores_absorbed_upstream_doc_subs():
+    """A prefix that absorbed doc-id subscriptions upstream must give
+    them back when it departs — still-refcounted docs keep flowing."""
+    root, hub, leaves, leaf_conns, conns, msgs, pump, _link = _tree(2)
+    leaf_conns[0].subscribe(docs=["chat/1"])
+    leaf_conns[1].subscribe(prefixes=["chat/"])
+    pump()
+    up = conns["rh.a"]._peer_interest
+    assert "chat/" in up.prefixes
+    leaf_conns[1].subscribe(remove_prefixes=["chat/"])
+    pump()
+    up = conns["rh.a"]._peer_interest
+    assert "chat/" not in up.prefixes
+    assert up.covers("chat/1")       # the absorbed doc-sub came back
+    for c in conns.values():
+        c.open()
+    pump()
+    seqs = {}
+    _write(root, "chat/1", "R", seqs, 2)
+    pump()
+    assert leaves[0].get_doc("chat/1")._doc.opset.clock == {"R": 2}
+
+
+def test_auditor_stays_green_after_unsubscribe():
+    """An advert-only (unsubscribed) doc's frozen state must not turn
+    every audit round into a digest mismatch: both sides digest the
+    covered subset only."""
+    from automerge_tpu.sync.audit import ConvergenceAuditor
+
+    a, b = EngineDocSet(backend="rows"), EngineDocSet(backend="rows")
+    p = Pair(a, b)
+    seqs = {}
+    try:
+        p.b.subscribe(docs=["d0", "d1"])
+        p.pump()
+        p.open()
+        _write(a, "d0", "A", seqs, 2)
+        _write(a, "d1", "A", seqs, 2)
+        p.pump()
+        p.b.subscribe(remove=["d1"])
+        p.pump()
+        _write(a, "d1", "A", seqs, 3)   # b's d1 state is now frozen
+        _write(a, "d0", "A", seqs, 1)
+        p.pump()
+        auditor = ConvergenceAuditor(b, p.b, period_s=0)
+        auditor.audit_once()
+        p.pump()
+        assert auditor.rounds_clean >= 1, "frozen advert-only doc " \
+            "degraded the audit to a per-round bisect"
+        assert not auditor.divergences
+    finally:
+        p.close()
+        a.close()
+        b.close()
+
+
+def test_history_sub_gates_run_independently_per_field():
+    """A config-13 record missing only the growth exponent must still
+    judge the other four gates (no silent vacation)."""
+    import json
+    import tempfile
+
+    from automerge_tpu.perf import history
+
+    rec = {"schema": 1, "at": 0.0, "source": "test", "backend": "cpu",
+           "value": 1000, "unit": "ops/sec", "vs_baseline": 1.0,
+           "configs": {"13": {"sub_converge_p99_s": 9.0,
+                              "sub_backfill_ok": 0}}}
+    with tempfile.NamedTemporaryFile("w", suffix=".jsonl",
+                                     delete=False) as f:
+        f.write(json.dumps(rec) + "\n")
+        path = f.name
+    rc, lines = history.check(path=path)
+    assert rc == 1
+    assert any("SUBSCRIBED-DOC SLO BREACH" in ln for ln in lines)
+    assert any("late-subscribe backfill: MISS" in ln for ln in lines)
