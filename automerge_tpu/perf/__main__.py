@@ -1,7 +1,8 @@
 """CLI for the performance plane: `python -m automerge_tpu.perf
-{report,check,contention,doctor,explain,top,roofline,resident}`
+{report,check,contention,doctor,explain,top,remediate,roofline,resident}`
 (docs/OBSERVABILITY.md "Performance plane" / "Contention & convergence
-lag" / "Fleet health" / "Per-doc ledger & perf explain").
+lag" / "Fleet health" / "Per-doc ledger & perf explain" / "Remediation
+plane").
 
 - `doctor`  — ranked root-cause report: live against a fleet
   (--connect), or post-mortem against a BENCH_DETAIL.json / flight-
@@ -14,6 +15,9 @@ lag" / "Fleet health" / "Per-doc ledger & perf explain").
 - `top`     — live terminal dashboard (fleet table, SLO verdict strip,
   sparklines, per-doc hot list) driven by the fleet collector
   (perf/fleet.py).
+- `remediate` — the chaos-recovery smoke (verify.sh stage 2): injects
+  one conn_kill into a supervised TCP link and asserts the fleet
+  self-heals (perf/remediate.py).
 
 Exit codes: 0 = ok (including a gracefully skipped check), 1 = the
 regression gate tripped, 2 = usage error.
@@ -177,6 +181,11 @@ def main(argv=None) -> int:
     if cmd == "top":
         from . import top
         return top.main(rest)
+    if cmd == "remediate":
+        # the chaos-recovery smoke (verify.sh stage 2): one injected
+        # fault, assert the supervised link self-heals
+        from . import remediate
+        return remediate.smoke_main(rest)
     if cmd == "roofline":
         from . import roofline
         roofline.main(rest)
@@ -186,8 +195,8 @@ def main(argv=None) -> int:
         resident.main(rest)
         return 0
     print(f"unknown command {cmd!r}; expected one of "
-          "report, check, contention, doctor, explain, top, roofline, "
-          "resident",
+          "report, check, contention, doctor, explain, top, remediate, "
+          "roofline, resident",
           file=sys.stderr)
     return 2
 

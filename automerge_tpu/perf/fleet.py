@@ -160,6 +160,10 @@ class NodeState:
         self.last_at: float | None = None
         self.straggler_since: float | None = None
         self.straggler_signal: str | None = None
+        # quarantined by the remediation engine (perf/remediate.py):
+        # excluded from straggler scoring, rollups and SLO membership —
+        # like a stale node, but deliberate and sticky across reconnects
+        self.quarantined = False
 
     def add_sample(self, t: float, snapshot: dict) -> dict:
         """Fold one snapshot in; returns the derived dict (rates over the
@@ -249,6 +253,10 @@ class FleetCollector:
         self.k_sigma = k_sigma
         self.min_nodes = min_nodes
         self.slo_engine = slo_engine
+        # remediation engine (perf/remediate.py): tick()ed after every
+        # scrape+SLO pass with the freshly judged state — the diagnosis-
+        # to-action edge. None = observe-only (the default).
+        self.remediator = None
         self.nodes: dict[str, NodeState] = {}
         self._locals: list[tuple[str, object]] = []   # (name, snapshot_fn)
         self._wires: list[dict] = []                  # peer records
@@ -289,6 +297,47 @@ class FleetCollector:
             connection.request_metrics()
         except Exception:
             pass    # a dead transport just leaves the node stale
+
+    def remove_peer(self, connection) -> None:
+        """Drop a wire source whose transport died. The NodeState (and
+        its ring) survives: a reconnected peer self-reporting the same
+        node label re-adopts it via add_peer, so rates stay continuous
+        across transport generations (counter resets clamp to a quiet
+        tick) — and the label is no longer 'taken' by a dead record,
+        which is what would otherwise strand the replacement on a
+        positional name."""
+        for rec in list(self._wires):
+            if rec["conn"] is connection:
+                self._wires.remove(rec)
+                if getattr(connection, "on_peer_metrics", None) is not None:
+                    connection.on_peer_metrics = None
+
+    # -- quarantine (perf/remediate.py's isolation primitive) -----------------
+
+    def quarantine(self, name: str) -> None:
+        """Mark a node quarantined: excluded from straggler scoring,
+        rollups and (via derived=None) SLO membership until
+        unquarantined. Sticky across reconnects — a quarantined peer
+        that redials is still quarantined. The node stays in the table
+        with its marker: quarantine is disclosure, not amnesia."""
+        st = self._node(name, "node") if name not in self.nodes \
+            else self.nodes[name]
+        st.quarantined = True
+        self._refresh_quarantine_gauge()
+
+    def unquarantine(self, name: str) -> None:
+        st = self.nodes.get(name)
+        if st is not None:
+            st.quarantined = False
+        self._refresh_quarantine_gauge()
+
+    def quarantined(self) -> list[str]:
+        return sorted(n for n, st in self.nodes.items() if st.quarantined)
+
+    def _refresh_quarantine_gauge(self) -> None:
+        metrics.gauge("obs_remed_quarantined",
+                      sum(1 for st in self.nodes.values()
+                          if st.quarantined))
 
     def _node(self, name: str, role: str) -> NodeState:
         st = self.nodes.get(name)
@@ -367,6 +416,13 @@ class FleetCollector:
                 self.slo_engine.evaluate(self)
             except Exception:
                 pass    # a broken SLO spec must not kill the scraper
+        if self.remediator is not None:
+            try:
+                # AFTER the SLO pass: the remediation engine judges the
+                # same tick's verdicts, not last tick's
+                self.remediator.tick(state)
+            except Exception:
+                log.exception("remediation tick failed")
         return state
 
     def _judge(self, now: float) -> dict:
@@ -382,7 +438,8 @@ class FleetCollector:
         def _fresh(st: NodeState) -> bool:
             return st.last_at is not None and now - st.last_at <= stale_after
 
-        latest = {n: (st.latest() if _fresh(st) else None)
+        latest = {n: (st.latest()
+                      if _fresh(st) and not st.quarantined else None)
                   for n, st in self.nodes.items()}
         scores: dict[str, tuple[float, str | None]] = {
             n: (0.0, None) for n in self.nodes}
@@ -464,6 +521,7 @@ class FleetCollector:
                     "age_s": (round(now - st.last_at, 3)
                               if st.last_at is not None else None),
                     "stale": not _fresh(st),
+                    "quarantined": st.quarantined,
                     "straggler_score": round(scores[n][0], 3),
                     "straggler_signal": st.straggler_signal,
                     "flagged": n in stragglers,

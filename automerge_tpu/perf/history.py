@@ -130,6 +130,20 @@ SUB_REDUNDANCY_MAX = 1.2
 #: converge SLO (mirrors perf/slo.py DEFAULT_CONVERGE_P99_S).
 SUB_CONVERGE_P99_BUDGET_S = 2.0
 
+#: remediation gates (r13, config 14). All ABSOLUTE — properties of the
+#: remediation code, not of the host:
+#: every injected fault class must return the live fleet to SLO-green
+#: with zero human action inside this MTTR budget,
+REMED_MTTR_BUDGET_S = 30.0
+#: at least this many fault classes must be injected AND recovered
+#: (incl. conn_kill and a straggler fault — the bench enforces the mix),
+REMED_MIN_CLASSES = 4
+#: the remediation engine's steady-state judging duty cycle
+#: (tick-p50 / scrape interval) must stay under this percentage — the
+#: same 2% bar the collector (config 11) and the ledger (config 12)
+#: hold their own overhead to,
+REMED_BUDGET_PCT = 2.0
+
 #: config-8 fields copied into the history record's `fleet` section
 FLEET_KEYS = ("fleet_hashes_s", "fleet_hashes_first_s",
               "fleet_hashes_clean_shards", "fleet_hashes_dirty_shards",
@@ -249,7 +263,20 @@ def _norm_configs(raw) -> dict:
                                        "sub_redundancy_ratio",
                                        "sub_converge_p99_s",
                                        "sub_slo_bound_s",
-                                       "sub_backfill_ok")
+                                       "sub_backfill_ok",
+                                       # remediation (r13, config 14):
+                                       # chaos-to-green MTTR, recovered
+                                       # class count, dry-run proof,
+                                       # steady-state duty cycle
+                                       "mttr_max_s", "mttr_mean_s",
+                                       "mttr_budget_s",
+                                       "fault_classes_injected",
+                                       "fault_classes_recovered",
+                                       "remed_overhead_pct",
+                                       "remed_tick_p50_s",
+                                       "remed_dry_run_clean",
+                                       "remed_actions_total",
+                                       "reconnects_total")
                      if isinstance(v.get(k), (int, float, str))}
         elif isinstance(v, (int, float)):
             entry = {"speedup": v}
@@ -733,6 +760,52 @@ def check(path: str | None = None, record: dict | None = None,
                      + ("OK (auditor green, unsubscribed lanes "
                         "silent)" if bf else "MISS"))
         if not bf:
+            rc = 1
+
+    # remediation gates (r13, config 14): chaos-to-green MTTR bound,
+    # recovered-class floor, dry-run cleanliness, and the engine's
+    # steady-state duty cycle — all absolute (properties of the
+    # remediation code). Skip-clean: runs without config 14 never
+    # fail; each gate judges its own field independently.
+    def _rm(r: dict):
+        return ((r.get("configs") or {}).get("14") or {})
+
+    mttr = _rm(current).get("mttr_max_s")
+    if isinstance(mttr, (int, float)):
+        verdict = ("OK" if mttr <= REMED_MTTR_BUDGET_S
+                   else "MTTR OVER BUDGET")
+        lines.append(
+            f"  remediation MTTR (config 14, worst class): {mttr}s "
+            f"(budget <= {REMED_MTTR_BUDGET_S}s) -> {verdict}")
+        if mttr > REMED_MTTR_BUDGET_S:
+            rc = 1
+    rec_n = _rm(current).get("fault_classes_recovered")
+    if isinstance(rec_n, (int, float)):
+        inj_n = _rm(current).get("fault_classes_injected")
+        verdict = ("OK" if rec_n >= REMED_MIN_CLASSES
+                   else "TOO FEW CLASSES RECOVERED")
+        lines.append(
+            f"  remediation classes recovered: {int(rec_n)}"
+            + (f"/{int(inj_n)} injected"
+               if isinstance(inj_n, (int, float)) else "")
+            + f" (floor >= {REMED_MIN_CLASSES}) -> {verdict}")
+        if rec_n < REMED_MIN_CLASSES:
+            rc = 1
+    ovh = _rm(current).get("remed_overhead_pct")
+    if isinstance(ovh, (int, float)):
+        verdict = ("OK" if ovh < REMED_BUDGET_PCT
+                   else "REMEDIATION OVER BUDGET")
+        lines.append(
+            f"  remediation duty cycle: {ovh}% (budget < "
+            f"{REMED_BUDGET_PCT}%) -> {verdict}")
+        if ovh >= REMED_BUDGET_PCT:
+            rc = 1
+    dr = _rm(current).get("remed_dry_run_clean")
+    if dr is not None:
+        lines.append("  remediation dry-run: "
+                     + ("OK (intentions logged, nothing executed)"
+                        if dr else "EXECUTED SOMETHING"))
+        if not dr:
             rc = 1
 
     # keystroke-flatness gate (r8, config 7): latency at 4x document

@@ -137,6 +137,12 @@ class SloEngine:
         # plane delays/sheds low-priority ingress, disclosed on the
         # sync_shed_* series. None = observe-only (the default).
         self.governor = None
+        # verdict-transition subscriber (perf/remediate.py): called as
+        # on_transition(name, ok, value, bound) exactly when a
+        # transition is recorded — the remediation plane's "something
+        # changed" edge, so it never has to diff verdict tables. None =
+        # nobody listening.
+        self.on_transition = None
 
     def _value(self, slo: Slo, state: dict) -> float | None:
         if slo.signal in ("scrape_p50_s", "scrape_p99_s"):
@@ -206,6 +212,12 @@ class SloEngine:
                         bound=slo.bound)
                     if not ok:
                         metrics.bump("obs_slo_breaches", slo=slo.name)
+                    if self.on_transition is not None:
+                        try:
+                            self.on_transition(slo.name, bool(ok), value,
+                                               slo.bound)
+                        except Exception:
+                            pass   # a broken listener must not stop judging
             self.verdicts[slo.name] = rec
         return self.verdicts
 

@@ -305,7 +305,11 @@ class ConvergenceAuditor:
                   json.dumps(report, sort_keys=True, default=str))
         flightrec.record("divergence", shard=report["shard"],
                          doc=report["doc_id"])
-        flightrec.dump("divergence", extra={"divergence": report})
+        # force: every divergence is its own critical post-mortem — two
+        # distinct divergences inside one dump-cooldown window must BOTH
+        # be persisted, never deduped as a repeat trigger
+        flightrec.dump("divergence", extra={"divergence": report},
+                       force=True)
         if self.on_divergence is not None:
             try:
                 self.on_divergence(report)

@@ -390,6 +390,16 @@ class Connection:
 
     # -- subscriptions (SUB message type; sync partial replication) ---------
 
+    @property
+    def local_interest(self) -> InterestSet:
+        """THIS side's declared interest (what subscribe() built). The
+        reconnect supervisor (sync/tcp.SupervisedTcpClient) carries this
+        object across transport generations: a replacement connection is
+        seeded with it and `resubscribe()` replays it with clocks, so a
+        re-established link backfills exactly what the dead window
+        missed instead of resetting to full-DocSet sync."""
+        return self._local_interest
+
     def subscribe(self, docs=(), prefixes=(), remove=(),
                   remove_prefixes=(), everything: bool = False) -> None:
         """Declare interest to the peer: only subscribed docs are framed

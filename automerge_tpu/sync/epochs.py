@@ -317,6 +317,30 @@ class IngressGovernor:
                     self._transition_locked(False, converge_p99_s)
             return self.shedding
 
+    def force(self, shedding: bool, mode: str | None = None,
+              p99_s: float = 0.0) -> None:
+        """External state control (the remediation ladder,
+        perf/remediate.GovernorLadder): set the governed mode and the
+        shedding state directly, with the same transition disclosure
+        judge() performs. A ladder escalating delay -> shed, or relaxing
+        with hysteresis, owns the decision; this method only applies it
+        — the sustain timer resets so a later judge() feed starts
+        clean."""
+        if mode is not None and mode not in ("delay", "shed"):
+            raise ValueError(f"unknown governor mode {mode!r}")
+        with self._lock:
+            mode_changed = mode is not None and mode != self.mode
+            if mode is not None:
+                self.mode = mode
+            self._breach_since = None
+            # a mode flip while already shedding (the ladder's
+            # delay -> shed escalation, or the relax back) is a real
+            # severity change and must be disclosed like any other
+            # transition — appends START raising IngressShedError at
+            # that edge, and shed load must never be silent
+            if shedding != self.shedding or (mode_changed and shedding):
+                self._transition_locked(shedding, p99_s)
+
     def _transition_locked(self, shedding: bool, p99: float) -> None:
         self.shedding = shedding
         metrics.gauge("sync_shed_active", 1 if shedding else 0)

@@ -85,6 +85,13 @@ class RelayHub:
 
     # -- children ------------------------------------------------------------
 
+    def children(self) -> list[Connection]:
+        """Snapshot of the currently attached downstream connections —
+        what the remediation plane walks when a quarantined hub's
+        subtree must be re-homed (perf/remediate.rehome_children)."""
+        with self._lock:
+            return list(self._children)
+
     def attach_child(self, conn: Connection) -> None:
         """Adopt a downstream connection (hub-side). Its future sub
         messages re-merge the cover; interest it already declared (a

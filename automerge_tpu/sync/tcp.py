@@ -31,6 +31,7 @@ import json
 import socket
 import struct
 import threading
+import time
 
 from ..utils import chaos, lockprof
 from .connection import Connection
@@ -155,8 +156,10 @@ class LockedConnection(Connection):
     (interpretive doc objects, not thread-safe) keep the apply under the
     shared lock via `_apply_lock`."""
 
-    def __init__(self, doc_set, send_msg, wire: str = "json"):
-        super().__init__(doc_set, send_msg, wire=wire)
+    def __init__(self, doc_set, send_msg, wire: str = "json",
+                 local_interest=None):
+        super().__init__(doc_set, send_msg, wire=wire,
+                         local_interest=local_interest)
         self._lock = _sync_lock_of(doc_set)
         self._state_lock = self._lock
         if not getattr(doc_set, "concurrent_ingest", False):
@@ -166,8 +169,13 @@ class LockedConnection(Connection):
 class _Peer:
     """One socket bound to one Connection; reads frames on a thread."""
 
-    def __init__(self, doc_set, sock: socket.socket, wire: str = "json"):
+    def __init__(self, doc_set, sock: socket.socket, wire: str = "json",
+                 local_interest=None):
         self.sock = sock
+        # monotonic stamp of the last PROCESSED inbound message — not
+        # mere socket arrival: a chaos-hung peer still receives bytes,
+        # and the supervisor's idle detector must see through that
+        self.last_active = time.monotonic()
         # chaos targeting label, inherited from the doc_set this peer
         # serves (utils/chaos.py; None unless a bench/test labeled it)
         self._chaos_node = getattr(doc_set, "_chaos_node", None)
@@ -176,7 +184,8 @@ class _Peer:
         # peer_send}) and the post-mortem holder table names the thread
         # stuck inside the write
         self._send_lock = lockprof.InstrumentedLock("peer_send")
-        self.connection = LockedConnection(doc_set, self._send, wire=wire)
+        self.connection = LockedConnection(doc_set, self._send, wire=wire,
+                                           local_interest=local_interest)
         # named so flight-recorder event tails and watchdog span stacks
         # attribute socket work to the right peer reader (not "Thread-3")
         self._thread = threading.Thread(target=self._read_loop, daemon=True,
@@ -192,6 +201,23 @@ class _Peer:
         if chaos.drop_frame(self._chaos_node, _msg_kind(msg)):
             from ..utils import metrics
             metrics.bump("sync_frames_dropped")
+            return
+        if chaos.conn_kill(self._chaos_node):
+            # chaos conn_kill (utils/chaos.py): an established socket
+            # torn down mid-stream. Only the socket dies here — the read
+            # thread's exit runs the full close() (connection released,
+            # closed set), exactly like an organic transport death, so
+            # the supervisor sees the real failure signature.
+            from ..utils import metrics
+            metrics.bump("sync_frames_dropped")
+            try:
+                self.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self.sock.close()
+            except OSError:
+                pass
             return
         with self._send_lock:
             try:
@@ -215,7 +241,16 @@ class _Peer:
                 msg = recv_frame(self.sock)
                 if msg is None:
                     break
+                if chaos.peer_hang(self._chaos_node):
+                    # chaos peer_hang (utils/chaos.py): accepted but
+                    # unresponsive — the message is swallowed before any
+                    # processing, so nothing is applied and nothing
+                    # (metrics pulls included) is answered while the
+                    # window is open; last_active freezes, which is what
+                    # the supervisor's idle detector keys on
+                    continue
                 self.connection.receive_msg(msg)
+                self.last_active = time.monotonic()
         finally:
             # always release the Connection (and its compaction-floor
             # registry entry) — a receive_msg exception must not leave a
@@ -226,6 +261,16 @@ class _Peer:
         if not self.closed.is_set():
             self.closed.set()
             self.connection.close()
+            try:
+                # shutdown BEFORE close: a bare close() of an fd another
+                # thread is blocked in recv() on neither unblocks that
+                # thread nor sends FIN (the kernel socket stays
+                # referenced by the in-flight syscall) — the remote end
+                # would never learn the link died. shutdown() tears the
+                # connection down immediately on both sides.
+                self.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self.sock.close()
             except OSError:
@@ -258,6 +303,12 @@ class TcpSyncServer:
                 sock, _ = self._listener.accept()
             except OSError:
                 break
+            # prune dead peers as replacements arrive: supervised
+            # clients (SupervisedTcpClient) redial after every
+            # transport death, and an append-only list would grow one
+            # dead _Peer per reconnect forever on a long-lived server
+            self.peers = [p for p in self.peers
+                          if not p.closed.is_set()]
             peer = _Peer(self.doc_set, sock, wire=self.wire)
             self.peers.append(peer)
             peer.start()
@@ -276,10 +327,11 @@ class TcpSyncClient:
     """Connects a DocSet to a remote TcpSyncServer."""
 
     def __init__(self, doc_set, host: str, port: int, timeout: float = 10.0,
-                 wire: str = "json"):
+                 wire: str = "json", local_interest=None):
         sock = socket.create_connection((host, port), timeout=timeout)
         sock.settimeout(None)
-        self.peer = _Peer(doc_set, sock, wire=wire)
+        self.peer = _Peer(doc_set, sock, wire=wire,
+                          local_interest=local_interest)
 
     def start(self) -> "TcpSyncClient":
         self.peer.start()
@@ -287,3 +339,137 @@ class TcpSyncClient:
 
     def close(self) -> None:
         self.peer.close()
+
+
+class SupervisedTcpClient:
+    """Self-healing TCP client: a supervisor thread owns the link's
+    lifecycle, so a dead read thread is a reconnect, not a silent stop.
+
+    Before this class, a peer socket dying mid-stream left the fleet in
+    the worst failure mode the sync layer has: the TCP read thread exits,
+    the Connection unregisters, and convergence for that peer simply
+    STOPS — no error reaches the application, the node just drifts (the
+    r13 remediation plane's motivating gap). The supervisor closes that
+    hole:
+
+    - **exponential-backoff reconnect**: on transport death (organic
+      OSError, chaos `conn_kill`, a force_reconnect() from the
+      remediation engine), the supervisor redials with backoff doubling
+      from `backoff_s` up to `backoff_max_s`, resetting after each
+      successful connect. Attempts/successes land on
+      `sync_reconnect_attempts` / `sync_reconnects`, and each
+      re-established link records a `remed_action` event
+      (action=reconnect) — self-healing is never silent.
+    - **targeted backfill**: the carried InterestSet (one object across
+      transport generations) seeds every replacement connection, and a
+      narrowed interest is replayed via `resubscribe()` — reset form
+      WITH clocks — so the serving side pushes exactly the suffix the
+      dead window missed through its missing_changes snapshot read
+      plane. Full-interest links recover through the ordinary
+      anti-entropy of `open()`'s re-adverts.
+    - **inbound-idle detection** (`idle_reconnect_s`, opt-in): a live
+      socket whose PROCESSED inbound activity goes quiet past the
+      threshold is torn down and redialed (`sync_reconnect_idle_kicks`)
+      — the only way to catch an accepted-but-unresponsive peer (chaos
+      `peer_hang`), whose socket never errors. Stamped on processed
+      messages, not arrivals, so a hung reader cannot look alive.
+    """
+
+    def __init__(self, doc_set, host: str, port: int, wire: str = "json",
+                 local_interest=None, backoff_s: float = 0.25,
+                 backoff_max_s: float = 5.0,
+                 idle_reconnect_s: float | None = None,
+                 connect_timeout: float = 10.0, node: str | None = None,
+                 on_reconnect=None):
+        self._doc_set = doc_set
+        self._host, self._port, self._wire = host, port, wire
+        self._interest = local_interest
+        self.backoff_s = backoff_s
+        self.backoff_max_s = backoff_max_s
+        self.idle_reconnect_s = idle_reconnect_s
+        self._connect_timeout = connect_timeout
+        self._node = node or getattr(doc_set, "_chaos_node", None)
+        self.on_reconnect = on_reconnect
+        self.generation = 0
+        self._client: TcpSyncClient | None = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"amtpu-tcp-supervisor-{port}")
+
+    @property
+    def connection(self) -> Connection | None:
+        cli = self._client
+        return cli.peer.connection if cli is not None else None
+
+    def start(self) -> "SupervisedTcpClient":
+        self._thread.start()
+        return self
+
+    def force_reconnect(self) -> None:
+        """Tear the current link down; the supervisor redials. The
+        remediation engine's `reconnect` action for wedged-but-open
+        connections routes here."""
+        cli = self._client
+        if cli is not None:
+            cli.close()
+
+    def close(self) -> None:
+        """Stop supervising and close the link (idempotent; joins)."""
+        self._stop.set()
+        cli = self._client
+        if cli is not None:
+            cli.close()
+        self._thread.join(timeout=10.0)
+
+    def _loop(self) -> None:
+        from ..utils import flightrec, metrics
+        backoff = self.backoff_s
+        while not self._stop.is_set():
+            metrics.bump("sync_reconnect_attempts")
+            try:
+                cli = TcpSyncClient(
+                    self._doc_set, self._host, self._port,
+                    timeout=self._connect_timeout, wire=self._wire,
+                    local_interest=self._interest)
+            except OSError:
+                if self._stop.wait(backoff):
+                    return
+                backoff = min(backoff * 2.0, self.backoff_max_s)
+                continue
+            cli.start()
+            self._client = cli
+            self.generation += 1
+            conn = cli.peer.connection
+            if self._interest is None:
+                # adopt generation 1's set: later generations carry it
+                self._interest = conn.local_interest
+            if self.generation > 1:
+                metrics.bump("sync_reconnects")
+                flightrec.record("remed_action", action="reconnect",
+                                 node=self._node,
+                                 generation=self.generation)
+                if self._interest.narrowed:
+                    try:
+                        conn.resubscribe()
+                    except Exception:
+                        pass   # the link may die again; the loop retries
+                if self.on_reconnect is not None:
+                    try:
+                        self.on_reconnect(conn)
+                    except Exception:
+                        pass
+            backoff = self.backoff_s        # healthy link: reset
+            while not self._stop.is_set():
+                if cli.peer.closed.wait(timeout=0.1):
+                    break
+                if self.idle_reconnect_s is not None and \
+                        time.monotonic() - cli.peer.last_active \
+                        > self.idle_reconnect_s:
+                    metrics.bump("sync_reconnect_idle_kicks")
+                    cli.close()
+                    break
+            cli.close()
+            if self._stop.wait(backoff):
+                return
+            backoff = min(backoff * 2.0, self.backoff_max_s)

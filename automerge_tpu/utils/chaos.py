@@ -37,6 +37,26 @@ hooks matching the three failure classes the doctor distinguishes:
   class; the victim doc's lag must come out of `perf explain` as
   doc_unsubscribed (with the churn noted from the ledger's sub_events
   lane), never as a transport stall.
+- **conn-kill** (`AMTPU_CHAOS_CONN_KILL_AFTER=<n>`): tear down an
+  ESTABLISHED peer socket mid-stream — the n-th outgoing transport
+  message of an affected peer hard-closes the socket instead of being
+  written (sync/tcp.py `_Peer._send`). Fires ONCE per node key, then
+  stays inert until `reload()`: the fault under test is a single
+  transport death, and the thing being proven is that the reconnect
+  supervisor (sync/tcp.SupervisedTcpClient) brings the link back and
+  `resubscribe()` backfills what the dead window missed — the
+  remediation plane's acceptance input (bench config 14).
+- **peer-hang** (`AMTPU_CHAOS_PEER_HANG_S=<seconds>`, onset
+  `AMTPU_CHAOS_PEER_HANG_AFTER=<n>`, default 1): an accepted but
+  UNRESPONSIVE peer — for that many seconds from the n-th eligible
+  receive, an affected peer's transport reader swallows every incoming
+  message unprocessed (sync/tcp.py `_Peer._read_loop`): the socket
+  stays open and deliverable, but nothing is applied and nothing
+  (metrics pulls included) is answered. The onset count lets a bench
+  open the window mid-traffic instead of on the very first handshake
+  message. The supervisor's idle detector is what must notice — a
+  dead-quiet inbound link with a live socket — and force a reconnect
+  whose resubscribe recovers the swallowed suffix.
 
 Targeting: `AMTPU_CHAOS_NODE=<label>` restricts injection to services /
 transports whose owner set `_chaos_node` to that label — needed when
@@ -83,7 +103,8 @@ DEFAULT_FLAP_EVERY = 4
 class _Config:
     __slots__ = ("slow_apply_s", "lock_hold_s", "lock_hold_every_s",
                  "drop_frames", "stall_doc_id", "sub_flap_doc_id",
-                 "sub_flap_every", "node", "any")
+                 "sub_flap_every", "conn_kill_after", "peer_hang_s",
+                 "peer_hang_after", "node", "any")
 
     def __init__(self):
         def _f(name, default=0.0):
@@ -101,10 +122,15 @@ class _Config:
                                 or None)
         self.sub_flap_every = max(
             1, int(_f("AMTPU_CHAOS_SUB_FLAP_EVERY", DEFAULT_FLAP_EVERY)))
+        self.conn_kill_after = max(0, int(_f("AMTPU_CHAOS_CONN_KILL_AFTER")))
+        self.peer_hang_s = max(0.0, _f("AMTPU_CHAOS_PEER_HANG_S"))
+        self.peer_hang_after = max(1, int(_f("AMTPU_CHAOS_PEER_HANG_AFTER",
+                                             1)))
         self.node = os.environ.get("AMTPU_CHAOS_NODE") or None
         self.any = bool(self.slow_apply_s or self.lock_hold_s
                         or self.drop_frames or self.stall_doc_id
-                        or self.sub_flap_doc_id)
+                        or self.sub_flap_doc_id or self.conn_kill_after
+                        or self.peer_hang_s)
 
 
 _config: _Config | None = None
@@ -125,6 +151,9 @@ def reload() -> None:
     global _config
     _config = None
     _flap_counts.clear()
+    _kill_counts.clear()
+    _hang_counts.clear()
+    _hang_started.clear()
 
 
 def enabled() -> bool:
@@ -214,6 +243,66 @@ def sub_flap(node: str | None, doc_id: str) -> bool:
     if n % c.sub_flap_every:
         return False
     _disclose("sub_flap", node, doc=doc_id)
+    return True
+
+
+# per-node outgoing-message counters for conn_kill; the sentinel -1
+# marks "already fired" (one transport death per node key per reload)
+_kill_counts: dict = {}
+
+# per-node peer_hang state: receive count until onset, then the wall
+# clock the window opened at; cleared by reload()
+_hang_counts: dict = {}
+_hang_started: dict = {}
+
+
+def conn_kill(node: str | None = None) -> bool:
+    """True exactly ONCE per node key, on the n-th eligible outgoing
+    transport message (`AMTPU_CHAOS_CONN_KILL_AFTER=<n>`): the caller
+    (sync/tcp.py `_Peer._send`) hard-closes the socket instead of
+    writing — an established connection torn down mid-stream, the
+    reconnect supervisor's acceptance input. Inert unset; fires once
+    and then stays quiet until reload() (the fault under test is a
+    single transport death, not flapping — churn is sub_flap's job)."""
+    c = _cfg()
+    if not c.conn_kill_after or not _match(c, node):
+        return False
+    n = _kill_counts.get(node, 0)
+    if n < 0:
+        return False            # already fired for this node key
+    n += 1
+    if n < c.conn_kill_after:
+        _kill_counts[node] = n
+        return False
+    _kill_counts[node] = -1
+    _disclose("conn_kill", node, after=c.conn_kill_after)
+    return True
+
+
+def peer_hang(node: str | None = None) -> bool:
+    """True while the hang window is open (`AMTPU_CHAOS_PEER_HANG_S=
+    <seconds>`, opening at the `AMTPU_CHAOS_PEER_HANG_AFTER`-th
+    eligible receive — default 1, i.e. immediately): the caller
+    (sync/tcp.py `_Peer._read_loop`) swallows the incoming message
+    unprocessed — an accepted but unresponsive peer. The socket stays
+    open and keeps delivering, so nothing times out at the transport;
+    only an idle detector watching PROCESSED inbound activity
+    (SupervisedTcpClient `idle_reconnect_s`) can tell this apart from a
+    quiet link. Every swallow is disclosed."""
+    c = _cfg()
+    if not c.peer_hang_s or not _match(c, node):
+        return False
+    now = time.monotonic()
+    started = _hang_started.get(node)
+    if started is None:
+        n = _hang_counts.get(node, 0) + 1
+        _hang_counts[node] = n
+        if n < c.peer_hang_after:
+            return False        # window not open yet
+        _hang_started[node] = started = now
+    if now - started >= c.peer_hang_s:
+        return False            # window expired: responsive again
+    _disclose("peer_hang", node, s=c.peer_hang_s)
     return True
 
 

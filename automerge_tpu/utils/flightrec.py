@@ -26,7 +26,16 @@ its peer, and the last thing every thread did — instead of a bare
 Env knobs: AMTPU_FLIGHTREC=0 disables recording entirely;
 AMTPU_FLIGHTREC_DIR picks the dump directory (default: the system temp
 dir); AMTPU_FLIGHTREC_EVENTS sizes the ring; AMTPU_FLIGHTREC_PER_THREAD
-caps the per-thread event tail embedded in a dump (default 64).
+caps the per-thread event tail embedded in a dump (default 64);
+AMTPU_FLIGHTREC_COOLDOWN_S (default 30, 0 disables) rate-limits
+auto-pathed dumps PER TRIGGER CLASS — a watchdog firing every budget
+window, or a remediation escalation loop, must not write an unbounded
+dump storm to disk. The class is the reason string itself (reasons are
+already class-shaped: "watchdog:<name>", "exception", "remed:<action>");
+within the cooldown a repeat trigger returns the PREVIOUS dump path,
+bumps `obs_flightrec_suppressed{reason=<class>}`, and writes nothing.
+An explicit `path=` or `force=True` always dumps — a caller that names
+a destination is deliberate, not a storm.
 """
 
 from __future__ import annotations
@@ -45,12 +54,18 @@ log = logging.getLogger("automerge_tpu.flightrec")
 _ENABLED = os.environ.get("AMTPU_FLIGHTREC", "1") != "0"
 _RING = int(os.environ.get("AMTPU_FLIGHTREC_EVENTS", "2048"))
 _PER_THREAD = int(os.environ.get("AMTPU_FLIGHTREC_PER_THREAD", "64"))
+try:
+    _COOLDOWN_S = float(os.environ.get("AMTPU_FLIGHTREC_COOLDOWN_S", "30"))
+except ValueError:
+    _COOLDOWN_S = 30.0
 
 _lock = threading.Lock()
 _events: deque = deque(maxlen=_RING)
 _seq = 0
 _dump_count = 0
 _last_dump_path: str | None = None
+# per-trigger-class dump dedup: reason -> (monotonic stamp, path written)
+_dump_stamps: dict[str, tuple[float, str | None]] = {}
 
 # Event-kind registry: every `record(kind, ...)` call site in the package
 # must use a kind declared here (enforced statically — the graftlint
@@ -115,6 +130,15 @@ EVENT_KINDS: dict[str, str] = {
     "shed_transition": "the admission governor flipped between open and "
                        "shedding (sync/epochs.IngressGovernor; "
                        "shedding/p99_s/bound_s/mode)",
+    # remediation plane (perf/remediate.py, sync/tcp.SupervisedTcpClient
+    # — r13)
+    "remed_action": "a remediation action was executed — or, in dry-run, "
+                    "would have been (perf/remediate.py; action/node/"
+                    "dry_run/evidence; reconnects recorded by the "
+                    "supervisor carry action=reconnect)",
+    "remed_recovered": "a remediation episode closed: the fleet returned "
+                       "to SLO-green with zero human action "
+                       "(perf/remediate.py; mttr_s/actions)",
 }
 
 
@@ -151,6 +175,7 @@ def reset() -> None:
     with _lock:
         _events.clear()
         _seq = 0
+        _dump_stamps.clear()
 
 
 def last_dump() -> str | None:
@@ -174,17 +199,42 @@ def _json_default(o):
 
 
 def dump(reason: str, path: str | None = None,
-         extra: dict | None = None) -> str | None:
+         extra: dict | None = None, force: bool = False) -> str | None:
     """Write the post-mortem JSON: per-thread event tails, active span
     stacks, recent completed spans, watchdog diagnoses, and the metrics
     snapshot. Never raises (a broken dump must not mask the failure being
     dumped); returns the file path, or None when disabled or the write
-    failed."""
+    failed.
+
+    Auto-pathed dumps are rate-limited per trigger class (the reason
+    string): a repeat trigger within AMTPU_FLIGHTREC_COOLDOWN_S is
+    suppressed — counted on `obs_flightrec_suppressed{reason=...}`,
+    returning the class's previous path so callers embedding "the dump"
+    in a report still point somewhere real. `last_dump()` is NOT
+    updated by a suppressed call. `path=`/`force=True` bypass."""
     global _dump_count, _last_dump_path
     if not _ENABLED:
         return None
     try:
         from . import metrics
+
+        rate_limited = path is None and not force and _COOLDOWN_S > 0
+        if rate_limited:
+            with _lock:
+                prev = _dump_stamps.get(reason)
+            # the stamp is written only AFTER a successful dump (below):
+            # a failed or still-in-flight first write must not silence
+            # the whole trigger class for a cooldown window — the rare
+            # race of two threads passing this check together costs one
+            # extra dump, the opposite bias costs the post-mortem
+            if prev is not None \
+                    and time.monotonic() - prev[0] < _COOLDOWN_S:
+                # bounded label: the reason class, not the full string
+                metrics.bump("obs_flightrec_suppressed",
+                             reason=reason.split(":")[0])
+                log.debug("flight-recorder dump suppressed (reason %s "
+                          "within %.0fs cooldown)", reason, _COOLDOWN_S)
+                return prev[1]
 
         with _lock:
             evs = list(_events)
@@ -222,6 +272,11 @@ def dump(reason: str, path: str | None = None,
         with open(path, "w") as f:
             json.dump(doc, f, indent=1, default=_json_default)
         _last_dump_path = path
+        if rate_limited:
+            with _lock:
+                # stamped on SUCCESS only, carrying the path a later
+                # suppressed repeat of this trigger class will return
+                _dump_stamps[reason] = (time.monotonic(), path)
         # bounded label: the reason class, not the full reason string
         metrics.bump("obs_flightrec_dumps", reason=reason.split(":")[0])
         log.warning("flight recorder dumped to %s (reason: %s)",

@@ -255,6 +255,34 @@ COUNTERS: dict[str, str] = {
         "(perf/fleet.py; counted on the transition into flagged)",
     "obs_slo_breaches":
         "SLO verdict transitions into breach {slo=...} (perf/slo.py)",
+    # remediation plane (perf/remediate.py + sync/tcp.py supervisor —
+    # r13): every automated action, withhold, and recovery disclosed
+    "obs_remed_actions":
+        "remediation actions EXECUTED {action=quarantine|reconnect|"
+        "governor_escalate|governor_relax} (perf/remediate.py; dry-run "
+        "intentions never land here)",
+    "obs_remed_skipped":
+        "remediation actions withheld by a guardrail {reason=cooldown|"
+        "budget|quorum|dry_run} (perf/remediate.py)",
+    "obs_remed_recovered":
+        "remediation episodes closed with the fleet back to SLO-green "
+        "(perf/remediate.py; each also a remed_recovered event with "
+        "the measured MTTR)",
+    "obs_flightrec_suppressed":
+        "flight-recorder dumps suppressed by the per-trigger-class "
+        "cooldown {reason=...} (utils/flightrec.py; a dump storm is "
+        "throttled, never unbounded)",
+    "sync_reconnect_attempts":
+        "socket (re)connection attempts by the reconnect supervisor "
+        "(sync/tcp.SupervisedTcpClient; includes the refused ones)",
+    "sync_reconnects":
+        "successful reconnections after a transport death — generation "
+        ">= 2 links brought back by the supervisor (sync/tcp.py)",
+    "sync_reconnect_idle_kicks":
+        "reconnects forced by the inbound-idle detector — a live socket "
+        "whose PROCESSED inbound activity went quiet past "
+        "idle_reconnect_s (sync/tcp.SupervisedTcpClient; the peer_hang "
+        "fault's detection path)",
 }
 
 GAUGES: dict[str, str] = {
@@ -336,6 +364,14 @@ GAUGES: dict[str, str] = {
     "sync_shed_active":
         "admission governor state: 1 while low-priority ingress is "
         "being delayed/shed, else 0 (sync/epochs.IngressGovernor)",
+    # remediation plane (perf/remediate.py — r13)
+    "obs_remed_quarantined":
+        "nodes currently quarantined by the remediation engine "
+        "(perf/fleet.py; excluded from straggler scoring, rollups and "
+        "SLO membership until unquarantined)",
+    "obs_remed_governor_stage":
+        "admission-governor escalation ladder stage: 0 open / 1 delay "
+        "/ 2 shed (perf/remediate.GovernorLadder)",
 }
 
 HISTOGRAMS: dict[str, str] = {
@@ -364,6 +400,10 @@ HISTOGRAMS: dict[str, str] = {
         "convergence-ledger self-time flushed per snapshot export "
         "(sync/docledger.py; sum/elapsed = the duty-cycle bound the "
         "config-12 perf-check gate holds under 2%)",
+    "obs_remed_tick_s":
+        "remediation-engine per-tick wall cost (perf/remediate.py; "
+        "p50/interval = the steady-state duty cycle bench config 14 "
+        "bounds under 2%)",
 }
 
 SPANS: dict[str, str] = {

@@ -1444,13 +1444,15 @@ def run_bulk_merge_config(base_chars=1_000_000, concurrency=0.01,
 
 
 def _spawn_fleet_peer(name: str, host: str, port: int, seconds: float,
-                      chaos_env: dict | None, stderr_path: str):
+                      chaos_env: dict | None, stderr_path: str,
+                      extra_args: list | None = None):
     """One fleet peer as a REAL subprocess: its metrics registry, oplag
     reservoirs, and chaos env are process-scoped, so the collector's
     per-node snapshots are honest (an in-process 'fleet' shares one
     metrics singleton and can only fake this). The degraded peer is
     degraded by its ENVIRONMENT — no peer-side code knows it is the
-    victim."""
+    victim. `extra_args` rides extra --fleet-peer flags (config 14's
+    --supervised/--peer-idle-s)."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["AMTPU_NODE_NAME"] = name
@@ -1461,7 +1463,7 @@ def _spawn_fleet_peer(name: str, host: str, port: int, seconds: float,
     env.update(chaos_env or {})
     cmd = [sys.executable, os.path.abspath(__file__), "--fleet-peer",
            "--connect", f"{host}:{port}", "--peer-name", name,
-           "--peer-seconds", str(seconds)]
+           "--peer-seconds", str(seconds)] + list(extra_args or ())
     with open(stderr_path, "w") as err:
         # Popen dups the fd; closing our handle here leaks nothing
         return subprocess.Popen(cmd, env=env, stdin=subprocess.PIPE,
@@ -2619,6 +2621,372 @@ def run_sub_relay_config(subscriber_counts=(8, 32, 128), rounds=110,
     }
 
 
+# ---------------------------------------------------------------------------
+# config 14: remediation — chaos to SLO-green with zero human action
+
+
+def _remed_subrun(fault: str, chaos_env: dict, *, n_peers=3,
+                  traffic_s=8.0, interval_s=0.4, supervised=False,
+                  idle_s=0.0, mttr_budget_s=30.0):
+    """One remediation acceptance sub-run: a REAL multi-process fleet
+    (hub in this worker + n_peers subprocess peers over TCP, config 11's
+    harness) with ONE fault class injected into p1's environment, and
+    the full closed loop armed — collector + SLO engine + remediation
+    engine on the hub, reconnect supervisors at the peers (supervised
+    classes). Measures MTTR: wall time from GO (injection armed) to the
+    fleet judging SLO-green for 2 consecutive ticks, with zero human
+    action. Returns the per-fault verdict dict + the remediation
+    engine's tick costs."""
+    import tempfile
+
+    from automerge_tpu.perf import remediate
+    from automerge_tpu.perf.fleet import FleetCollector, collapse
+    from automerge_tpu.perf.remediate import Guardrails, RemediationEngine
+    from automerge_tpu.perf.slo import SloEngine
+    from automerge_tpu.sync.service import EngineDocSet
+    from automerge_tpu.sync.tcp import TcpSyncServer
+    from automerge_tpu.utils import metrics
+
+    degraded = "p1"
+    hub = EngineDocSet(backend="rows")
+    server = TcpSyncServer(hub, wire="columnar").start()
+    procs, stderr_paths = [], []
+    collector = FleetCollector(interval_s=interval_s, k_sigma=3.0,
+                               min_nodes=3)
+    collector.add_local("hub", role="hub")
+    slo = SloEngine()
+    collector.slo_engine = slo
+    engine = RemediationEngine(
+        collector, slo,
+        guardrails=Guardrails(cooldown_s=4.0, budget=5, window_s=60.0))
+    # isolation hook: quarantining a peer closes its hub-side transport
+    # (routing stops); the health-plane exclusion is collector-side
+
+    def isolate(node):
+        for peer in server.peers:
+            if getattr(peer.connection, "peer_node", None) == node:
+                peer.close()
+    engine.on_quarantine = isolate
+
+    actions0 = collapse(metrics.snapshot(), "obs_remed_actions")
+    tracked: set = set()
+    red_events: list = []
+
+    def sync_peers():
+        """Fold the server's live peer set into the collector: prune
+        transports that died (their NodeState survives, so a reconnect
+        re-adopts the label with ring continuity) and adopt new ones —
+        the supervised classes' reconnects surface here. Dead conns are
+        detected BOTH in place (closed flag) and by absence: the accept
+        loop prunes dead peers when a replacement dials in, which can
+        happen between two watcher ticks."""
+        live_open = set()
+        for peer in list(server.peers):
+            if not peer.closed.is_set():
+                live_open.add(peer.connection)
+        for conn in list(tracked):
+            if conn not in live_open:
+                tracked.discard(conn)
+                collector.remove_peer(conn)
+                red_events.append(
+                    ("conn_dead", getattr(conn, "peer_node", None)))
+        for conn in live_open:
+            if conn not in tracked:
+                tracked.add(conn)
+                collector.add_peer(conn, role="peer")
+
+    extra = []
+    if supervised:
+        extra.append("--supervised")
+        if idle_s:
+            extra += ["--peer-idle-s", str(idle_s)]
+    try:
+        for k in range(n_peers):
+            name = f"p{k}"
+            spath = os.path.join(tempfile.gettempdir(),
+                                 f"amtpu-bench-remed-{fault}-{name}.log")
+            stderr_paths.append(spath)
+            procs.append(_spawn_fleet_peer(
+                name, server.host, server.port, traffic_s,
+                chaos_env if name == degraded else None, spath,
+                extra_args=extra))
+        deadline = time.time() + 180.0
+        while len(server.peers) < n_peers:
+            if time.time() > deadline:
+                raise RuntimeError(
+                    f"remediation peers never connected "
+                    f"({len(server.peers)}/{n_peers}; see {stderr_paths})")
+            if any(p.poll() is not None for p in procs):
+                raise RuntimeError(
+                    f"a remediation peer died during startup "
+                    f"(see {stderr_paths})")
+            time.sleep(0.1)
+        # pre-GO baseline ticks: labels adopt, rings get their first
+        # samples — the fault must land on an ASSEMBLED fleet, and
+        # fleet_green's pending-node grace must be over before GO
+        with _quiet_traceback_dumps():
+            for _ in range(3):
+                sync_peers()
+                collector.scrape_once()
+                time.sleep(interval_s)
+            red_events.clear()
+            for p in procs:
+                p.stdin.write(b"GO\n")
+                p.stdin.flush()
+            t_go = time.time()
+            first_red = None
+            green_streak = 0
+            recovered_at = None
+            red_reasons_seen: set = set()
+            deadline = t_go + traffic_s + 2.0
+
+            def peer_counter(node, prefix):
+                st = collector.nodes.get(node)
+                snap = st.last_snapshot if st is not None else None
+                return collapse(snap or {}, prefix)
+
+            def evidence():
+                injected = peer_counter(degraded,
+                                        "obs_chaos_injected") > 0
+                if fault in ("conn_kill", "peer_hang"):
+                    return injected and peer_counter(
+                        degraded, "sync_reconnects") >= 1
+                healed = (collapse(metrics.snapshot(),
+                                   "obs_remed_actions")
+                          - actions0) >= 1
+                return injected and healed
+
+            while time.time() < deadline:
+                time.sleep(interval_s)
+                sync_peers()
+                state = collector.scrape_once()
+                green, reasons = remediate.fleet_green(state,
+                                                       slo.verdicts)
+                if red_events:
+                    reasons += [f"{k}:{n}" for k, n in red_events]
+                    red_events.clear()
+                    green = False
+                if not green:
+                    red_reasons_seen.update(reasons)
+                    if first_red is None:
+                        first_red = time.time()
+                    green_streak = 0
+                elif first_red is not None:
+                    green_streak += 1
+                    if green_streak >= 2 and evidence():
+                        recovered_at = time.time()
+                        break
+        tick_costs = engine.tick_costs()
+        assert first_red is not None, (
+            f"remediation[{fault}]: the fleet never went red — the "
+            f"fault did not bite (injected="
+            f"{peer_counter(degraded, 'obs_chaos_injected')})")
+        assert recovered_at is not None, (
+            f"remediation[{fault}]: no SLO-green recovery before the "
+            f"window closed (red since {time.time() - first_red:.1f}s "
+            f"ago: {sorted(red_reasons_seen)}; "
+            f"evidence={evidence()}; see {stderr_paths})")
+        mttr = recovered_at - t_go
+        assert mttr <= mttr_budget_s, (
+            f"remediation[{fault}]: MTTR {mttr:.1f}s exceeds the "
+            f"{mttr_budget_s}s budget")
+        healed_by = ("peer-side supervised reconnect"
+                     if fault in ("conn_kill", "peer_hang")
+                     else "hub-side quarantine")
+        return {
+            "degraded": degraded,
+            "mttr_s": round(mttr, 2),
+            "red_reasons": sorted(red_reasons_seen)[:8],
+            "injected": int(peer_counter(degraded,
+                                         "obs_chaos_injected")),
+            "reconnects": int(peer_counter(degraded,
+                                           "sync_reconnects")),
+            "idle_kicks": int(peer_counter(degraded,
+                                           "sync_reconnect_idle_kicks")),
+            "quarantined": collector.quarantined(),
+            "remed_actions": int(collapse(metrics.snapshot(),
+                                          "obs_remed_actions")
+                                 - actions0),
+            "healed_by": healed_by,
+            "recovered": True,
+        }, tick_costs
+    finally:
+        collector.stop()
+        for p in procs:
+            try:
+                p.stdin.close()
+            except OSError:
+                pass
+        server.close()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=10)
+        hub.close()
+
+
+def _remed_dry_run_proof():
+    """Dry-run provably executes nothing: an in-process 3-node fleet
+    with a manufactured slow_apply straggler, a RemediationEngine in
+    dry-run mode, and a recording isolation hook. The engine must log
+    the intended quarantine (remed_action with dry_run, obs_remed_
+    skipped{reason=dry_run}) and execute NOTHING — no hook call, no
+    quarantine, no executed-action counter movement."""
+    from automerge_tpu.perf.fleet import FleetCollector, collapse
+    from automerge_tpu.perf.remediate import Guardrails, RemediationEngine
+    from automerge_tpu.utils import metrics
+
+    ticks = {"n": 0}
+
+    def snapshot_fn(node, flush_per_tick):
+        def fn():
+            k = ticks["n"]
+            return {"sync_ops_ingested": 50.0 * k,
+                    "sync_round_flush_s": flush_per_tick * k,
+                    "sync_round_flush_count": 10.0 * k}
+        return fn
+
+    collector = FleetCollector(interval_s=0.05, k_sigma=3.0, min_nodes=3)
+    for name, flush in (("a", 0.001), ("b", 0.001), ("c", 1.0)):
+        collector.add_local(name, snapshot_fn(name, flush))
+    engine = RemediationEngine(
+        collector, slo_engine=None, dry_run=True,
+        guardrails=Guardrails(cooldown_s=0.05, budget=4, window_s=10.0))
+    executed = []
+    engine.on_quarantine = executed.append
+    actions0 = collapse(metrics.snapshot(), "obs_remed_actions")
+    skipped0 = collapse(metrics.snapshot(), "obs_remed_skipped")
+    for _ in range(4):
+        ticks["n"] += 1
+        collector.scrape_once()
+        time.sleep(0.05)
+    snap = metrics.snapshot()
+    intended = [e for e in engine.log
+                if e["action"] == "quarantine" and e["dry_run"]]
+    assert intended and intended[0]["node"] == "c", (
+        "dry-run never logged the intended quarantine", list(engine.log))
+    assert not executed, f"dry-run EXECUTED the hook: {executed}"
+    assert collector.quarantined() == [], "dry-run quarantined a node"
+    assert collapse(snap, "obs_remed_actions") - actions0 == 0, (
+        "dry-run moved the executed-actions counter")
+    assert snap.get("obs_remed_skipped{reason=dry_run}", 0) >= 1
+    assert collapse(snap, "obs_remed_skipped") - skipped0 >= 1
+    return 1
+
+
+def run_remediation_config(n_peers=3, interval_s=0.4):
+    """Config 14: the remediation plane's acceptance harness — the chaos
+    suite graduated from attribution to RECOVERY. Four fault classes
+    (incl. conn_kill and the slow_apply straggler), each injected into a
+    live multi-process fleet with the closed loop armed, each required
+    to return to SLO-green with zero human action inside the 30s MTTR
+    budget; plus the dry-run proof (intended actions logged, nothing
+    executed) and the remediation engine's steady-state duty cycle
+    (<2%). All gated in `perf check` (perf/history.py)."""
+    import statistics
+
+    from automerge_tpu.utils import metrics, oplag
+
+    mttr_budget_s = 30.0
+    faults = {
+        # the reconnect supervisor's classes (peer-side healing)
+        "conn_kill": dict(
+            chaos={"AMTPU_CHAOS_CONN_KILL_AFTER": "100"},
+            supervised=True, idle_s=0.0, traffic_s=8.0),
+        # hang + reconnect must stay under the 2s converge SLO bound:
+        # swallowed changes re-deliver after the window, and their
+        # converge lag ≈ hang + redial — a window past the bound would
+        # poison the receiver's rolling lag reservoir for ~20s
+        "peer_hang": dict(
+            chaos={"AMTPU_CHAOS_PEER_HANG_S": "1.2",
+                   "AMTPU_CHAOS_PEER_HANG_AFTER": "150"},
+            supervised=True, idle_s=0.8, traffic_s=12.0),
+        # the quarantine classes (hub-side healing; slow_apply is THE
+        # straggler fault, frame_drop the transport-degradation one)
+        "slow_apply": dict(
+            chaos={"AMTPU_CHAOS_SLOW_APPLY_S": "0.12"},
+            supervised=False, idle_s=0.0, traffic_s=8.0),
+        "frame_drop": dict(
+            chaos={"AMTPU_CHAOS_DROP_FRAMES": "1.0"},
+            supervised=False, idle_s=0.0, traffic_s=8.0),
+    }
+    oplag.set_sample_rate(4)
+    results = {}
+    all_tick_costs = []
+    t0 = time.perf_counter()
+    try:
+        for fault, spec in faults.items():
+            # each sub-run judges a fresh registry: a prior fault's
+            # converge-lag reservoir must not redden this one's SLOs
+            metrics.reset()
+            results[fault], costs = _remed_subrun(
+                fault, spec["chaos"], n_peers=n_peers,
+                traffic_s=spec["traffic_s"], interval_s=interval_s,
+                supervised=spec["supervised"], idle_s=spec["idle_s"],
+                mttr_budget_s=mttr_budget_s)
+            all_tick_costs.extend(costs)
+    finally:
+        oplag.set_sample_rate(None)
+    faults_wall = time.perf_counter() - t0
+
+    dry_run_clean = _remed_dry_run_proof()
+
+    # steady-state overhead: the engine's judging pass runs once per
+    # collector tick, so p50 tick cost / interval bounds its duty cycle
+    # exactly the way the collector's scrape bound works (config 11)
+    tick_p50 = (sorted(all_tick_costs)[len(all_tick_costs) // 2]
+                if all_tick_costs else None)
+    overhead_pct = (round(100.0 * tick_p50 / interval_s, 3)
+                    if tick_p50 is not None else None)
+    assert overhead_pct is not None and overhead_pct < 2.0, (
+        f"remediation steady-state duty cycle {overhead_pct}% >= 2%")
+
+    mttrs = [r["mttr_s"] for r in results.values()]
+    recovered = sum(1 for r in results.values() if r["recovered"])
+    assert recovered == len(faults), results
+    return {
+        "config": 14,
+        "name": CONFIGS[14][0],
+        "docs": n_peers * 4,
+        "ops": None,
+        "faults": results,
+        "fault_classes_injected": len(faults),
+        "fault_classes_recovered": recovered,
+        "mttr_max_s": max(mttrs),
+        "mttr_mean_s": round(statistics.mean(mttrs), 2),
+        "mttr_budget_s": mttr_budget_s,
+        # summed per-fault (each sub-run snapshots its own delta): the
+        # registry resets between sub-runs, so a final-snapshot read
+        # would only see the LAST class's actions
+        "remed_actions_total": sum(r["remed_actions"]
+                                   for r in results.values()),
+        "reconnects_total": sum(r["reconnects"]
+                                for r in results.values()),
+        "remed_tick_p50_s": (round(tick_p50, 6)
+                             if tick_p50 is not None else None),
+        "remed_overhead_pct": overhead_pct,
+        "remed_dry_run_clean": dry_run_clean,
+        "protocol": (f"{n_peers} subprocess peers + 1 hub over TCP "
+                     "(columnar wire), one fault class per sub-run "
+                     "injected into p1's environment only; hub runs "
+                     "collector + SLO engine + remediation engine "
+                     f"(scrape every {interval_s}s), peers of the "
+                     "transport classes run SupervisedTcpClient; MTTR "
+                     "= GO (injection armed) to 2 consecutive "
+                     "SLO-green ticks with fault+healing evidence in "
+                     "the scraped registries; remediation overhead is "
+                     "the tick-p50/interval duty-cycle bound; dry-run "
+                     "proof runs in-process with a recording isolation "
+                     "hook"),
+        "engine_s": round(faults_wall, 3),
+        "oracle_s": None,
+        "speedup": None,
+        "parity": True,
+    }
+
+
 CONFIGS = {
     1: ("single-doc LWW storm (2 actors x 1000 sets)", gen_lww_storm),
     2: ("nested JSON card board (8 actors)", gen_trellis),
@@ -2637,6 +3005,8 @@ CONFIGS = {
          "redundancy accounting + perf explain", None),
     13: ("interest-based partial replication: zipf-interest relay tree "
          "vs flat full-sync (sublinear fan-out bytes)", None),
+    14: ("remediation: chaos to SLO-green with zero human action "
+         "(MTTR-bounded self-healing)", None),
 }
 
 
@@ -3269,6 +3639,8 @@ def run_config(cfg: int, n_docs: int | None = None, oracle_cap_docs=12000):
         return run_doc_obs_config()
     if cfg == 13:
         return run_sub_relay_config()
+    if cfg == 14:
+        return run_remediation_config()
     name, gen = CONFIGS[cfg]
     kwargs = {}
     if cfg == 5 and n_docs:
@@ -3536,6 +3908,19 @@ def _final_record(results_by_cfg: dict, backend: str | None, attempts: list):
                 "sub_backfill_ok": r["sub_backfill_ok"],
                 "backfill": r["backfill"]}
                if r.get("config") == 13 else {}),
+            **({"mttr_max_s": r["mttr_max_s"],
+                "mttr_mean_s": r["mttr_mean_s"],
+                "mttr_budget_s": r["mttr_budget_s"],
+                "fault_classes_injected": r["fault_classes_injected"],
+                "fault_classes_recovered": r["fault_classes_recovered"],
+                "remed_overhead_pct": r["remed_overhead_pct"],
+                "remed_tick_p50_s": r["remed_tick_p50_s"],
+                "remed_dry_run_clean": r["remed_dry_run_clean"],
+                "remed_actions_total": r["remed_actions_total"],
+                "reconnects_total": r["reconnects_total"],
+                "faults": r["faults"],
+                "protocol": r["protocol"]}
+               if r.get("config") == 14 else {}),
             **({"doc_lag_p50_s": r["doc_lag_p50_s"],
                 "doc_lag_p99_s": r["doc_lag_p99_s"],
                 "doc_lag_max_s": r["doc_lag_max_s"],
@@ -3756,8 +4141,22 @@ def fleet_peer_main(args):
     svc = EngineDocSet(backend="rows")
     svc._chaos_node = name
     host, _, port = args.connect.rpartition(":")
-    client = TcpSyncClient(svc, host or "127.0.0.1", int(port),
-                           wire="columnar").start()
+    if args.supervised:
+        # config-14 posture: the link is owned by the reconnect
+        # supervisor — a chaos conn_kill/peer_hang is ITS problem to
+        # heal, with zero peer-side code knowing the fault exists
+        from automerge_tpu.sync.tcp import SupervisedTcpClient
+        client = SupervisedTcpClient(
+            svc, host or "127.0.0.1", int(port), wire="columnar",
+            backoff_s=0.25,
+            idle_reconnect_s=(args.peer_idle_s or None),
+            node=name).start()
+        deadline = time.time() + 30.0
+        while client.connection is None and time.time() < deadline:
+            time.sleep(0.05)
+    else:
+        client = TcpSyncClient(svc, host or "127.0.0.1", int(port),
+                               wire="columnar").start()
     docs = [f"{name}-d{j}" for j in range(4)]
     seqs = {d: 0 for d in docs}
     print("PEER READY", flush=True)
@@ -4179,6 +4578,12 @@ def main():
     ap.add_argument("--peer-name", default="p0")
     ap.add_argument("--peer-seconds", type=float, default=6.0)
     ap.add_argument("--peer-period", type=float, default=0.02)
+    ap.add_argument("--supervised", action="store_true",
+                    help="(fleet-peer) own the link through the "
+                         "reconnect supervisor (config 14)")
+    ap.add_argument("--peer-idle-s", type=float, default=0.0,
+                    help="(fleet-peer, supervised) inbound-idle "
+                         "force-reconnect threshold; 0 disables")
     args = ap.parse_args()
 
     if args.fleet_peer:

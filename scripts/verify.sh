@@ -14,10 +14,15 @@
 #          baseline ROADMAP #1's ingestion refactor lands against), the
 #          perf-doctor post-mortem over the last bench detail (ranked
 #          root causes per config — docs/OBSERVABILITY.md "Fleet
-#          health"), and the per-doc `perf explain` post-mortem beside
+#          health"), the per-doc `perf explain` post-mortem beside
 #          it (one view set per captured config, incl. config 13's
 #          relay-tree run — docs/OBSERVABILITY.md "Partial replication,
-#          relay fan-out & shedding"). Never fails verify — a CPU-only
+#          relay fan-out & shedding"), and the chaos-recovery smoke:
+#          one conn_kill injected into a supervised TCP link, recovery
+#          (reconnect + reconverge, zero human action) asserted in
+#          seconds (docs/OBSERVABILITY.md "Remediation plane"; the
+#          full 4-class MTTR proof is bench config 14 under `make
+#          perfcheck`). Never fails verify — a CPU-only
 #          image or a missing/empty history must not block the build
 #          (TUNNEL_DIAGNOSIS.md: TPU absence is an environment fact, not
 #          a code defect). Run `make perfcheck` for the enforcing gate.
@@ -41,6 +46,8 @@ JAX_PLATFORMS=cpu python -m automerge_tpu.perf doctor --post-mortem BENCH_DETAIL
     || echo "perf doctor unavailable (informational — not a failure)"
 JAX_PLATFORMS=cpu python -m automerge_tpu.perf explain --post-mortem BENCH_DETAIL.json \
     || echo "perf explain unavailable (informational — not a failure)"
+JAX_PLATFORMS=cpu python -m automerge_tpu.perf remediate --smoke \
+    || echo "chaos-recovery smoke FAILED (informational here; enforced by tests + perf check)"
 
 echo "== stage 3/3: tier-1 suite (ROADMAP.md) =="
 set -o pipefail

@@ -574,3 +574,85 @@ def test_norm_configs_carries_sub_relay_fields():
     assert c13["sub_converge_p99_s"] == 0.066
     assert c13["sub_backfill_ok"] == 1
     assert "backfill" not in c13
+
+
+def test_remed_gates_ok_over_and_absent(tmp_path):
+    """Config-14 remediation gates: MTTR budget, recovered-class floor,
+    steady-state duty cycle, dry-run cleanliness — all absolute, each
+    judged independently; runs without config 14 skip cleanly."""
+    p = str(tmp_path / "h.jsonl")
+
+    def rrec(mttr=6.2, classes=4, ovh=0.4, dry=1, source="test"):
+        return _rec(1000, source=source,
+                    configs={"14": {"mttr_max_s": mttr,
+                                    "fault_classes_injected": 4,
+                                    "fault_classes_recovered": classes,
+                                    "remed_overhead_pct": ovh,
+                                    "remed_dry_run_clean": dry}})
+
+    _write(p, [rrec(), rrec(source="ok")])
+    rc, lines = history.check(path=p)
+    assert rc == 0, lines
+    assert any("remediation MTTR" in ln and "OK" in ln for ln in lines)
+    assert any("remediation classes recovered: 4/4" in ln and "OK" in ln
+               for ln in lines)
+    assert any("remediation duty cycle" in ln and "OK" in ln
+               for ln in lines)
+    assert any("remediation dry-run: OK" in ln for ln in lines)
+
+    _write(p, [rrec(), rrec(mttr=45.0, source="slow-heal")])
+    rc, lines = history.check(path=p)
+    assert rc == 1
+    assert any("MTTR OVER BUDGET" in ln for ln in lines)
+
+    _write(p, [rrec(), rrec(classes=2, source="half-healed")])
+    rc, lines = history.check(path=p)
+    assert rc == 1
+    assert any("TOO FEW CLASSES RECOVERED" in ln for ln in lines)
+
+    _write(p, [rrec(), rrec(ovh=3.1, source="heavy")])
+    rc, lines = history.check(path=p)
+    assert rc == 1
+    assert any("REMEDIATION OVER BUDGET" in ln for ln in lines)
+
+    _write(p, [rrec(), rrec(dry=0, source="wet-run")])
+    rc, lines = history.check(path=p)
+    assert rc == 1
+    assert any("EXECUTED SOMETHING" in ln for ln in lines)
+
+    # a record missing only the MTTR must not vacate the other gates
+    bad = rrec(ovh=3.1, source="partial")
+    del bad["configs"]["14"]["mttr_max_s"]
+    _write(p, [rrec(), bad])
+    rc, lines = history.check(path=p)
+    assert rc == 1
+    assert any("REMEDIATION OVER BUDGET" in ln for ln in lines)
+
+    _write(p, [rrec(), _rec(1000, source="no-cfg14")])
+    rc, lines = history.check(path=p)
+    assert rc == 0
+    assert not any("remediation" in ln for ln in lines)
+
+
+def test_norm_configs_carries_remed_fields():
+    rec = {"backend": "cpu", "value": 10, "configs": {
+        "14": {"mttr_max_s": 6.2, "mttr_mean_s": 4.1,
+               "mttr_budget_s": 30.0,
+               "fault_classes_injected": 4,
+               "fault_classes_recovered": 4,
+               "remed_overhead_pct": 0.4,
+               "remed_tick_p50_s": 0.0016,
+               "remed_dry_run_clean": 1,
+               "remed_actions_total": 2,
+               "reconnects_total": 3,
+               "faults": {"dropped": "(dict fields ride the detail "
+                                     "sidecar only)"}}}}
+    out = history.record_from_bench(rec)
+    c14 = out["configs"]["14"]
+    assert c14["mttr_max_s"] == 6.2
+    assert c14["mttr_budget_s"] == 30.0
+    assert c14["fault_classes_recovered"] == 4
+    assert c14["remed_overhead_pct"] == 0.4
+    assert c14["remed_dry_run_clean"] == 1
+    assert c14["reconnects_total"] == 3
+    assert "faults" not in c14

@@ -1,0 +1,830 @@
+"""Remediation plane (round 13): recovery, not just attribution.
+
+Covers the closed loop end to end:
+
+- the new chaos fault classes (`conn_kill`, `peer_hang`): inert-unset
+  pinned, onset/one-shot semantics, disclosure;
+- the reconnect supervisor (sync/tcp.SupervisedTcpClient): exponential-
+  backoff redial after an organic or injected transport death, the
+  inbound-idle detector catching an accepted-but-unresponsive peer, and
+  resubscribe() targeted backfill carrying a narrowed interest across
+  transport generations;
+- the RemediationEngine: straggler -> quarantine (with the live doctor
+  cause), stale-node -> reconnect, episode recovery with measured MTTR,
+  and the escalation auto-dump;
+- guardrails: per-action cooldown, global budget exhaustion, quorum
+  refusal (never quarantine the majority), and dry-run provably
+  executing nothing;
+- the governor escalation ladder (delay -> shed -> recover with
+  hysteresis) replacing the single-edge SLO coupling;
+- the flight-recorder dump rate-limit (per-trigger-class cooldown);
+- FleetCollector quarantine/remove_peer semantics.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+
+import automerge_tpu as am
+import pytest
+from automerge_tpu.core.change import Change, Op
+from automerge_tpu.core.ids import ROOT_ID
+from automerge_tpu.perf import remediate
+from automerge_tpu.perf.fleet import FleetCollector, collapse
+from automerge_tpu.perf.remediate import (GovernorLadder, Guardrails,
+                                          RemediationEngine, fleet_green,
+                                          rehome_children)
+from automerge_tpu.perf.slo import SloEngine
+from automerge_tpu.sync import epochs
+from automerge_tpu.sync.connection import Connection
+from automerge_tpu.sync.docset import DocSet
+from automerge_tpu.sync.relay import RelayHub
+from automerge_tpu.sync.tcp import (SupervisedTcpClient, TcpSyncClient,
+                                    TcpSyncServer, sync_lock)
+from automerge_tpu.utils import chaos, flightrec, metrics
+
+
+def wait_until(predicate, timeout=10.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for k in list(os.environ):
+        if k.startswith("AMTPU_CHAOS_"):
+            monkeypatch.delenv(k, raising=False)
+    chaos.reload()
+    metrics.reset()
+    flightrec.reset()
+    yield
+    chaos.reload()
+    metrics.reset()
+
+
+def _write(ds, doc, actor, seqs, n=1):
+    for _ in range(n):
+        seqs[(actor, doc)] = seqs.get((actor, doc), 0) + 1
+        ds.apply_changes(doc, [Change(
+            actor=actor, seq=seqs[(actor, doc)], deps={},
+            ops=[Op("set", ROOT_ID, key="k",
+                    value=seqs[(actor, doc)])])])
+
+
+# ---------------------------------------------------------------------------
+# chaos: conn_kill / peer_hang semantics
+
+
+def test_new_faults_inert_unset():
+    assert not chaos.enabled()
+    assert chaos.conn_kill("n") is False and chaos.conn_kill(None) is False
+    assert chaos.peer_hang("n") is False and chaos.peer_hang(None) is False
+    snap = metrics.snapshot()
+    assert not any(k.startswith("obs_chaos_injected") for k in snap)
+
+
+def test_conn_kill_fires_once_after_n(monkeypatch):
+    monkeypatch.setenv("AMTPU_CHAOS_CONN_KILL_AFTER", "3")
+    chaos.reload()
+    assert [chaos.conn_kill("x") for _ in range(6)] == [
+        False, False, True, False, False, False]
+    # an independent node key counts separately
+    assert [chaos.conn_kill("y") for _ in range(3)] == [False, False, True]
+    assert metrics.snapshot()[
+        "obs_chaos_injected{fault=conn_kill}"] == 2
+
+
+def test_peer_hang_window_and_onset(monkeypatch):
+    monkeypatch.setenv("AMTPU_CHAOS_PEER_HANG_S", "0.15")
+    monkeypatch.setenv("AMTPU_CHAOS_PEER_HANG_AFTER", "3")
+    chaos.reload()
+    # onset: the first two eligible receives pass through
+    assert chaos.peer_hang("x") is False
+    assert chaos.peer_hang("x") is False
+    assert chaos.peer_hang("x") is True      # window opens on the 3rd
+    assert chaos.peer_hang("x") is True
+    time.sleep(0.2)
+    assert chaos.peer_hang("x") is False     # window expired: responsive
+    assert metrics.snapshot()[
+        "obs_chaos_injected{fault=peer_hang}"] == 2
+
+
+def test_conn_kill_node_targeting(monkeypatch):
+    monkeypatch.setenv("AMTPU_CHAOS_CONN_KILL_AFTER", "1")
+    monkeypatch.setenv("AMTPU_CHAOS_NODE", "victim")
+    chaos.reload()
+    assert chaos.conn_kill("bystander") is False
+    assert chaos.conn_kill(None) is False
+    assert chaos.conn_kill("victim") is True
+
+
+# ---------------------------------------------------------------------------
+# the reconnect supervisor
+
+
+def test_supervisor_reconnects_after_server_side_death():
+    ds_server, ds_client = DocSet(), DocSet()
+    server = TcpSyncServer(ds_server).start()
+    sup = SupervisedTcpClient(ds_client, server.host, server.port,
+                              backoff_s=0.05).start()
+    try:
+        assert wait_until(lambda: sup.connection is not None
+                          and server.peers)
+        doc = am.change(am.init("S"), lambda d: d.__setitem__("v", 1))
+        with sync_lock(ds_server):
+            ds_server.set_doc("d", doc)
+        assert wait_until(lambda: ds_client.get_doc("d") == {"v": 1})
+        # the server-side peer dies; before the supervisor existed this
+        # silently stopped convergence forever
+        server.peers[0].close()
+        assert wait_until(lambda: sup.generation >= 2)
+        with sync_lock(ds_server):
+            ds_server.set_doc("d", am.change(
+                ds_server.get_doc("d"),
+                lambda d: d.__setitem__("after", 2)))
+        assert wait_until(
+            lambda: ds_client.get_doc("d") == {"v": 1, "after": 2})
+        assert metrics.snapshot().get("sync_reconnects", 0) >= 1
+    finally:
+        sup.close()
+        server.close()
+
+
+def test_supervisor_heals_chaos_conn_kill(monkeypatch):
+    monkeypatch.setenv("AMTPU_CHAOS_CONN_KILL_AFTER", "5")
+    monkeypatch.setenv("AMTPU_CHAOS_NODE", "cl")
+    chaos.reload()
+    ds_server, ds_client = DocSet(), DocSet()
+    ds_client._chaos_node = "cl"
+    server = TcpSyncServer(ds_server).start()
+    sup = SupervisedTcpClient(ds_client, server.host, server.port,
+                              backoff_s=0.05, node="cl").start()
+    try:
+        assert wait_until(lambda: sup.connection is not None)
+        doc = am.init("C")
+        for k in range(12):
+            doc = am.change(doc, lambda d, k=k: d.__setitem__(f"k{k}", k))
+            with sync_lock(ds_client):
+                ds_client.set_doc("d", doc)
+            time.sleep(0.02)
+        # the killed link must come back and the tail must converge
+        assert wait_until(
+            lambda: ds_server.get_doc("d") == ds_client.get_doc("d")
+            and ds_server.get_doc("d") is not None)
+        snap = metrics.snapshot()
+        assert snap["obs_chaos_injected{fault=conn_kill}"] == 1
+        assert snap.get("sync_reconnects", 0) >= 1
+    finally:
+        sup.close()
+        server.close()
+
+
+def test_supervisor_idle_kick_heals_peer_hang(monkeypatch):
+    monkeypatch.setenv("AMTPU_CHAOS_PEER_HANG_S", "0.6")
+    monkeypatch.setenv("AMTPU_CHAOS_NODE", "cl")
+    chaos.reload()
+    ds_server, ds_client = DocSet(), DocSet()
+    ds_client._chaos_node = "cl"
+    server = TcpSyncServer(ds_server).start()
+    sup = SupervisedTcpClient(ds_client, server.host, server.port,
+                              backoff_s=0.05, idle_reconnect_s=0.3,
+                              node="cl").start()
+    try:
+        assert wait_until(lambda: sup.connection is not None)
+        doc = am.init("S")
+        deadline = time.time() + 4.0
+        k = 0
+        # keep writing THROUGH the hang window: the client's reader
+        # swallows these silently (socket alive, nothing applied) until
+        # the idle detector forces a redial and the window expires
+        while time.time() < deadline:
+            doc = am.change(doc, lambda d, k=k: d.__setitem__(f"k{k}", k))
+            with sync_lock(ds_server):
+                ds_server.set_doc("d", doc)
+            k += 1
+            time.sleep(0.05)
+            got = ds_client.get_doc("d")
+            if k > 12 and got is not None \
+                    and got == ds_server.get_doc("d"):
+                break
+        assert wait_until(
+            lambda: ds_client.get_doc("d") == ds_server.get_doc("d")
+            and ds_client.get_doc("d") is not None)
+        snap = metrics.snapshot()
+        assert snap.get("obs_chaos_injected{fault=peer_hang}", 0) >= 1
+        assert snap.get("sync_reconnect_idle_kicks", 0) >= 1
+    finally:
+        sup.close()
+        server.close()
+
+
+def test_supervisor_resubscribe_backfills_narrowed_interest():
+    """A narrowed interest survives the transport death: the replacement
+    connection replays it with clocks, the server pushes exactly the
+    subscribed doc's missing suffix, and the unsubscribed doc is never
+    shipped — the targeted-backfill contract across a reconnect."""
+    ds_server, ds_client = DocSet(), DocSet()
+    server = TcpSyncServer(ds_server, wire="columnar").start()
+    sup = SupervisedTcpClient(ds_client, server.host, server.port,
+                              wire="columnar", backoff_s=0.05).start()
+    seqs: dict = {}
+    try:
+        assert wait_until(lambda: sup.connection is not None
+                          and server.peers)
+        sup.connection.subscribe(docs=["a"])
+        _write(ds_server, "a", "S", seqs, 2)
+        assert wait_until(
+            lambda: ds_client.get_doc("a") is not None
+            and ds_client.get_doc("a")._doc.opset.clock == {"S": 2})
+        server.peers[0].close()          # the link dies...
+        assert wait_until(lambda: sup.generation >= 2)
+        _write(ds_server, "a", "S", seqs, 3)    # ...while history grows
+        _write(ds_server, "b", "S", seqs, 4)
+        assert wait_until(
+            lambda: ds_client.get_doc("a") is not None
+            and ds_client.get_doc("a")._doc.opset.clock == {"S": 5})
+        assert ds_client.get_doc("b") is None   # never subscribed
+        snap = metrics.snapshot()
+        assert snap.get("sync_sub_resubscribes", 0) >= 1
+        assert snap.get("sync_sub_backfills", 0) >= 1
+    finally:
+        sup.close()
+        server.close()
+
+
+def test_supervisor_close_is_idempotent_and_joins():
+    ds_server, ds_client = DocSet(), DocSet()
+    server = TcpSyncServer(ds_server).start()
+    sup = SupervisedTcpClient(ds_client, server.host, server.port,
+                              backoff_s=0.05).start()
+    assert wait_until(lambda: sup.connection is not None)
+    sup.close()
+    sup.close()
+    assert not sup._thread.is_alive()
+    server.close()
+
+
+# ---------------------------------------------------------------------------
+# guardrails
+
+
+def test_guardrails_cooldown_blocks_repeat():
+    g = Guardrails(cooldown_s=10.0, budget=100, window_s=100.0)
+    assert g.check("quarantine", "n1", now=0.0) is None
+    g.note("quarantine", "n1", 0.0, consume_budget=True)
+    assert g.check("quarantine", "n1", now=5.0) == "cooldown"
+    # a different node (or action) is an independent cooldown key
+    assert g.check("quarantine", "n2", now=5.0) is None
+    assert g.check("reconnect", "n1", now=5.0) is None
+    assert g.check("quarantine", "n1", now=11.0) is None
+
+
+def test_guardrails_budget_window_exhaustion():
+    g = Guardrails(cooldown_s=0.0, budget=2, window_s=10.0)
+    for k in range(2):
+        assert g.check("reconnect", f"n{k}", now=0.0) is None
+        g.note("reconnect", f"n{k}", 0.0, consume_budget=True)
+    assert g.check("reconnect", "n9", now=1.0) == "budget"
+    # the window slides: old actions age out
+    assert g.check("reconnect", "n9", now=11.0) is None
+
+
+def test_guardrails_per_action_override():
+    g = Guardrails(cooldown_s=100.0, budget=10, window_s=100.0,
+                   per_action_cooldown_s={"reconnect": 1.0})
+    g.note("reconnect", "n1", 0.0, consume_budget=True)
+    assert g.check("reconnect", "n1", now=2.0) is None   # override won
+
+
+def _synthetic_collector(flush_map, interval_s=0.05):
+    """3+ in-process local sources with manufactured per-tick
+    round-flush costs — the deviant one reads as a slow_apply straggler
+    to both the collector and the live doctor."""
+    ticks = {"n": 0}
+
+    def snapshot_fn(flush_per_tick):
+        def fn():
+            k = ticks["n"]
+            return {"sync_ops_ingested": 50.0 * k,
+                    "sync_round_flush_s": flush_per_tick * k,
+                    "sync_round_flush_count": 10.0 * k}
+        return fn
+
+    collector = FleetCollector(interval_s=interval_s, k_sigma=3.0,
+                               min_nodes=3)
+    for name, flush in flush_map.items():
+        collector.add_local(name, snapshot_fn(flush))
+    return collector, ticks
+
+
+def _tick(collector, ticks, n=1, sleep=0.05):
+    state = None
+    for _ in range(n):
+        ticks["n"] += 1
+        state = collector.scrape_once()
+        time.sleep(sleep)
+    return state
+
+
+def test_engine_quarantines_flagged_straggler(tmp_path, monkeypatch):
+    monkeypatch.setenv("AMTPU_FLIGHTREC_DIR", str(tmp_path))
+    flightrec.reset()
+    collector, ticks = _synthetic_collector(
+        {"a": 0.001, "b": 0.001, "c": 1.0})
+    engine = RemediationEngine(
+        collector, guardrails=Guardrails(cooldown_s=0.01, budget=4,
+                                         window_s=10.0))
+    executed = []
+    engine.on_quarantine = executed.append
+    _tick(collector, ticks, 3)
+    assert executed == ["c"]
+    assert collector.quarantined() == ["c"]
+    snap = metrics.snapshot()
+    assert snap["obs_remed_actions{action=quarantine}"] == 1
+    assert snap["obs_remed_quarantined"] == 1
+    evs = [e for e in flightrec.events() if e["kind"] == "remed_action"]
+    assert evs and evs[0]["action"] == "quarantine" \
+        and evs[0]["node"] == "c" and evs[0]["dry_run"] is False
+    # the escalation auto-captured a dump with the doctor report riding
+    path = flightrec.last_dump()
+    assert path is not None
+    doc = json.load(open(path))
+    assert doc["reason"] == "remed:quarantine"
+    assert doc["extra"]["remediation"]["action"] == "quarantine"
+    # quarantined node is OUT of the judged fleet on the next tick
+    state = _tick(collector, ticks, 1)
+    assert state["nodes"]["c"]["quarantined"] is True
+    assert state["nodes"]["c"]["derived"] is None
+    assert "c" not in state["stragglers"]
+
+
+def test_engine_recovery_episode_measures_mttr():
+    collector, ticks = _synthetic_collector(
+        {"a": 0.001, "b": 0.001, "c": 1.0})
+    engine = RemediationEngine(
+        collector, guardrails=Guardrails(cooldown_s=0.01, budget=4,
+                                         window_s=10.0))
+    engine.on_quarantine = lambda n: None
+    _tick(collector, ticks, 3)
+    assert collector.quarantined() == ["c"]
+    # quarantine removed the deviant: the fleet judges green, and after
+    # the streak the episode closes with a measured MTTR
+    _tick(collector, ticks, 3)
+    assert engine.last_recovery is not None
+    assert engine.last_recovery["actions"] >= 1
+    assert engine.last_recovery["mttr_s"] > 0
+    assert metrics.snapshot()["obs_remed_recovered"] == 1
+    evs = [e for e in flightrec.events()
+           if e["kind"] == "remed_recovered"]
+    assert evs and evs[-1]["mttr_s"] > 0
+
+
+def test_engine_quorum_refuses_majority_quarantine():
+    collector, ticks = _synthetic_collector(
+        {"a": 0.001, "b": 0.001, "c": 0.001, "d": 1.0})
+    engine = RemediationEngine(
+        collector, guardrails=Guardrails(cooldown_s=0.01, budget=10,
+                                         window_s=10.0))
+    executed = []
+    engine.on_quarantine = executed.append
+    # one node is ALREADY quarantined (a prior episode): cutting d too
+    # would leave only half the fleet healthy — the quorum guardrail
+    # must refuse, however deviant d looks
+    collector.quarantine("c")
+    _tick(collector, ticks, 4)
+    state = collector.fleet_state()
+    assert "d" in state["stragglers"]       # flagged, but...
+    assert executed == []                   # ...never cut off
+    assert collector.quarantined() == ["c"]
+    assert metrics.snapshot().get(
+        "obs_remed_skipped{reason=quorum}", 0) >= 1
+
+
+def test_engine_dry_run_executes_nothing():
+    collector, ticks = _synthetic_collector(
+        {"a": 0.001, "b": 0.001, "c": 1.0})
+    engine = RemediationEngine(
+        collector, dry_run=True,
+        guardrails=Guardrails(cooldown_s=0.01, budget=4, window_s=10.0))
+    executed = []
+    engine.on_quarantine = executed.append
+    _tick(collector, ticks, 3)
+    assert executed == []
+    assert collector.quarantined() == []
+    snap = metrics.snapshot()
+    assert collapse(snap, "obs_remed_actions") == 0
+    assert snap.get("obs_remed_skipped{reason=dry_run}", 0) >= 1
+    intended = [e for e in engine.log if e["dry_run"]]
+    assert intended and intended[0]["action"] == "quarantine" \
+        and intended[0]["node"] == "c"
+    evs = [e for e in flightrec.events() if e["kind"] == "remed_action"]
+    assert evs and all(e["dry_run"] for e in evs)
+
+
+def test_engine_dry_run_env_knob(monkeypatch):
+    monkeypatch.setenv("AMTPU_REMED_DRY_RUN", "1")
+    collector, _ = _synthetic_collector({"a": 0.001, "b": 0.001,
+                                         "c": 0.001})
+    engine = RemediationEngine(collector)
+    assert engine.dry_run is True
+
+
+def test_engine_reconnect_action_for_stale_supervised_node():
+    calls = []
+
+    class FakeSupervisor:
+        def force_reconnect(self):
+            calls.append("kick")
+
+    dead = {"alive": True}
+
+    def flaky():
+        if not dead["alive"]:
+            raise OSError("gone")
+        return {"sync_ops_ingested": 1.0}
+
+    collector = FleetCollector(interval_s=0.02, min_nodes=3)
+    collector.add_local("d", flaky)
+    engine = RemediationEngine(
+        collector, guardrails=Guardrails(cooldown_s=0.01, budget=4,
+                                         window_s=10.0))
+    engine.register_supervisor("d", FakeSupervisor())
+    collector.scrape_once()
+    dead["alive"] = False
+    time.sleep(0.35)    # > the 0.3s staleness floor: the node is stale
+    collector.scrape_once()
+    assert calls == ["kick"]
+    assert metrics.snapshot()[
+        "obs_remed_actions{action=reconnect}"] == 1
+
+
+def test_engine_tick_costs_bounded_and_recorded():
+    collector, ticks = _synthetic_collector(
+        {"a": 0.001, "b": 0.001, "c": 0.001})
+    RemediationEngine(collector)
+    _tick(collector, ticks, 3, sleep=0.01)
+    engine = collector.remediator
+    costs = engine.tick_costs()
+    assert len(costs) == 3 and all(c >= 0 for c in costs)
+    assert "obs_remed_tick_s_count" in metrics.snapshot()
+
+
+# ---------------------------------------------------------------------------
+# governor escalation ladder
+
+
+def test_ladder_escalates_delay_then_shed_and_relaxes_with_hysteresis():
+    gov = epochs.IngressGovernor(bound_s=1.0, sustain_s=0.0,
+                                 mode="delay")
+    ladder = GovernorLadder(gov, bound_s=1.0, sustain_s=1.0,
+                            escalate_s=2.0, recover_frac=0.5,
+                            recover_sustain_s=1.0)
+    assert ladder.desired(2.0, now=0.0) == 0     # breach, not sustained
+    assert ladder.desired(2.0, now=1.1) == 1     # sustained: delay
+    ladder.apply(1, 2.0)
+    assert gov.shedding and gov.mode == "delay"
+    assert ladder.desired(2.0, now=1.2) == 1     # fresh sustain window
+    assert ladder.desired(2.0, now=3.5) == 2     # sustained again: shed
+    ladder.apply(2, 2.0)
+    assert gov.shedding and gov.mode == "shed"
+    # recovered past the bound but INSIDE the hysteresis band: hold
+    assert ladder.desired(0.9, now=4.0) == 2
+    assert ladder.desired(0.4, now=5.0) == 2     # below band, not held
+    assert ladder.desired(0.4, now=6.1) == 1     # held long enough
+    ladder.apply(1, 0.4)
+    assert gov.shedding and gov.mode == "delay"
+    assert ladder.desired(0.4, now=7.0) == 1
+    assert ladder.desired(0.4, now=8.1) == 0
+    ladder.apply(0, 0.4)
+    assert not gov.shedding
+    snap = metrics.snapshot()
+    assert snap["obs_remed_governor_stage"] == 0
+    assert snap["sync_shed_transitions"] >= 2    # on at delay, off at open
+
+
+def test_ladder_no_data_never_moves():
+    gov = epochs.IngressGovernor(bound_s=1.0)
+    ladder = GovernorLadder(gov, bound_s=1.0)
+    assert ladder.desired(None) == 0
+    ladder.stage = 2
+    assert ladder.desired(None) == 2
+
+
+def test_engine_drives_ladder_through_guardrails():
+    collector, ticks = _synthetic_collector(
+        {"a": 0.001, "b": 0.001, "c": 0.001})
+    slo = SloEngine(slos=[{"name": "converge_p99",
+                           "signal": "converge_p99_s", "bound": 1.0}])
+    collector.slo_engine = slo
+    engine = RemediationEngine(
+        collector, slo,
+        guardrails=Guardrails(cooldown_s=0.0, budget=10, window_s=10.0))
+    gov = epochs.IngressGovernor(bound_s=1.0)
+    engine.attach_ladder(gov, bound_s=1.0, sustain_s=0.0,
+                         escalate_s=0.0, recover_frac=0.5,
+                         recover_sustain_s=0.0)
+
+    def breach_state(p99):
+        return {"rollup": {"converge_p99_s": p99}, "stragglers": [],
+                "nodes": {}}
+
+    engine.tick(breach_state(5.0))
+    assert engine.ladder.stage == 1 and gov.mode == "delay"
+    engine.tick(breach_state(5.0))
+    assert engine.ladder.stage == 2 and gov.mode == "shed"
+    engine.tick(breach_state(0.1))
+    engine.tick(breach_state(0.1))
+    assert engine.ladder.stage == 0 and not gov.shedding
+    snap = metrics.snapshot()
+    assert snap["obs_remed_actions{action=governor_escalate}"] == 2
+    assert snap["obs_remed_actions{action=governor_relax}"] == 2
+
+
+# ---------------------------------------------------------------------------
+# relay subtree re-homing
+
+
+def test_rehome_children_moves_cover_and_backfills():
+    msgs = deque()
+    conns = {}
+
+    def link(ds_a, ds_b, name):
+        a = Connection(ds_a, lambda m, n=name: msgs.append((n + ".b", m)),
+                       wire="columnar")
+        b = Connection(ds_b, lambda m, n=name: msgs.append((n + ".a", m)),
+                       wire="columnar")
+        conns[name + ".a"], conns[name + ".b"] = a, b
+        return a, b
+
+    def pump():
+        for _ in range(100_000):
+            if not msgs:
+                return
+            name, m = msgs.popleft()
+            conns[name].receive_msg(m)
+        raise AssertionError("tree failed to quiesce")
+
+    root = DocSet()
+    hubA_ds, hubB_ds = DocSet(), DocSet()
+    hubA = RelayHub(hubA_ds, label="hubA")
+    hubB = RelayHub(hubB_ds, label="hubB")
+    _, a_up = link(root, hubA_ds, "rA")
+    hubA.set_upstream(a_up)
+    _, b_up = link(root, hubB_ds, "rB")
+    hubB.set_upstream(b_up)
+    leaves, leaf_conns, hub_sides = [], [], []
+    for i in range(2):
+        leaf = DocSet()
+        hub_side, leaf_side = link(hubA_ds, leaf, f"Al{i}")
+        hubA.attach_child(hub_side)
+        leaves.append(leaf)
+        leaf_conns.append(leaf_side)
+        hub_sides.append(hub_side)
+    leaf_conns[0].subscribe(docs=["hot"])
+    leaf_conns[1].subscribe(docs=["hot", "b"])
+    pump()
+    for c in conns.values():
+        c.open()
+    pump()
+    seqs: dict = {}
+    _write(root, "hot", "R", seqs, 2)
+    _write(root, "b", "R", seqs, 1)
+    pump()
+    assert leaves[0].get_doc("hot")._doc.opset.clock == {"R": 2}
+
+    # hubA is quarantined: re-home its subtree onto hubB — the child
+    # links are rebuilt (the old hub's transports die with it) and each
+    # child replays its interest to the adopting hub
+    old_to_idx = {c: i for i, c in enumerate(hub_sides)}
+    new_leaf_sides = {}
+
+    def rebuild(old_conn):
+        i = old_to_idx[old_conn]
+        old_conn.close()
+        conns[f"Al{i}.b"].close()
+        new_hub_side, new_leaf_side = link(hubB_ds, leaves[i], f"Bl{i}")
+        new_leaf_side._local_interest = leaf_conns[i]._local_interest
+        new_leaf_sides[i] = new_leaf_side
+        return new_hub_side
+
+    moved = rehome_children(hubA, hubB, rebuild)
+    assert len(moved) == 2
+    for c in moved:
+        c.open()
+    for leaf_side in new_leaf_sides.values():
+        leaf_side.resubscribe()
+        leaf_side.open()
+    pump()
+    docs, _ = hubB.cover()
+    assert docs == {"hot", "b"}
+    assert hubA.children() == []
+    docsA, _ = hubA.cover()
+    assert docsA == set()       # detach released every ref
+    _write(root, "hot", "R", seqs, 2)
+    pump()
+    assert leaves[0].get_doc("hot")._doc.opset.clock == {"R": 4}
+    assert leaves[1].get_doc("hot")._doc.opset.clock == {"R": 4}
+
+
+# ---------------------------------------------------------------------------
+# fleet_green + collector plumbing
+
+
+def test_fleet_green_predicate():
+    state = {"stragglers": [], "nodes": {
+        "a": {"stale": False, "age_s": 0.1},
+        "pending": {"stale": True, "age_s": None},
+    }}
+    green, reasons = fleet_green(state, {})
+    assert green and reasons == []
+    state["stragglers"] = ["a"]
+    green, reasons = fleet_green(state, {})
+    assert not green and reasons == ["straggler:a"]
+    state["stragglers"] = []
+    state["nodes"]["a"]["stale"] = True
+    green, reasons = fleet_green(state, {"s": {"ok": True}})
+    assert not green and reasons == ["stale:a"]
+    state["nodes"]["a"].update(stale=True, quarantined=True)
+    green, reasons = fleet_green(state, {"s": {"ok": False}})
+    assert not green and reasons == ["slo:s"]
+
+
+def test_collector_quarantine_excludes_from_rollup_and_scoring():
+    collector, ticks = _synthetic_collector(
+        {"a": 0.001, "b": 0.001, "c": 1.0})
+    state = _tick(collector, ticks, 3)
+    assert "c" in state["stragglers"]
+    ops_all = state["rollup"]["ops_per_s"]
+    collector.quarantine("c")
+    state = _tick(collector, ticks, 1)
+    assert state["stragglers"] == []
+    assert state["nodes"]["c"]["quarantined"] is True
+    assert state["rollup"]["ops_per_s"] < ops_all
+    collector.unquarantine("c")
+    state = _tick(collector, ticks, 2)
+    assert "c" in state["stragglers"]
+
+
+def test_collector_remove_peer_frees_label_for_reconnect():
+    class FakeConn:
+        peer_node = None
+
+        def __init__(self):
+            self.on_peer_metrics = None
+
+        def request_metrics(self):
+            if self.on_peer_metrics is not None:
+                self.on_peer_metrics({"sync_ops_ingested": 1.0})
+
+    collector = FleetCollector(interval_s=0.02)
+    c1 = FakeConn()
+    c1.peer_node = "p1"
+    collector.add_peer(c1)
+    collector.scrape_once()
+    collector.scrape_once()
+    assert "p1" in collector.nodes
+    samples_before = len(collector.nodes["p1"].samples)
+    collector.remove_peer(c1)
+    # the reconnected transport self-reports the same label and adopts
+    # the surviving NodeState — ring continuity across generations
+    c2 = FakeConn()
+    c2.peer_node = "p1"
+    collector.add_peer(c2)
+    collector.scrape_once()
+    collector.scrape_once()
+    assert len(collector.nodes["p1"].samples) > samples_before
+    assert not any(n.startswith("peer") for n in collector.nodes)
+
+
+def test_slo_on_transition_hook_fires():
+    collector, ticks = _synthetic_collector(
+        {"a": 0.001, "b": 0.001, "c": 0.001})
+    slo = SloEngine(slos=[{"name": "ops_floor",
+                           "signal": "ops_per_s", "bound": 1e9}])
+    seen = []
+    slo.on_transition = lambda *a: seen.append(a)
+    collector.slo_engine = slo
+    _tick(collector, ticks, 2)
+    # ops_per_s <= 1e9 is ok; flip the bound to force a breach edge
+    slo.slos[0].bound = -1.0
+    _tick(collector, ticks, 1)
+    assert seen and seen[-1][0] == "ops_floor" and seen[-1][1] is False
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder dump rate-limit
+
+
+def test_dump_cooldown_suppresses_same_trigger_class(tmp_path,
+                                                     monkeypatch):
+    monkeypatch.setenv("AMTPU_FLIGHTREC_DIR", str(tmp_path))
+    monkeypatch.setattr(flightrec, "_COOLDOWN_S", 60.0)
+    flightrec.reset()
+    p1 = flightrec.dump("stormy:loop")
+    assert p1 is not None
+    p_other = flightrec.dump("calm")
+    assert p_other is not None and p_other != p1
+    # same class inside the cooldown: suppressed, previous path
+    # returned, last_dump NOT updated, suppression counted
+    p2 = flightrec.dump("stormy:loop")
+    assert p2 == p1
+    assert flightrec.last_dump() == p_other
+    assert metrics.snapshot()[
+        "obs_flightrec_suppressed{reason=stormy}"] == 1
+    files = [f for f in os.listdir(tmp_path) if "stormy" in f]
+    assert len(files) == 1
+
+
+def test_dump_force_and_explicit_path_bypass_cooldown(tmp_path,
+                                                      monkeypatch):
+    monkeypatch.setenv("AMTPU_FLIGHTREC_DIR", str(tmp_path))
+    monkeypatch.setattr(flightrec, "_COOLDOWN_S", 60.0)
+    flightrec.reset()
+    p1 = flightrec.dump("wd")
+    p2 = flightrec.dump("wd", force=True)
+    assert p2 is not None and p2 != p1
+    p3 = flightrec.dump("wd", path=str(tmp_path / "explicit.json"))
+    assert p3 == str(tmp_path / "explicit.json")
+    assert os.path.exists(p3)
+
+
+def test_dump_cooldown_zero_disables(tmp_path, monkeypatch):
+    monkeypatch.setenv("AMTPU_FLIGHTREC_DIR", str(tmp_path))
+    monkeypatch.setattr(flightrec, "_COOLDOWN_S", 0.0)
+    flightrec.reset()
+    p1 = flightrec.dump("wd")
+    p2 = flightrec.dump("wd")
+    assert p1 and p2 and p1 != p2
+
+
+def test_server_prunes_dead_peers_on_reconnect():
+    """Supervised reconnect churn must not leak dead _Peer objects:
+    the accept loop prunes closed peers as replacements dial in."""
+    ds_server, ds_client = DocSet(), DocSet()
+    server = TcpSyncServer(ds_server).start()
+    sup = SupervisedTcpClient(ds_client, server.host, server.port,
+                              backoff_s=0.05).start()
+    try:
+        assert wait_until(lambda: sup.connection is not None
+                          and server.peers)
+        for k in range(3):
+            gen = sup.generation
+            next(p for p in server.peers
+                 if not p.closed.is_set()).close()
+            assert wait_until(lambda: sup.generation > gen)
+        assert wait_until(
+            lambda: sum(1 for p in server.peers
+                        if not p.closed.is_set()) == 1)
+        # at most the one live peer plus the most recent corpse (pruned
+        # on the NEXT accept) — never one dead _Peer per reconnect
+        assert len(server.peers) <= 2
+    finally:
+        sup.close()
+        server.close()
+
+
+def test_governor_force_discloses_mode_flip_while_shedding():
+    """The delay -> shed escalation changes WHAT happens to appends
+    (delay becomes IngressShedError) without changing the shedding
+    flag — it must still fire a shed_transition disclosure."""
+    gov = epochs.IngressGovernor(bound_s=1.0, mode="delay")
+    gov.force(True, mode="delay", p99_s=3.0)
+    t1 = metrics.snapshot().get("sync_shed_transitions", 0)
+    assert t1 == 1
+    gov.force(True, mode="shed", p99_s=3.0)      # severity flip
+    assert metrics.snapshot()["sync_shed_transitions"] == t1 + 1
+    evs = [e for e in flightrec.events()
+           if e["kind"] == "shed_transition"]
+    assert evs[-1]["mode"] == "shed" and evs[-1]["shedding"] is True
+    gov.force(True, mode="shed", p99_s=3.0)      # no-op: no disclosure
+    assert metrics.snapshot()["sync_shed_transitions"] == t1 + 1
+    gov.force(False, p99_s=0.2)
+    assert metrics.snapshot()["sync_shed_transitions"] == t1 + 2
+    assert not gov.shedding
+
+
+def test_divergence_dump_bypasses_cooldown(tmp_path, monkeypatch):
+    """Two distinct divergences inside one dump-cooldown window must
+    BOTH persist — sync/audit.py forces its dumps past the rate limit."""
+    monkeypatch.setenv("AMTPU_FLIGHTREC_DIR", str(tmp_path))
+    monkeypatch.setattr(flightrec, "_COOLDOWN_S", 60.0)
+    flightrec.reset()
+    from automerge_tpu.sync import audit as audit_mod
+    # the audit module's dump call, driven directly with two reports
+    p1 = flightrec.dump("divergence", extra={"divergence": {"doc": "a"}},
+                        force=True)
+    p2 = flightrec.dump("divergence", extra={"divergence": {"doc": "b"}},
+                        force=True)
+    assert p1 and p2 and p1 != p2
+    assert json.load(open(p2))["extra"]["divergence"]["doc"] == "b"
+    # and the audit source really does pass force=True
+    import inspect
+    src = inspect.getsource(audit_mod)
+    assert 'force=True' in src
