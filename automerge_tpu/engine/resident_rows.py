@@ -149,6 +149,11 @@ class ResidentRowsDocSet(ResidentDocSet):
         # change_log holds only the tail above it. Empty dict = no horizon.
         self.log_horizon: list[dict] = [{} for _ in self.doc_ids]
         self.log_archive = None   # LogArchive, injected by the service
+        # SnapshotStore (sync/snapshots.py), injected by the service:
+        # compacted doc-state images beside the full-fidelity archive —
+        # rebuild-from-log replays a snapshot-booted doc from its image
+        # when the archive does not hold its history
+        self.snapshot_store = None
         # bumped by _rebuild_from_log: lets the service's admission
         # detection use cheap log-length compares except across a rebuild
         # (which restores the archived prefix into the RAM log)
@@ -775,6 +780,56 @@ class ResidentRowsDocSet(ResidentDocSet):
         metrics.bump("rows_horizon_truncated")
         return len(move)
 
+    @staticmethod
+    def _archive_covers_floor(archived, floor: dict[str, int]) -> bool:
+        """True when the archived changes include each floor actor's
+        history FROM SEQ 1 — i.e. the archive holds the doc's full
+        prefix, not just a post-bootstrap tail. A wire-snapshot-booted
+        replica that later archives its own tail has a NON-empty
+        archive that still does not cover the compacted prefix; replay
+        paths must route through the image for such docs (per-actor
+        seqs are dense from 1 and archive_log_prefix moves contiguous
+        prefixes, so min-seq == 1 is the coverage witness)."""
+        if not floor:
+            return True
+        mins: dict[str, int] = {}
+        for c in archived:
+            if c.actor in floor and c.seq < mins.get(c.actor, 1 << 62):
+                mins[c.actor] = c.seq
+        return all(mins.get(a) == 1 for a in floor)
+
+    def seed_clock(self, doc_id: str, clock: dict[str, int],
+                   head_closures: dict | None = None) -> None:
+        """Snapshot-bootstrap seeding (sync/snapshots.py): after a doc's
+        compacted (renumbered) snapshot frame admitted through the
+        ordinary ingress, raise the doc's clock to the ORIGINAL covered
+        clock so the suffix — archive tail or live sync — admits with
+        its original seqs and below-clock redeliveries drop
+        idempotently. `head_closures` (per-actor transitive clocks of
+        the covered heads, the engine's state_clocks convention of
+        excluding the own coordinate) are memoized so `causal_floor`
+        and later slow-path clock rows can expand references to the
+        seeded heads; `snap_floor` arms the post-seed clock-row clamp
+        (resident.DocTables.snap_floor)."""
+        i = self.doc_index[doc_id]
+        t = self.tables[i]
+        self._sync_stale_table(t)
+        self._register_actor_names(set(clock))
+        heads = head_closures or {}
+        for a, s in clock.items():
+            if s > t.clock.get(a, 0):
+                t.clock[a] = int(s)
+            t.state_clocks[(a, int(s))] = dict(heads.get(a) or {})
+        # frontier := the seeded heads not covered by another head's
+        # closure (the pruned maximal set the reference keeps as deps)
+        t.frontier = {
+            a: int(s) for a, s in clock.items()
+            if not any(o != a and (heads.get(o) or {}).get(a, 0) >= s
+                       for o in clock)}
+        t.snap_floor = {a: int(s) for a, s in clock.items()}
+        self._cache_dirty.add(i)
+        metrics.bump("sync_bootstrap_docs")
+
     def _rebuild_from_log(self) -> None:
         """Disaster recovery: reconstruct the whole instance from the
         admitted change log (the authoritative record) plus any causally-
@@ -797,10 +852,37 @@ class ResidentRowsDocSet(ResidentDocSet):
 
         docs = list(self.doc_ids)
         round_: dict[str, list] = {}
+        snap_replay: dict[str, object] = {}
         for i, d in enumerate(docs):
             chs = []
+            snap_floor = getattr(self.tables[i], "snap_floor", None)
             if self.log_archive is not None and self.log_horizon[i]:
-                chs.extend(self.log_archive.read(d))
+                archived = self.log_archive.read(d)
+                if snap_floor and not self._archive_covers_floor(
+                        archived, snap_floor):
+                    # the local archive holds only this replica's
+                    # post-bootstrap tail — the prefix lives in the
+                    # image; keep the archived tail for the round
+                    chs.extend(c for c in archived
+                               if c.seq > snap_floor.get(c.actor, 0))
+                else:
+                    chs.extend(archived)
+                    snap_floor = None   # full prefix on disk: no image
+            if snap_floor:
+                # snapshot-booted doc whose archive (if any) lacks the
+                # compacted prefix: the image is the only durable copy
+                # — replay it (and re-seed) before the tail. Losing it
+                # poisons the rebuild (serving a tail-only doc as truth
+                # would be silent divergence).
+                img = (self.snapshot_store.load(d)
+                       if self.snapshot_store is not None else None)
+                if img is None:
+                    e = RuntimeError(
+                        f"rebuild of snapshot-booted doc {d!r}: no "
+                        "archived prefix and no local snapshot image")
+                    self._poison(e)
+                    raise e
+                snap_replay[d] = img
             chs.extend(c.change() if isinstance(c, AdmittedRef) else c
                        for c in self.change_log[i])
             for p in self.tables[i].queue:
@@ -812,11 +894,20 @@ class ResidentRowsDocSet(ResidentDocSet):
         fresh = ResidentRowsDocSet(docs, actors=list(self.actors),
                                    native=self._native is not None)
         fresh.log_archive = self.log_archive
+        fresh.snapshot_store = self.snapshot_store
         fresh.compaction_floors = dict(self.compaction_floors)
         fresh.device = self.device
         fresh.lazy_dispatch = self.lazy_dispatch
         fresh._rebuilding = True
         try:
+            for d, img in snap_replay.items():
+                fresh.apply_rounds([{d: img.columns().to_changes()}])
+                fresh.seed_clock(d, img.clock, img.heads)
+                i2 = fresh.doc_index[d]
+                # the image is the doc's below-horizon truth, not a
+                # re-servable log prefix (renumbered seqs)
+                fresh.change_log[i2] = []
+                fresh.log_horizon[i2] = dict(img.clock)
             if round_:
                 try:
                     fresh.apply_rounds([round_])
@@ -1934,12 +2025,41 @@ class ResidentRowsDocSet(ResidentDocSet):
         i = self.doc_index[doc_id]
         doc = api.init("resident-view")
         changes = []
+        arch_tail: list = []
+        snap_floor = getattr(self.tables[i], "snap_floor", None)
         if self.log_archive is not None and self.log_horizon[i]:
             # RAM holds only the tail above the log horizon; the replay
             # needs the archived prefix too (cold path, like a fresh peer)
-            changes.extend(self.log_archive.read(doc_id))
-        changes.extend(c.change() if isinstance(c, AdmittedRef) else c
-                       for c in self.change_log[i])
+            archived = self.log_archive.read(doc_id)
+            if snap_floor and not self._archive_covers_floor(
+                    archived, snap_floor):
+                # post-bootstrap archival only: the archived changes are
+                # TAIL, not prefix — fold them into the tail and route
+                # through the image below
+                arch_tail = [c for c in archived
+                             if c.seq > snap_floor.get(c.actor, 0)]
+            else:
+                changes.extend(archived)
+                snap_floor = None
+        tail = arch_tail + [c.change() if isinstance(c, AdmittedRef) else c
+                            for c in self.change_log[i]]
+        if snap_floor:
+            # snapshot-booted doc whose original-numbered prefix exists
+            # only as the compacted image: replay image + the tail
+            # REBASED onto the renumbered history (snapshots.remap_tail
+            # — a monotone per-actor bijection, identical visible state)
+            from ..sync.snapshots import remap_tail
+            img = (self.snapshot_store.load(doc_id)
+                   if self.snapshot_store is not None else None)
+            if img is None:
+                raise RuntimeError(
+                    f"cannot materialize snapshot-booted doc {doc_id!r}: "
+                    "no archived prefix and no local snapshot image "
+                    "(attach snapshot_dir so wire-received images are "
+                    "retained)")
+            changes = img.columns().to_changes()
+            tail = remap_tail(tail, img.clock, img.kept_seqs)
+        changes.extend(tail)
         doc = apply_changes_to_doc(doc, doc._doc.opset, changes,
                                    incremental=False, emit_diffs=False)
         from .batchdoc import oracle_state

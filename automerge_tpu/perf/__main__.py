@@ -186,6 +186,11 @@ def main(argv=None) -> int:
         # fault, assert the supervised link self-heals
         from . import remediate
         return remediate.smoke_main(rest)
+    if cmd == "bootstrap":
+        # the replica-bootstrap smoke (verify.sh stage 2): deep-history
+        # doc -> snapshot -> cold-boot a fresh replica, byte-equal hashes
+        from . import bootstrap
+        return bootstrap.smoke_main(rest)
     if cmd == "roofline":
         from . import roofline
         roofline.main(rest)
@@ -196,7 +201,7 @@ def main(argv=None) -> int:
         return 0
     print(f"unknown command {cmd!r}; expected one of "
           "report, check, contention, doctor, explain, top, remediate, "
-          "roofline, resident",
+          "bootstrap, roofline, resident",
           file=sys.stderr)
     return 2
 

@@ -34,6 +34,10 @@ Cause classes (stable identifiers — the bench asserts on them):
                      frontier (the docledger section) — the evidence
                      names them and points at `perf explain <doc>` for
                      the per-doc causal walk (perf/explain.py)
+    storage_stall    archive/snapshot fsyncs dominate (slow or stalled
+                     disk — the chaos `disk_stall` fault class): slow
+                     appends and slow bootstraps attribute to the
+                     STORAGE tier, not the engine (r15 storage tier)
 
 CLI: `python -m automerge_tpu.perf doctor [--post-mortem PATH]
 [--config N] [--json] [--connect host:port,... --ticks N]`. With no
@@ -239,6 +243,27 @@ def diagnose_snapshot(snapshot: dict, label: str = "snapshot",
         _cause(causes, "doc_stall", None,
                sum(r["lag_s"] for r in rows)
                + 0.1 * sum(r["lag_changes"] for r in rows), ev)
+
+    # storage tier (r15): archive/seal/snapshot fsync wall — when the
+    # disk is the bottleneck (chaos disk_stall, or a genuinely slow
+    # volume), slow appends and slow bootstraps must attribute HERE,
+    # not to the engine. Scored by the fsync seconds themselves, with
+    # the worst single fsync as supporting evidence.
+    fsync_s = snapshot.get("sync_archive_fsync_s_sum", 0)
+    fsync_n = snapshot.get("sync_archive_fsync_s_count", 0)
+    fsync_max = snapshot.get("sync_archive_fsync_s_max", 0)
+    if isinstance(fsync_s, (int, float)) and fsync_s > 0.5:
+        ev = [f"archive/snapshot fsyncs total {fsync_s:.3f}s across "
+              f"{int(fsync_n)} syncs (worst {fsync_max}s) — the storage "
+              "tier, not the engine, is absorbing the time"]
+        boot = snapshot.get("sync_bootstrap_s_sum")
+        if isinstance(boot, (int, float)) and boot > 0:
+            ev.append(f"replica bootstraps spent {boot:.3f}s total")
+        inj = snapshot.get("obs_chaos_injected{fault=disk_stall}", 0)
+        if inj:
+            ev.append(f"{int(inj)} injected disk_stall fault(s) "
+                      "disclosed — chaos run, not an organic disk")
+        _cause(causes, "storage_stall", None, float(fsync_s), ev)
 
     retraced = sum(v for k, v in snapshot.items()
                    if isinstance(v, (int, float))

@@ -144,6 +144,19 @@ REMED_MIN_CLASSES = 4
 #: hold their own overhead to,
 REMED_BUDGET_PCT = 2.0
 
+#: replica-bootstrap gates (r15, config 15). All ABSOLUTE — properties
+#: of the storage tier, not the host:
+#: a fresh replica joining a deep-history fleet via snapshot+tail must
+#: converge at least this many times faster than full-history replay,
+BOOTSTRAP_SPEEDUP_MIN = 5.0
+#: the compacted snapshot images must be strictly smaller than the
+#: archived op logs covering the same prefix (the bench asserts a much
+#: tighter ratio in-run; the gate pins the direction),
+SNAPSHOT_LOG_RATIO_MAX = 1.0
+#: and converged-state hashes must be byte-equal between the snapshot
+#: path and the replay path (asserted in-run; the gate re-checks the
+#: recorded verdict so a disabled assertion cannot ship silently).
+
 #: config-8 fields copied into the history record's `fleet` section
 FLEET_KEYS = ("fleet_hashes_s", "fleet_hashes_first_s",
               "fleet_hashes_clean_shards", "fleet_hashes_dirty_shards",
@@ -276,7 +289,21 @@ def _norm_configs(raw) -> dict:
                                        "remed_tick_p50_s",
                                        "remed_dry_run_clean",
                                        "remed_actions_total",
-                                       "reconnects_total")
+                                       "reconnects_total",
+                                       # replica bootstrap (r15, config
+                                       # 15): snapshot+tail vs replay
+                                       # time-to-converged, image-vs-log
+                                       # size, in-run parity verdict
+                                       "bootstrap_speedup_x",
+                                       "bootstrap_snapshot_s",
+                                       "bootstrap_replay_s",
+                                       "snapshot_log_ratio",
+                                       "snapshot_bytes", "archive_bytes",
+                                       "bootstrap_hash_parity",
+                                       "bootstrap_docs_per_fleet",
+                                       "bootstrap_changes_per_doc",
+                                       "bootstrap_fallbacks",
+                                       "compaction_ratio")
                      if isinstance(v.get(k), (int, float, str))}
         elif isinstance(v, (int, float)):
             entry = {"speedup": v}
@@ -806,6 +833,41 @@ def check(path: str | None = None, record: dict | None = None,
                      + ("OK (intentions logged, nothing executed)"
                         if dr else "EXECUTED SOMETHING"))
         if not dr:
+            rc = 1
+
+    # replica-bootstrap gates (r15, config 15): snapshot+tail speedup
+    # floor, image-vs-log size direction, and the in-run byte-equal
+    # parity verdict — all absolute (properties of the storage tier).
+    # Skip-clean: runs without config 15 never fail; each gate judges
+    # its own field independently.
+    def _bs(r: dict):
+        return ((r.get("configs") or {}).get("15") or {})
+
+    spd = _bs(current).get("bootstrap_speedup_x")
+    if isinstance(spd, (int, float)):
+        verdict = ("OK" if spd >= BOOTSTRAP_SPEEDUP_MIN
+                   else "BOOTSTRAP TOO SLOW")
+        lines.append(
+            f"  replica bootstrap (config 15): snapshot+tail x{spd:.2f} "
+            f"faster than full replay (floor >= "
+            f"x{BOOTSTRAP_SPEEDUP_MIN}) -> {verdict}")
+        if spd < BOOTSTRAP_SPEEDUP_MIN:
+            rc = 1
+    ratio = _bs(current).get("snapshot_log_ratio")
+    if isinstance(ratio, (int, float)):
+        verdict = ("OK" if ratio < SNAPSHOT_LOG_RATIO_MAX
+                   else "SNAPSHOT NOT SMALLER THAN LOG")
+        lines.append(
+            f"  snapshot/log bytes: x{ratio:.4f} (must be < "
+            f"{SNAPSHOT_LOG_RATIO_MAX}) -> {verdict}")
+        if ratio >= SNAPSHOT_LOG_RATIO_MAX:
+            rc = 1
+    par = _bs(current).get("bootstrap_hash_parity")
+    if par is not None:
+        lines.append("  bootstrap hash parity: "
+                     + ("OK (byte-equal, asserted in-run)"
+                        if par else "DIVERGED"))
+        if not par:
             rc = 1
 
     # keystroke-flatness gate (r8, config 7): latency at 4x document

@@ -28,6 +28,15 @@ actions:
                                          detach_child + adopt — PR 11's
                                          crash re-home path, driven
                                          automatically)
+    quarantine executed, node has a      `re_bootstrap`: run the node's
+    registered bootstrapper              registered rebuild-from-storage
+                                         executor (typically
+                                         EngineDocSet.
+                                         bootstrap_from_storage —
+                                         snapshot + archived tail,
+                                         sync/snapshots.py; the r15
+                                         storage tier this action was
+                                         blocked on)
     tracked node gone stale (dead or     `reconnect`: kick the node's
     wedged transport, chaos conn_kill/   registered SupervisedTcpClient
     peer_hang)                           (sync/tcp.py) — exponential-
@@ -304,6 +313,7 @@ class RemediationEngine:
         self.ladder: GovernorLadder | None = None
         self._supervisors: dict[str, object] = {}
         self._hubs: dict[str, object] = {}
+        self._bootstrappers: dict[str, object] = {}
         #: bounded log of intended/executed actions — the dry-run proof
         #: surface (bench config 14 asserts the intentions were logged
         #: while nothing ran)
@@ -337,6 +347,17 @@ class RemediationEngine:
         node re-homes the hub's children onto the healthiest OTHER
         registered hub."""
         self._hubs[node] = hub
+
+    def register_bootstrapper(self, node: str, fn) -> None:
+        """Register a node's re-bootstrap executor: a zero-arg callable
+        that rebuilds the node's replica from the storage tier —
+        typically EngineDocSet.bootstrap_from_storage on a fresh
+        service (snapshot + archived tail, sync/snapshots.py), the
+        fast path r12's remediation plane was blocked on. After a
+        successful quarantine of `node`, the engine attempts the
+        `re_bootstrap` action through the same guardrails; the healed
+        replica re-joins via the ordinary reconnect/resubscribe path."""
+        self._bootstrappers[node] = fn
 
     def _on_slo_transition(self, name, ok, value, bound) -> None:
         self._slo_transitions.append(
@@ -402,6 +423,13 @@ class RemediationEngine:
                               f"cause {cause}"),
                     escalation=True):
                 decided.append(("quarantine", n))
+                boot = self._bootstrappers.get(n)
+                if boot is not None and self._attempt(
+                        "re_bootstrap", n, boot,
+                        evidence=(f"quarantined {n} has a registered "
+                                  "bootstrapper — rebuilding its replica "
+                                  "from snapshot + archived tail")):
+                    decided.append(("re_bootstrap", n))
 
         for n, rec in (state.get("nodes") or {}).items():
             if not rec.get("stale") or rec.get("quarantined") \

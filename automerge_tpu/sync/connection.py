@@ -30,8 +30,8 @@ from ..core import clock as C
 from ..core.change import coerce_change
 from ..utils import chaos, flightrec, metrics, oplag
 from . import docledger
-from .frames import (OPLAG_KEY, SUB_KEY, TRACE_KEY, msg_kind, pack_trace,
-                     unpack_trace)
+from .frames import (OPLAG_KEY, SNAP_KEY, SUB_KEY, TRACE_KEY, msg_kind,
+                     pack_trace, unpack_trace)
 
 
 class InterestSet:
@@ -243,6 +243,17 @@ class Connection:
         # changed — (conn, {"added", "added_prefixes", "removed",
         # "removed_prefixes"}) — so a hub can re-merge its cover set
         self.on_sub_change: Callable | None = None
+        # snapshot bootstrap (sync/snapshots.py): set sticky when the
+        # peer's sub delta carried `"snap": 1` — an empty-clock add from
+        # such a peer is answered with a compacted doc-state image plus
+        # the suffix instead of full history (frames.SNAP_KEY).
+        # _snap_sent holds docs whose image is in flight: until the
+        # peer's first post-apply advert lands, an empty-clock request
+        # for such a doc must NOT trigger the full-history push (the
+        # open()-advert / subscribe race would otherwise ship the whole
+        # history right behind the image it exists to replace)
+        self._peer_wants_snap = False
+        self._snap_sent: set[str] = set()
 
     # -- lifecycle (connection.js:49-56) ------------------------------------
 
@@ -352,7 +363,16 @@ class Connection:
             return
 
         if doc_id in self._their_clock:
-            changes = opset.get_missing_changes(self._their_clock[doc_id])
+            their = self._their_clock[doc_id]
+            if not their and doc_id in self._snap_sent:
+                # an image is in flight for this doc and the peer still
+                # claims an empty clock (the subscribe/open race): hold
+                # the full-history push; the post-apply advert (clock
+                # >= the image's) pulls exactly the suffix — and if the
+                # image was refused, that advert carries the peer's
+                # real clock and ordinary anti-entropy resumes
+                return
+            changes = opset.get_missing_changes(their)
             if changes:
                 self._their_clock = self._clock_union(self._their_clock, doc_id, clock)
                 self.send_msg(doc_id, clock, changes)
@@ -431,6 +451,12 @@ class Connection:
                     msg["remove"] = list(remove)
                 if remove_prefixes:
                     msg["remove_prefixes"] = list(remove_prefixes)
+            if hasattr(self._doc_set, "apply_snapshot"):
+                # opt into snapshot-frame bootstrap: only doc_sets that
+                # can APPLY an image may ask for one (a plain DocSet
+                # receiving a renumbered image could never admit the
+                # original-seq suffix on top)
+                msg["snap"] = 1
             if self._ledger is not None:
                 for d in docs or ():
                     self._ledger.record_sub(d, self, True)
@@ -448,6 +474,8 @@ class Connection:
             msg = self._local_interest.to_wire()
             if msg.get("add"):
                 msg["clocks"] = self._held_clocks(msg["add"])
+            if hasattr(self._doc_set, "apply_snapshot"):
+                msg["snap"] = 1
         self._send_traced({SUB_KEY: msg})
 
     def _held_clocks(self, doc_ids) -> dict:
@@ -483,6 +511,8 @@ class Connection:
         # to zero with no upstream churn.)
         report_removed = list(removed)
         report_removed_prefixes = list(removed_prefixes)
+        if sub.get("snap"):
+            self._peer_wants_snap = True
         with self._state_lock:
             if sub.get("reset"):
                 old = self._peer_interest
@@ -524,6 +554,13 @@ class Connection:
                     self._their_clock = self._clock_union(
                         self._their_clock, d, {})
                 metrics.bump("sync_sub_backfills")
+                if not (known or {}) and self._maybe_send_snapshot(d):
+                    # image shipped: the suffix flows when the joiner's
+                    # post-apply advert arrives (its clock then covers
+                    # the image), so a lost or refused image degrades to
+                    # ordinary full-history anti-entropy instead of
+                    # stranding the middle of the history
+                    continue
                 self.maybe_send_changes(d)
             if new_prefixes:
                 for d in self._doc_set.doc_ids:
@@ -531,6 +568,37 @@ class Connection:
                         continue
                     if any(d.startswith(p) for p in new_prefixes):
                         self.maybe_send_changes(d)
+
+    def _maybe_send_snapshot(self, doc_id: str) -> bool:
+        """Serve a fresh joiner (empty declared clock, snap-capable) a
+        compacted doc-state image instead of full history. Runs under
+        _state_lock (the _backfill path). True when an image shipped —
+        the peer's assumed clock advances to the image's covered clock,
+        so the ordinary missing-suffix flow sends only the tail."""
+        if not self._peer_wants_snap:
+            return False
+        offer_fn = getattr(self._doc_set, "snapshot_payload_for", None)
+        if offer_fn is None:
+            return False
+        offer = offer_fn(doc_id)
+        if offer is None:
+            return False
+        blob, sclock = offer
+        import base64
+
+        doc = self._doc_set.get_doc(doc_id)
+        clock = doc._doc.opset.clock
+        self._our_clock = self._clock_union(self._our_clock, doc_id, clock)
+        self._snap_sent.add(doc_id)
+        metrics.bump("sync_snapshot_frames_sent")
+        metrics.bump("sync_snapshot_bytes_sent", len(blob))
+        if self._ledger is not None:
+            self._ledger.record_send(doc_id, self, 0, nbytes=len(blob))
+        self._send_traced({
+            "docId": doc_id, "clock": dict(clock),
+            SNAP_KEY: {"clock": dict(sclock),
+                       "b64": base64.b64encode(blob).decode("ascii")}})
+        return True
 
     def _maybe_sub_flap(self, doc_id: str) -> None:
         """Chaos `sub_flap` (utils/chaos.py AMTPU_CHAOS_SUB_FLAP_DOC):
@@ -680,6 +748,18 @@ class Connection:
                 # have, vs the local clock it peeks lock-free
                 self._ledger.record_advert(doc_id, self, msg["clock"])
             self._maybe_sub_flap(doc_id)
+        snap = msg.get(SNAP_KEY)
+        if snap is not None:
+            import base64
+            apply_snap = getattr(self._doc_set, "apply_snapshot", None)
+            if apply_snap is not None:
+                blob = base64.b64decode(snap["b64"])
+                with self._apply_lock:
+                    # a False return (doc no longer empty — e.g. normal
+                    # sync raced the image) is fine: the suffix frames
+                    # behind this message still converge the doc
+                    apply_snap(doc_id, blob)
+            return self._doc_set.get_doc(doc_id)
         if msg.get("frame") is not None:
             from .frames import decode_frame
             metrics.bump("sync_frames_received")

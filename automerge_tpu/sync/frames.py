@@ -91,6 +91,17 @@ OPLAG_KEY = "oplag"
 # partial replication").
 SUB_KEY = "sub"
 
+# Snapshot-bootstrap message (sync/connection.py + sync/snapshots.py): a
+# serving peer answers a fresh joiner's empty-clock subscribe with
+# `{"docId": ..., "clock": {...}, "snap": {"clock": {...}, "b64": ...}}`
+# — a base64 compacted doc-state image covering `snap.clock`, followed by
+# the ordinary missing-suffix frames. Base64 keeps the image JSON-clean,
+# so it crosses the plain wire, the AMWM envelope's JSON head, and any
+# reference-framing relay unchanged. Strictly opt-in: the joiner
+# declares `"snap": 1` inside its sub delta (only doc_sets exposing
+# apply_snapshot do), and peers that predate the key never see one.
+SNAP_KEY = "snap"
+
 
 def msg_kind(msg: dict) -> str:
     """Coarse protocol-message class: the label space of the per-kind
@@ -105,6 +116,8 @@ def msg_kind(msg: dict) -> str:
         return f"audit:{msg['audit']}"
     if "sub" in msg:
         return "sub"
+    if msg.get("snap") is not None:
+        return "snapshot"
     if msg.get("frame") is not None:
         return "frame"
     if msg.get("changes") is not None:

@@ -112,7 +112,8 @@ class EngineDocSet:
                  live_views: bool = False, backend: str = "resident",
                  device=None, log_archive_dir: str | None = None,
                  log_horizon_changes: int | None = None,
-                 ingest_mode: str | None = None):
+                 ingest_mode: str | None = None,
+                 snapshot_dir: str | None = None):
         """live_views=True turns the node into a view server: every ingress
         runs the fused apply+reconcile with device-side diff emission
         (engine/diffs.py), per-doc MirrorDoc views are maintained
@@ -176,6 +177,9 @@ class EngineDocSet:
             if log_archive_dir is not None:
                 from .logarchive import LogArchive
                 self._resident.log_archive = LogArchive(log_archive_dir)
+            if snapshot_dir is not None:
+                from .snapshots import SnapshotStore
+                self._resident.snapshot_store = SnapshotStore(snapshot_dir)
         else:
             self._resident = ResidentDocSet(list(doc_ids or []))
             if device is not None:
@@ -184,6 +188,10 @@ class EngineDocSet:
                 raise ValueError(
                     "log_archive_dir requires backend='rows' (the log-"
                     "horizon layer lives on the rows engine's admitted log)")
+            if snapshot_dir is not None:
+                raise ValueError(
+                    "snapshot_dir requires backend='rows' (snapshots "
+                    "compact the rows engine's admitted log)")
         if log_horizon_changes is not None and (
                 backend != "rows" or log_archive_dir is None):
             # silently ignoring the bound would reproduce the exact
@@ -422,6 +430,296 @@ class EngineDocSet:
                     # the RAM log was truncated: log snapshots re-key
                     self._bump_read_vers_locked((d,))
             return out
+
+    # -- snapshots & bootstrap (sync/snapshots.py; ROADMAP #2) ---------------
+
+    @property
+    def snapshot_store(self):
+        return getattr(self._resident, "snapshot_store", None)
+
+    def write_snapshots(self, doc_ids: list[str] | None = None) -> dict:
+        """Compact each doc's causally-stable prefix into its snapshot
+        image: archive the prefix below the peer-clock floor first (the
+        horizon is the covered clock), then run the survivor join over
+        the archived prefix OUTSIDE the service lock and commit the
+        image crash-safely. Returns per-doc write stats ({} entries for
+        docs with nothing stable yet). Requires backend='rows' with
+        both log_archive_dir and snapshot_dir set."""
+        from .snapshots import compact_prefix
+
+        store = self.snapshot_store
+        if store is None:
+            raise ValueError(
+                "no snapshot store attached (construct with "
+                "snapshot_dir=...)")
+        self.archive_logs(doc_ids)
+        rset = self._resident
+        if getattr(rset, "log_archive", None) is None:
+            raise ValueError(
+                "write_snapshots requires a log archive (the prefix "
+                "source); construct with log_archive_dir=...")
+        out: dict[str, dict] = {}
+        targets = (doc_ids if doc_ids is not None
+                   else list(rset.doc_index))
+        for d in targets:
+            with self._lock:
+                i = rset.doc_index[d]
+                hz = dict(rset.log_horizon[i])
+            if not hz:
+                out[d] = {}
+                continue
+            # O(prefix) read + survivor join outside the lock — one
+            # doc's snapshot write must not stall concurrent appends
+            prefix = [c for c in rset.log_archive.read(d)
+                      if c.seq <= hz.get(c.actor, 0)]
+            with metrics.trace("sync_snapshot_write"):
+                out[d] = store.write(d, compact_prefix(prefix))
+        return out
+
+    @staticmethod
+    def _suffix_covers(row: dict | None, seq_hint: tuple,
+                       clock: dict) -> bool:
+        """True when a suffix change's transitive clock row (plus its
+        own (actor, seq) coordinate) covers the snapshot clock — the
+        conformance gate snapshot shipping requires (see
+        sync/snapshots.py)."""
+        if row is None:
+            return False
+        a0, s0 = seq_hint
+        for a, s in clock.items():
+            have = s0 if a == a0 else 0
+            r = row.get(a, 0)
+            if r > have:
+                have = r
+            if have < s:
+                return False
+        return True
+
+    def snapshot_payload_for(self, doc_id: str):
+        """Wire-serve surface: (image blob, covered clock) when a fresh
+        joiner (empty clock) can be bootstrapped from this node's
+        snapshot — i.e. an image exists AND every suffix change above
+        its clock provably covers that clock (checked against the
+        engine's exact state-clock memos; a non-covering suffix falls
+        back to full-history serving, disclosed via
+        sync_bootstrap_fallbacks). None = serve full history."""
+        store = self.snapshot_store
+        if store is None:
+            return None
+        try:
+            img = store.load(doc_id)
+        except (OSError, ValueError):
+            return None
+        if img is None or not img.clock:
+            return None
+        rset = self._resident
+        with self._lock:
+            self._maybe_flush_locked()
+            i = rset.doc_index.get(doc_id)
+            if i is None:
+                return None
+            t = rset.tables[i]
+            rset._sync_stale_table(t)
+            suffix = [c for c in rset.change_log[i]
+                      if c.seq > img.clock.get(c.actor, 0)]
+            for c in suffix:
+                row = t.state_clocks.get((c.actor, c.seq))
+                if row is not None and not isinstance(row, dict):
+                    arr, ridx = row
+                    row = {rset.actors[r]: int(v)
+                           for r, v in enumerate(arr[ridx]) if v}
+                    t.state_clocks[(c.actor, c.seq)] = row
+                if not self._suffix_covers(row, (c.actor, c.seq - 1),
+                                           img.clock):
+                    metrics.bump("sync_bootstrap_fallbacks")
+                    return None
+        blob = store.payload(doc_id)
+        if blob is None:
+            return None
+        return blob, dict(img.clock)
+
+    def _bootstrap_docs(self, images: dict) -> dict[str, bool]:
+        """Admit a batch of snapshot images (independent docs -> ONE
+        coalesced flush round) and seed each covered clock, all inside
+        one service-lock critical section: between a doc's (renumbered)
+        image admission and its clock seed, a concurrent ingress
+        carrying ORIGINAL seqs must not observe the intermediate
+        renumbered clock — it would admit mid-window and corrupt the
+        doc. Handler gossip drains after release, so adverts only ever
+        show seeded clocks. Returns per-doc success (False = the doc
+        was no longer empty; the caller serves/awaits full history)."""
+        from .frames import bytes_to_columns
+
+        cols_by = {d: bytes_to_columns(img.frame_bytes)
+                   for d, img in images.items()}
+        ok: dict[str, bool] = {}
+        try:
+            with self._lock:
+                self._maybe_flush_locked()
+                rset = self._resident
+                for d, img in images.items():
+                    self.add_doc(d)
+                    t = rset.tables[rset.doc_index[d]]
+                    rset._sync_stale_table(t)
+                    if t.clock:
+                        # not empty (normal sync raced the image):
+                        # refuse — renumbered image seqs must never
+                        # interleave with partial original history
+                        metrics.bump("sync_bootstrap_fallbacks")
+                        ok[d] = False
+                        continue
+                    ok[d] = True
+                    if cols_by[d].n_changes:
+                        self._pending.setdefault(d, []).append(cols_by[d])
+                if self._pending:
+                    self._flush_locked()
+                seeded = []
+                for d, good in ok.items():
+                    if not good:
+                        continue
+                    img = images[d]
+                    rset.seed_clock(d, img.clock, img.heads)
+                    i = rset.doc_index[d]
+                    rset.change_log[i] = []
+                    rset.log_horizon[i] = dict(img.clock)
+                    seeded.append(d)
+                self._bump_read_vers_locked(seeded)
+        except BaseException:
+            self._drain_admitted_shielded()
+            raise
+        self._drain_admitted()
+        return ok
+
+    def _bootstrap_doc(self, doc_id: str, img) -> bool:
+        return self._bootstrap_docs({doc_id: img})[doc_id]
+
+    def _apply_chunked(self, doc_id: str, changes, chunk: int = 256) -> None:
+        """Replay a (possibly deep) change list in bounded rounds so the
+        engine's budget-pressure compaction can reclaim dominated rows
+        between them — the bootstrap twin of the rebuild path's
+        _replay_chunked."""
+        changes = list(changes)
+        for k in range(0, len(changes), chunk):
+            self.apply_changes(doc_id, changes[k:k + chunk])
+
+    def apply_snapshot(self, doc_id: str, blob: bytes) -> bool:
+        """Receive-side bootstrap: decode a snapshot image, admit its
+        compacted frame, seed the covered clock, and mark the prefix
+        below-horizon. Only an EMPTY doc may be snapshot-booted (the
+        compacted frame's renumbered seqs must not interleave with
+        partial original history) — a non-empty doc returns False and
+        the caller serves/awaits full history."""
+        from .snapshots import SnapshotStore
+
+        img = SnapshotStore.decode(blob)
+        t0 = _time.perf_counter()
+        if not self._bootstrap_doc(doc_id, img):
+            return False
+        store = self.snapshot_store
+        if store is not None:
+            # keep the image: this replica can re-serve the next joiner
+            store.adopt(doc_id, blob)
+        metrics.observe("sync_bootstrap_s", _time.perf_counter() - t0)
+        metrics.bump("sync_snapshot_frames_received")
+        metrics.bump("sync_snapshot_bytes_received", len(blob))
+        return True
+
+    def bootstrap_from_storage(self, doc_ids: list[str] | None = None
+                               ) -> dict:
+        """Cold-boot this (fresh) node from its attached storage tier:
+        per doc, load the snapshot image, admit it, seed the covered
+        clock, then replay only the archived TAIL above the image's
+        clock — O(state + tail) instead of O(history). Docs without an
+        image (or whose tail fails the coverage gate) replay their full
+        archive instead (disclosed via sync_bootstrap_fallbacks).
+        Returns per-doc {'mode': 'snapshot'|'replay'|'empty',
+        'changes': n}."""
+        from .snapshots import validate_tail
+
+        rset = self._resident
+        store = self.snapshot_store
+        archive = getattr(rset, "log_archive", None)
+        out: dict[str, dict] = {}
+        targets = list(doc_ids) if doc_ids is not None else sorted(
+            set(rset.doc_index)
+            | set(store.doc_ids() if store is not None else ()))
+        t0 = _time.perf_counter()
+
+        def _replay(d) -> None:
+            archived = archive.read(d) if archive is not None else ()
+            if archived:
+                # chunked replay: a deep history applied in one round
+                # would trip the VMEM precheck before the engine's
+                # budget-pressure compaction can reclaim anything
+                self._apply_chunked(d, archived)
+                out[d] = {"mode": "replay", "changes": len(archived)}
+            else:
+                out[d] = {"mode": "empty", "changes": 0}
+
+        # independent docs' images coalesce into shared flush rounds
+        # (bounded by an op budget so one round never trips the VMEM
+        # precheck) — the per-doc fixed flush cost amortizes across the
+        # fleet, which is most of the measured bootstrap win at scale
+        batch: dict = {}
+        tails: dict = {}
+        batch_ops = 0
+
+        def _flush_batch() -> None:
+            nonlocal batch, tails, batch_ops
+            if not batch:
+                return
+            ok = self._bootstrap_docs(batch)
+            # tails coalesce the same way the images did: one batch()
+            # flush per op-budget group instead of one per doc
+            group: list = []
+            group_ops = 0
+            for d, good in ok.items():
+                if good:
+                    out[d] = {"mode": "snapshot",
+                              "changes": batch[d].n_changes
+                              + len(tails[d])}
+                    if not tails[d]:
+                        continue
+                    if len(tails[d]) >= 2048:
+                        self._apply_chunked(d, tails[d])
+                        continue
+                    group.append(d)
+                    group_ops += len(tails[d])
+                    if group_ops >= 2048:
+                        with self.batch():
+                            for g in group:
+                                self.apply_changes(g, tails[g])
+                        group, group_ops = [], 0
+                else:
+                    _replay(d)
+            if group:
+                with self.batch():
+                    for g in group:
+                        self.apply_changes(g, tails[g])
+            batch, tails, batch_ops = {}, {}, 0
+
+        for d in targets:
+            img = None
+            if store is not None:
+                img = store.load(d)
+            if img is not None and img.clock:
+                # segmented tail read: sealed segments the image's clock
+                # covers are skipped via their manifest clock ranges
+                tail = [c for c in (archive.read_since(d, img.clock)
+                                    if archive is not None else ())
+                        if c.seq > img.clock.get(c.actor, 0)]
+                if validate_tail(tail, img.clock, img.heads):
+                    batch[d] = img
+                    tails[d] = tail
+                    batch_ops += max(img.n_ops, img.n_changes)
+                    if batch_ops >= 2048:
+                        _flush_batch()
+                    continue
+                metrics.bump("sync_bootstrap_fallbacks")
+            _replay(d)
+        _flush_batch()
+        metrics.observe("sync_bootstrap_s", _time.perf_counter() - t0)
+        return out
 
     # -- registry surface (doc_set.js:5-38) ---------------------------------
 
@@ -1344,7 +1642,10 @@ class EngineDocSet:
                 # holding more than the horizon covers — the RAM tail
                 # already serves that overlap.
                 metrics.bump("sync_archive_cold_reads")
-                cold = [c for c in archive.read(doc_id)
+                reader = getattr(archive, "read_since", None)
+                src = (reader(doc_id, clock) if reader is not None
+                       else archive.read(doc_id))
+                cold = [c for c in src
                         if clock.get(c.actor, 0) < c.seq
                         <= hz.get(c.actor, 0)]
                 out = cold + out

@@ -104,7 +104,7 @@ class _Config:
     __slots__ = ("slow_apply_s", "lock_hold_s", "lock_hold_every_s",
                  "drop_frames", "stall_doc_id", "sub_flap_doc_id",
                  "sub_flap_every", "conn_kill_after", "peer_hang_s",
-                 "peer_hang_after", "node", "any")
+                 "peer_hang_after", "disk_stall_s", "node", "any")
 
     def __init__(self):
         def _f(name, default=0.0):
@@ -126,11 +126,12 @@ class _Config:
         self.peer_hang_s = max(0.0, _f("AMTPU_CHAOS_PEER_HANG_S"))
         self.peer_hang_after = max(1, int(_f("AMTPU_CHAOS_PEER_HANG_AFTER",
                                              1)))
+        self.disk_stall_s = max(0.0, _f("AMTPU_CHAOS_DISK_STALL_S"))
         self.node = os.environ.get("AMTPU_CHAOS_NODE") or None
         self.any = bool(self.slow_apply_s or self.lock_hold_s
                         or self.drop_frames or self.stall_doc_id
                         or self.sub_flap_doc_id or self.conn_kill_after
-                        or self.peer_hang_s)
+                        or self.peer_hang_s or self.disk_stall_s)
 
 
 _config: _Config | None = None
@@ -181,6 +182,23 @@ def slow_apply(node: str | None = None) -> None:
         return
     _disclose("slow_apply", node, s=c.slow_apply_s)
     _sleep(c.slow_apply_s)
+
+
+def disk_stall(node: str | None = None) -> None:
+    """Injection point in the storage tier's durability paths
+    (`AMTPU_CHAOS_DISK_STALL_S=<seconds>`): every archive/seal/snapshot
+    fsync (sync/logarchive.py `_fsync_file`, sync/snapshots.py write)
+    sleeps that long first — a slow or overloaded disk. Signature: the
+    node's `sync_archive_fsync_s` histogram inflates while round
+    flushes and lock waits stay ordinary, which is what lets the doctor
+    attribute slow-append/slow-bootstrap to `storage_stall` instead of
+    the engine. Inert (one cached check) unless the knob is set; every
+    injection is disclosed."""
+    c = _cfg()
+    if not c.disk_stall_s or not _match(c, node):
+        return
+    _disclose("disk_stall", node, s=c.disk_stall_s)
+    _sleep(c.disk_stall_s)
 
 
 def drop_frame(node: str | None = None, kind: str = "frame") -> bool:

@@ -150,7 +150,53 @@ COUNTERS: dict[str, str] = {
     "sync_archive_tail_skipped": "torn archive tails skipped on read",
     "sync_archive_reads_cached":
         "archive cold reads served from the parsed-prefix cache "
-        "(sync/logarchive.py; keyed by file size+mtime)",
+        "(sync/logarchive.py; active-segment entries keyed by file "
+        "size+mtime)",
+    # segmented archive + snapshot shipping (r15 storage tier:
+    # sync/logarchive.py segments, sync/snapshots.py images,
+    # sync/service.py bootstrap — docs/INTERNALS.md "The storage tier")
+    "sync_segments_sealed":
+        "active archive segments sealed (rotated immutable + manifest "
+        "entry committed) (sync/logarchive.py)",
+    "sync_segments_adopted":
+        "orphan sealed segments re-adopted into a manifest after a "
+        "crash between the seal rename and the manifest commit "
+        "(sync/logarchive.py)",
+    "sync_segment_reads_cached":
+        "sealed-segment reads served from the immutable per-segment "
+        "parse cache — entries never invalidate, only LRU-evict "
+        "(sync/logarchive.py)",
+    "sync_segments_skipped":
+        "sealed segments skipped by a clock-bounded tail read — the "
+        "manifest clock range proved every record covered "
+        "(sync/logarchive.py read_since; the segmented bootstrap/"
+        "cold-read win)",
+    "sync_snapshot_writes":
+        "compacted doc-state snapshot images committed "
+        "(sync/snapshots.py; write-temp-then-rename)",
+    "sync_snapshot_bytes_written":
+        "bytes of committed snapshot images (sync/snapshots.py)",
+    "sync_snapshot_loads":
+        "snapshot images decoded from disk (sync/snapshots.py; "
+        "cache misses — cached loads don't re-decode)",
+    "sync_snapshot_frames_sent":
+        "snapshot images shipped to fresh joiners over the sync wire "
+        "(sync/connection.py; the empty-clock subscribe answer)",
+    "sync_snapshot_bytes_sent":
+        "payload bytes of snapshot images shipped (sync/connection.py)",
+    "sync_snapshot_frames_received":
+        "snapshot images applied from the sync wire (sync/service.py "
+        "apply_snapshot)",
+    "sync_snapshot_bytes_received":
+        "payload bytes of snapshot images applied (sync/service.py)",
+    "sync_bootstrap_docs":
+        "docs snapshot-booted: compacted image admitted + covered "
+        "clock seeded (engine seed_clock; local and wire bootstraps)",
+    "sync_bootstrap_fallbacks":
+        "bootstraps that fell back to full-history replay/serving — "
+        "no usable image, non-covering tail, or a non-empty doc "
+        "(sync/service.py; disclosed so a silent snapshot regression "
+        "shows up in ops metrics, not just in wall time)",
     "sync_metrics_pulls": "remote metrics snapshots served to peers",
     # lockprof (utils/lockprof.py): the contention plane. The `_total`
     # suffix is deliberate prometheus idiom for this one counter (it
@@ -248,8 +294,8 @@ COUNTERS: dict[str, str] = {
     # fleet health plane (perf/fleet.py, perf/slo.py, utils/chaos.py)
     "obs_chaos_injected":
         "chaos fault injections fired {fault=slow_apply|lock_hold|"
-        "frame_drop|doc_stall|sub_flap} (utils/chaos.py; inert unless "
-        "AMTPU_CHAOS_* set)",
+        "frame_drop|doc_stall|sub_flap|conn_kill|peer_hang|disk_stall} "
+        "(utils/chaos.py; inert unless AMTPU_CHAOS_* set)",
     "obs_fleet_stragglers_flagged":
         "straggler flags raised by the fleet collector {node=...} "
         "(perf/fleet.py; counted on the transition into flagged)",
@@ -259,8 +305,8 @@ COUNTERS: dict[str, str] = {
     # r13): every automated action, withhold, and recovery disclosed
     "obs_remed_actions":
         "remediation actions EXECUTED {action=quarantine|reconnect|"
-        "governor_escalate|governor_relax} (perf/remediate.py; dry-run "
-        "intentions never land here)",
+        "re_bootstrap|governor_escalate|governor_relax} "
+        "(perf/remediate.py; dry-run intentions never land here)",
     "obs_remed_skipped":
         "remediation actions withheld by a guardrail {reason=cooldown|"
         "budget|quorum|dry_run} (perf/remediate.py)",
@@ -404,6 +450,15 @@ HISTOGRAMS: dict[str, str] = {
         "remediation-engine per-tick wall cost (perf/remediate.py; "
         "p50/interval = the steady-state duty cycle bench config 14 "
         "bounds under 2%)",
+    "sync_archive_fsync_s":
+        "wall seconds of one storage-tier fsync — archive append, "
+        "segment seal, manifest commit, snapshot write "
+        "(sync/logarchive.py / sync/snapshots.py; the doctor's "
+        "storage_stall evidence and the disk_stall chaos signature)",
+    "sync_bootstrap_s":
+        "wall seconds of one replica bootstrap — snapshot admission + "
+        "clock seed + tail replay, or the full-replay fallback "
+        "(sync/service.py bootstrap paths)",
 }
 
 SPANS: dict[str, str] = {
@@ -418,6 +473,9 @@ SPANS: dict[str, str] = {
     "sync_hashes_fanout": "sharded service hash fan-out over all shards",
     "sync_msg_send": "one outgoing protocol message (trace-context root)",
     "sync_msg_serve": "serving one received protocol message",
+    "sync_snapshot_write":
+        "one doc's snapshot write: archived-prefix read + survivor "
+        "join + crash-safe image commit (sync/service.write_snapshots)",
     "engine_kernel_compile":
         "attributed jit lower+compile wall time {kernel=...} "
         "(perfscope listener; timer-only, no span records)",

