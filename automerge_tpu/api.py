@@ -220,6 +220,14 @@ def undo(doc, message: str | None = None) -> RootMap:
 
     redo_ops: list[Op] = []
     for op in undo_ops:
+        if op.action == "move":
+            # redo = move back to the element's CURRENT location (read
+            # now: applying the undo rewrites it)
+            cur = _current_location(opset, op)
+            if cur is not None:
+                redo_ops.append(Op("move", cur.obj, key=cur.key,
+                                   value=op.value))
+            continue
         if op.action not in ("set", "del", "link"):
             raise ValueError(f"Unexpected operation type in undo history: {op!r}")
         field_ops = O.get_field_ops(opset, op.obj, op.key)
@@ -231,7 +239,43 @@ def undo(doc, message: str | None = None) -> RootMap:
     opset = opset.replace_undo(
         undo_pos=undo_pos - 1,
         redo_stack=opset.redo_stack + (tuple(redo_ops),))
-    return _apply_new_change(doc, opset, undo_ops, message)
+    return _apply_new_change(doc, opset, _finalize_move_ops(opset, undo_ops),
+                             message)
+
+
+def _current_location(opset: OpSet, op: Op) -> Op | None:
+    """The effective location op of a move target right now (map child:
+    resolved loc or first inbound link; list element: its placement)."""
+    dest = opset.by_object.get(op.obj)
+    if dest is not None and dest.is_sequence:
+        return dest.insertion.get(op.value)
+    child = opset.by_object.get(op.value)
+    if child is None:
+        return None
+    if child.loc is not None:
+        return child.loc
+    for ref in child.inbound:
+        if ref.action == "link":
+            return ref
+    return None
+
+
+def _finalize_move_ops(opset: OpSet, ops) -> list[Op]:
+    """Allocate fresh destination elem counters for LIST move ops in an
+    undo/redo replay — stored records deliberately omit them so a stale
+    stamp can never tie with elements inserted since."""
+    out: list[Op] = []
+    bump: dict[str, int] = {}
+    for op in ops:
+        if op.action == "move" and op.elem is None:
+            dest = opset.by_object.get(op.obj)
+            if dest is not None and dest.is_sequence:
+                nxt = bump.get(op.obj, dest.max_elem) + 1
+                bump[op.obj] = nxt
+                op = Op("move", op.obj, key=op.key, value=op.value,
+                        elem=nxt)
+        out.append(op)
+    return out
 
 
 def can_redo(doc) -> bool:
@@ -250,7 +294,8 @@ def redo(doc, message: str | None = None) -> RootMap:
     opset = opset.replace_undo(
         undo_pos=opset.undo_pos + 1,
         redo_stack=opset.redo_stack[:-1])
-    return _apply_new_change(doc, opset, redo_ops, message)
+    return _apply_new_change(doc, opset, _finalize_move_ops(opset, redo_ops),
+                             message)
 
 
 # ---------------------------------------------------------------------------

@@ -129,6 +129,11 @@ def build_opset(cols) -> OpSet:
     i_ins, i_set, i_del, i_link = (act_idx["ins"], act_idx["set"],
                                    act_idx["del"], act_idx["link"])
     make_codes = (act_idx["makeMap"], act_idx["makeList"], act_idx["makeText"])
+    if (np.asarray(cols.op_action) == act_idx["move"]).any():
+        # the move plane's resolution (winner + cycle fixpoint,
+        # core/moves.py) has no vectorized from-scratch formulation here
+        # yet; the interpretive path owns those semantics
+        raise BulkUnsupported("log contains move ops")
 
     n_ch = cols.n_changes
     actors = cols.actors

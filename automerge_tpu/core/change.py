@@ -21,7 +21,14 @@ from typing import Any, Iterable, Mapping
 
 MAKE_ACTIONS = ("makeMap", "makeList", "makeText")
 ASSIGN_ACTIONS = ("set", "del", "link")
-ALL_ACTIONS = MAKE_ACTIONS + ("ins",) + ASSIGN_ACTIONS
+# `move` (r16) reparents a map child object or repositions a list element
+# as ONE op: {obj: destination container, key: dest key (map) / dest anchor
+# elemId or '_head' (list), value: moved object id (map) / moved elemId
+# (list), elem: dest sibling-order counter (list moves only)}. Concurrent
+# moves of one element resolve by priority; cycles resolve deterministically
+# (core/moves.py). The reference has no equivalent — a reparent there is a
+# delete + re-insert of the whole subtree.
+ALL_ACTIONS = MAKE_ACTIONS + ("ins",) + ASSIGN_ACTIONS + ("move",)
 
 
 class Op:
@@ -78,7 +85,7 @@ class Op:
         out: dict[str, Any] = {"action": self.action, "obj": self.obj}
         if self.key is not None:
             out["key"] = self.key
-        if self.action in ("set", "link"):
+        if self.action in ("set", "link", "move"):
             out["value"] = self.value
         if self.elem is not None:
             out["elem"] = self.elem

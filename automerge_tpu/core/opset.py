@@ -66,7 +66,7 @@ class ObjState:
     """
 
     __slots__ = ("init_action", "fields", "following", "insertion", "inbound",
-                 "max_elem", "elem_ids")
+                 "max_elem", "elem_ids", "moves", "loc")
 
     def __init__(self, init_action: str):
         self.init_action = init_action
@@ -82,6 +82,13 @@ class ObjState:
         self.inbound: dict[Op, None] = {}
         self.max_elem = 0
         self.elem_ids: ElemList | None = ElemList() if seq else None
+        # move plane (core/moves.py): per moved list element its
+        # (base ins op, non-dominated move candidates); per moved map
+        # child its resolved effective location op. Empty/None for every
+        # object no move has ever targeted — the reference semantics are
+        # untouched until the first move arrives.
+        self.moves: dict[str, tuple] = {}
+        self.loc: Op | None = None
 
     def copy(self) -> "ObjState":
         out = ObjState.__new__(ObjState)
@@ -92,6 +99,8 @@ class ObjState:
         out.inbound = dict(self.inbound)
         out.max_elem = self.max_elem
         out.elem_ids = self.elem_ids  # copied lazily by Builder.elem_ids_mut
+        out.moves = dict(self.moves) if self.moves else {}
+        out.loc = self.loc
         return out
 
     @property
@@ -99,11 +108,35 @@ class ObjState:
         return self.init_action in ("makeList", "makeText")
 
 
+class MoveEntry:
+    """Per-moved-list-element move-plane state (one per ObjState.moves
+    entry): the original ins (the undroppable base edge and the ghost
+    spot's identity), the non-dominated move candidates, the per-actor
+    MINIMUM move seq ever seen (`stamps` — what anchored_at_placed tests
+    against; additions are monotone and already-admitted siblings can
+    never cover a later-arriving move, so the ghost/placed split never
+    flips), and whether any sibling op follows the placed spot (the flag
+    that forces a full index rebuild when the winner changes)."""
+
+    __slots__ = ("base", "cands", "stamps", "followers")
+
+    def __init__(self, base: Op, cands: tuple = (),
+                 stamps: dict | None = None, followers: bool = False):
+        self.base = base
+        self.cands = cands
+        self.stamps = stamps if stamps is not None else {}
+        self.followers = followers
+
+    def copy(self) -> "MoveEntry":
+        return MoveEntry(self.base, self.cands, dict(self.stamps),
+                         self.followers)
+
+
 class Builder:
     """Copy-on-write working state for applying a batch of changes."""
 
     __slots__ = ("states", "by_object", "clock", "deps", "queue", "history",
-                 "_touched", "_elem_copied", "_deferred_seqs")
+                 "moved_objs", "_touched", "_elem_copied", "_deferred_seqs")
 
     def __init__(self, opset: "OpSet"):
         self.states: dict[str, AList] = dict(opset.states)
@@ -112,6 +145,7 @@ class Builder:
         self.deps: dict[str, int] = dict(opset.deps)
         self.queue: list[Change] = list(opset.queue)
         self.history: AList = opset.history
+        self.moved_objs: set[str] = set(opset.moved_objs)
         self._touched: set[str] = set()
         self._elem_copied: set[str] = set()
         # sequence objects whose elem_ids maintenance was deferred by a
@@ -186,6 +220,71 @@ def transitive_deps(state, base_deps: dict[str, int]) -> dict[str, int]:
 
 # ---------------------------------------------------------------------------
 # Paths and RGA traversal (op_set.js:43-60, 343-397)
+#
+# Ghost spots (the move plane, core/moves.py): a moved-away list element
+# leaves its original `ins` in the insertion tree as an invisible GHOST —
+# elements anchored at it keep their positions (the anchor relation is an
+# ordering artifact, not containment), while the element itself is placed
+# by its winning move op. A sibling op that causally KNOWS some move of
+# its anchor (`anchored_at_placed`) follows the anchor's placed spot
+# instead — that predicate is decidable at the sibling's admission
+# (causal delivery: any move it covers has already arrived) and never
+# flips afterwards, so positions are stable and delivery-order-free.
+# Traversal walks spot-qualified ids: `eid` is the element's placed spot,
+# `eid + GHOST_SUFFIX` its ghost. Ghost ids never appear in elem_ids,
+# diffs, or on the wire.
+
+GHOST_SUFFIX = "\x00g"
+
+
+def is_ghost(key: str) -> bool:
+    return key.endswith(GHOST_SUFFIX)
+
+
+def strip_ghost(key: str) -> str:
+    return key[:-len(GHOST_SUFFIX)] if key.endswith(GHOST_SUFFIX) else key
+
+
+def moved_away(obj, eid: str) -> bool:
+    """True when `eid`'s effective placement is a move op (its original
+    ins spot is a ghost)."""
+    if not obj.moves or eid not in obj.moves:
+        return False
+    placed = obj.insertion.get(eid)
+    return placed is not None and placed.action == "move"
+
+
+def anchored_at_placed(state, obj, sib_op, anchor_eid: str) -> bool:
+    """True when sibling op `sib_op` (ins or move) anchored at
+    `anchor_eid` follows the anchor's PLACED spot: it causally covers at
+    least one move of the anchor. Stable from the op's admission on."""
+    entry = obj.moves.get(anchor_eid)
+    if entry is None:
+        return False
+    actor, seq = sib_op.actor, sib_op.seq
+    if not actor or not seq:
+        return True  # local unstamped op: sees the current placement
+    clock = None
+    for a, q in entry.stamps.items():
+        if a == actor:
+            if seq > q:
+                return True
+            continue
+        if clock is None:
+            clock = state.states[actor][seq - 1][1]
+        if clock.get(a, 0) >= q:
+            return True
+    return False
+
+
+def spot_of(state, obj, anchor_key: str, via_op) -> str:
+    """Spot-qualified id of `via_op`'s anchor: the placed spot when the
+    op causally follows the anchor's relocation, else the ghost spot."""
+    if anchor_key == HEAD or not moved_away(obj, anchor_key):
+        return anchor_key
+    if anchored_at_placed(state, obj, via_op, anchor_key):
+        return anchor_key
+    return anchor_key + GHOST_SUFFIX
 
 def get_path(state, object_id: str) -> list | None:
     """Path from the root to `object_id` (string keys for maps, integer
@@ -195,7 +294,9 @@ def get_path(state, object_id: str) -> list | None:
         obj = state.by_object.get(object_id)
         if obj is None or not obj.inbound:
             return None
-        ref = next(iter(obj.inbound))
+        # a move-targeted object's position is its RESOLVED location
+        # (core/moves.py); everything else keeps first-inbound semantics
+        ref = obj.loc if obj.loc is not None else next(iter(obj.inbound))
         object_id = ref.obj
         parent = state.by_object[object_id]
         if parent.is_sequence:
@@ -209,14 +310,24 @@ def get_path(state, object_id: str) -> list | None:
 
 
 def get_parent(state, object_id: str, key: str) -> str | None:
-    """elemId after which `key` was inserted, or None for the head
-    (op_set.js:336-341)."""
+    """Spot-qualified anchor after which `key` sits, or None for the head
+    (op_set.js:336-341). A ghost spot's anchor comes from the element's
+    original ins; a placed spot's from its effective placement op."""
     if key == HEAD:
         return None
-    insertion = state.by_object[object_id].insertion.get(key)
-    if insertion is None:
-        raise TypeError(f"Missing index entry for list element {key}")
-    return insertion.key
+    obj = state.by_object[object_id]
+    if is_ghost(key):
+        entry = obj.moves.get(strip_ghost(key))
+        if entry is None:
+            raise TypeError(f"Missing move entry for ghost {key!r}")
+        op = entry.base
+    else:
+        op = obj.insertion.get(key)
+        if op is None:
+            raise TypeError(f"Missing index entry for list element {key}")
+    if op.key == HEAD:
+        return HEAD
+    return spot_of(state, obj, op.key, op)
 
 
 def insertions_after(state, object_id: str, parent_id: str,
@@ -224,14 +335,39 @@ def insertions_after(state, object_id: str, parent_id: str,
     """Element IDs inserted directly after `parent_id`, in Lamport-descending
     (elem, actor) order; if `child_id` is given, only those ordered before it
     (op_set.js:351-362)."""
-    child_key = parse_elem_id(child_id) if child_id else None
     obj = state.by_object[object_id]
-    ops = [op for op in obj.following.get(parent_id, ()) if op.action == "ins"]
-    if child_key is not None:
-        child_actor, child_elem = child_key
-        ops = [op for op in ops if (op.elem, op.actor) < (child_elem, child_actor)]
+    anchor = strip_ghost(parent_id) if parent_id else parent_id
+    ops = [op for op in obj.following.get(anchor, ())
+           if op.action == "ins" or op.action == "move"]
+    if parent_id and obj.moves and moved_away(obj, anchor):
+        # the anchor element has a ghost and a placed spot: each sibling
+        # op belongs to exactly one of them (anchored_at_placed is stable
+        # from its admission, so this split never flips)
+        want_placed = not is_ghost(parent_id)
+        ops = [op for op in ops
+               if anchored_at_placed(state, obj, op, anchor) == want_placed]
+    if child_id:
+        # a moved child bound compares by its PLACEMENT op's stamp, not
+        # by the stamp embedded in its id; a ghost bound by its ins
+        cid = strip_ghost(child_id)
+        placed = (obj.moves[cid].base if is_ghost(child_id)
+                  else obj.insertion.get(cid))
+        if placed is not None and (placed.action == "move"
+                                   or is_ghost(child_id)):
+            child_elem, child_actor = placed.elem, placed.actor
+        else:
+            child_actor, child_elem = parse_elem_id(cid)
+        ops = [op for op in ops
+               if (op.elem, op.actor) < (child_elem, child_actor)]
     ops.sort(key=lambda op: (op.elem, op.actor), reverse=True)
-    return [make_elem_id(op.actor, op.elem) for op in ops]
+    out = []
+    for op in ops:
+        if op.action == "move":
+            out.append(op.value)          # the element at its placed spot
+        else:
+            eid = make_elem_id(op.actor, op.elem)
+            out.append(eid + GHOST_SUFFIX if moved_away(obj, eid) else eid)
+    return out
 
 
 def get_next(state, object_id: str, key: str) -> str | None:
@@ -301,8 +437,8 @@ def _conflict_records(ops: tuple[Op, ...]) -> list[dict]:
     out = []
     for op in ops[1:]:
         record: dict[str, Any] = {"actor": op.actor, "value": op.value}
-        if op.action == "link":
-            record["link"] = True
+        if op.action in ("link", "move"):
+            record["link"] = True  # a map move's value IS a child object id
         out.append(record)
     return out
 
@@ -329,6 +465,15 @@ def apply_insert(b: Builder, op: Op) -> list[dict]:
     obj.following[op.key] = obj.following.get(op.key, ()) + (op,)
     obj.max_elem = max(op.elem, obj.max_elem)
     obj.insertion[elem_id] = op
+    if obj.moves:
+        entry = obj.moves.get(op.key)
+        if entry is not None and anchored_at_placed(b, obj, op, op.key):
+            # this insert tracks the anchor's placement: a future winner
+            # change must reposition it too (full-index rebuild path)
+            if not entry.followers:
+                entry = entry.copy()
+                entry.followers = True
+                obj.moves[op.key] = entry
     return []
 
 
@@ -396,7 +541,7 @@ def update_map_key(b: Builder, object_id: str, key: str) -> list[dict]:
     else:
         edit["action"] = "set"
         edit["value"] = ops[0].value
-        if ops[0].action == "link":
+        if ops[0].action in ("link", "move"):
             edit["link"] = True
         if len(ops) > 1:
             edit["conflicts"] = _conflict_records(ops)
@@ -432,6 +577,16 @@ def apply_assign(b: Builder, op: Op, emit: bool = True) -> list[dict]:
     remaining.sort(key=lambda o: o.actor or "", reverse=True)
     obj.fields[op.key] = tuple(remaining)
 
+    # single-location rule for move-managed children (core/moves.py): a
+    # link to a child whose position is move-resolved registers as a
+    # potential base edge (inbound) but must not ALSO present the child
+    # beside its effective location
+    if op.action == "link" and op.value in b.moved_objs:
+        child = b.by_object[op.value]
+        if child.loc is not None and child.loc is not op:
+            obj.fields[op.key] = tuple(
+                o for o in obj.fields[op.key] if o is not op)
+
     if not emit:
         # No-diff mode (from-scratch loads): edit records have no consumer
         # and elem_ids maintenance — the per-op O(sqrt n) index work — is
@@ -451,7 +606,20 @@ def apply_assign(b: Builder, op: Op, emit: bool = True) -> list[dict]:
 _NO_DIFFS: tuple = ()
 
 
-def rebuild_elem_ids(obj: "ObjState", actor_rank: dict | None = None) -> None:
+def _queue_gauges(b: "Builder") -> None:
+    """Causal-queue gauges after a batch (THE one definition — every
+    add_changes exit path reports them): a growing depth means peers are
+    delivering out of causal order (or a dep will never arrive); bytes
+    are a coarse per-change host-object estimate (header + per-op
+    records — exact sizeof walks would cost more than the queue is
+    worth)."""
+    metrics.gauge("core_queue_depth", len(b.queue))
+    metrics.gauge("core_queue_bytes",
+                  sum(120 + 80 * len(c.ops) for c in b.queue))
+
+
+def rebuild_elem_ids(obj: "ObjState", actor_rank: dict | None = None,
+                     state=None) -> None:
     """Rebuild a sequence object's visible-element index from its insertion
     tree in one pass: native RGA linearization over every insertion (the
     same algorithm the incremental path applies per-op), then a bulk
@@ -463,29 +631,41 @@ def rebuild_elem_ids(obj: "ObjState", actor_rank: dict | None = None) -> None:
 
     from ..native.linearize import linearize_host
 
-    ins_ops = list(obj.insertion.values())
-    n = len(ins_ops)
+    # iterate (eid, op) pairs: a moved element's effective op carries the
+    # MOVE stamp for ordering while the dict key keeps its identity
+    ins_items = list(obj.insertion.items())
+    n = len(ins_items)
     if n == 0:
         obj.elem_ids = ElemList()
+        return
+    if obj.moves:
+        # moved lists have ghost/placed spot splits the native linearizer
+        # cannot see (and can violate its parent.elem < child.elem
+        # invariant): rebuild by walking the insertion tree in document
+        # order instead — same O(n log n), no invariant needed. The walk
+        # needs the states table for the anchored_at_placed predicate.
+        if state is None:
+            raise ValueError("rebuilding a moved list requires state")
+        _rebuild_by_walk(obj, state)
         return
     if actor_rank is None:
         # ranks need only be order-isomorphic to the actor strings for
         # sibling comparisons within this object
         actor_rank = {a: r for r, a in enumerate(
-            sorted({op.actor for op in ins_ops}))}
-    slot_of = {f"{op.actor}:{op.elem}": s for s, op in enumerate(ins_ops)}
-    elem = np.fromiter((op.elem for op in ins_ops), np.int32, n)
-    arank = np.fromiter((actor_rank[op.actor] for op in ins_ops),
+            sorted({op.actor for _eid, op in ins_items}))}
+    slot_of = {eid: s for s, (eid, _op) in enumerate(ins_items)}
+    elem = np.fromiter((op.elem for _e, op in ins_items), np.int32, n)
+    arank = np.fromiter((actor_rank[op.actor] for _e, op in ins_items),
                         np.int32, n)
     parent = np.fromiter(
-        ((-1 if op.key == HEAD else slot_of[op.key]) for op in ins_ops),
+        ((-1 if op.key == HEAD else slot_of[op.key])
+         for _e, op in ins_items),
         np.int32, n)
     pos = linearize_host(np.ones(n, bool), elem, arank, parent)
     keys_v, values_v = [], []
     fields_get = obj.fields.get
     for s in np.argsort(pos, kind="stable").tolist():
-        op = ins_ops[s]
-        eid = f"{op.actor}:{op.elem}"
+        eid = ins_items[s][0]
         fops = fields_get(eid)
         if not fops:
             continue
@@ -494,6 +674,33 @@ def rebuild_elem_ids(obj: "ObjState", actor_rank: dict | None = None) -> None:
         values_v.append(Link(first.value) if first.action == "link"
                         else first.value)
     obj.elem_ids = ElemList(keys_v, values_v)
+
+
+def _rebuild_by_walk(obj: "ObjState", state) -> None:
+    """Visible-index rebuild by insertion-tree walk (move-aware twin of
+    the linearize_host path above). Ghost spots yield no entry — their
+    ids are not fields keys — but their subtrees are walked through."""
+    keys_v, values_v = [], []
+    fields_get = obj.fields.get
+    for eid in iter_list_elem_ids(_ObjView(obj, state), "_"):
+        fops = fields_get(eid)
+        if not fops:
+            continue
+        first = fops[0]
+        keys_v.append(eid)
+        values_v.append(Link(first.value) if first.action == "link"
+                        else first.value)
+    obj.elem_ids = ElemList(keys_v, values_v)
+
+
+class _ObjView:
+    """Minimal state adapter so the RGA traversal helpers accept a bare
+    ObjState (rebuilds run outside any Builder)."""
+    __slots__ = ("by_object", "states")
+
+    def __init__(self, obj, state=None):
+        self.by_object = {"_": obj}
+        self.states = state.states if state is not None else {}
 
 
 def apply_op(b: Builder, op: Op, emit: bool = True) -> list[dict]:
@@ -505,6 +712,9 @@ def apply_op(b: Builder, op: Op, emit: bool = True) -> list[dict]:
         return apply_insert(b, op)
     if action in ("set", "del", "link"):
         return apply_assign(b, op, emit)
+    if action == "move":
+        from .moves import apply_move
+        return apply_move(b, op, emit)
     raise ValueError(f"Unknown operation type {action}")
 
 
@@ -611,16 +821,18 @@ class OpSet:
     """
 
     __slots__ = ("states", "by_object", "clock", "deps", "queue", "history",
-                 "undo_pos", "undo_stack", "redo_stack")
+                 "moved_objs", "undo_pos", "undo_stack", "redo_stack")
 
     def __init__(self, states, by_object, clock, deps, queue, history,
-                 undo_pos=0, undo_stack=(), redo_stack=()):
+                 undo_pos=0, undo_stack=(), redo_stack=(),
+                 moved_objs=frozenset()):
         self.states = states          # actor -> AList[(Change, all_deps)]
         self.by_object = by_object    # objectId -> ObjState
         self.clock = clock            # actor -> seq
         self.deps = deps              # pruned dependency frontier
         self.queue = queue            # tuple of causally-unready changes
         self.history = history        # AList[Change], application order
+        self.moved_objs = moved_objs  # map-realm children with move cands
         self.undo_pos = undo_pos
         self.undo_stack = undo_stack  # tuple of tuples of undo Ops
         self.redo_stack = redo_stack
@@ -637,6 +849,7 @@ class OpSet:
                redo_stack=None) -> "OpSet":
         return OpSet(states=b.states, by_object=b.by_object, clock=b.clock,
                      deps=b.deps, queue=tuple(b.queue), history=b.history,
+                     moved_objs=frozenset(b.moved_objs),
                      undo_pos=self.undo_pos if undo_pos is None else undo_pos,
                      undo_stack=self.undo_stack if undo_stack is None else undo_stack,
                      redo_stack=self.redo_stack if redo_stack is None else redo_stack)
@@ -644,7 +857,7 @@ class OpSet:
     def replace_undo(self, undo_pos=None, undo_stack=None, redo_stack=None) -> "OpSet":
         return OpSet(states=self.states, by_object=self.by_object,
                      clock=self.clock, deps=self.deps, queue=self.queue,
-                     history=self.history,
+                     history=self.history, moved_objs=self.moved_objs,
                      undo_pos=self.undo_pos if undo_pos is None else undo_pos,
                      undo_stack=self.undo_stack if undo_stack is None else undo_stack,
                      redo_stack=self.redo_stack if redo_stack is None else redo_stack)
@@ -655,7 +868,8 @@ class OpSet:
         return self.add_changes([change])
 
     def add_changes(self, changes, emit_diffs: bool = True,
-                    text_batch: bool = False) -> tuple["OpSet", list[dict]]:
+                    text_batch: bool = False,
+                    move_batch: bool = False) -> tuple["OpSet", list[dict]]:
         """Queue + causally apply a batch of changes (op_set.js:294-297).
 
         emit_diffs=False is the from-scratch-load fast path: no edit
@@ -684,14 +898,24 @@ class OpSet:
                 b = self.thaw()
                 batch_diffs = try_apply_text_batch(b, changes)
                 if batch_diffs is not None:
-                    metrics.gauge("core_queue_depth", len(b.queue))
-                    metrics.gauge("core_queue_bytes",
-                                  sum(120 + 80 * len(c.ops)
-                                      for c in b.queue))
+                    _queue_gauges(b)
                     return self.freeze(b), batch_diffs
                 # ineligible: fall through on a FRESH builder (the scan
                 # phase mutates nothing, but a clean thaw keeps that
                 # contract local)
+        if move_batch and emit_diffs and not self.queue:
+            # the move twin of the text plane: an all-move batch admits
+            # with ONE winner+cycle resolution per touched realm
+            # (core/moves.py), kernel-routed above the size threshold
+            from .moves import MOVE_BATCH_MIN_OPS, try_apply_move_batch
+            changes = list(changes)
+            if sum(len(c.ops) for c in changes
+                   if isinstance(c, Change)) >= MOVE_BATCH_MIN_OPS:
+                b = self.thaw()
+                batch_diffs = try_apply_move_batch(b, changes)
+                if batch_diffs is not None:
+                    _queue_gauges(b)
+                    return self.freeze(b), batch_diffs
         b = self.thaw()
         diffs: list[dict] = []
         for change in changes:
@@ -703,19 +927,13 @@ class OpSet:
             for oid in b._deferred_seqs:
                 obj = b.by_object.get(oid)
                 if obj is not None:
-                    rebuild_elem_ids(obj)
+                    rebuild_elem_ids(obj, state=b)
             b._deferred_seqs.clear()
-        # causal-queue depth after the batch: a growing gauge means peers
-        # are delivering out of causal order (or a dep will never arrive)
-        metrics.gauge("core_queue_depth", len(b.queue))
+        _queue_gauges(b)
         # op-lifecycle plane: mark when parking began (one locked batch
         # call; 1/N hash-sampled inside)
         if b.queue:
             oplag.queue_park_batch([(c.actor, c.seq) for c in b.queue])
-        # coarse host-object estimate (change header + per-op records);
-        # exact sizeof walks would cost more than the queue is worth
-        metrics.gauge("core_queue_bytes",
-                      sum(120 + 80 * len(c.ops) for c in b.queue))
         return self.freeze(b), diffs
 
     # -- change-graph queries (op_set.js:299-330) ---------------------------

@@ -137,6 +137,14 @@ def decode_round_diffs(rset, chg_fid: np.ndarray, chg_elem: np.ndarray,
             obj_idx, key = t.fields[f]
             if obj_idx in seq_objs:
                 continue
+            if key.startswith("\x00loc\x00"):
+                # move-plane location fields (engine/encode.move_loc_key)
+                # are hash/domination bookkeeping, not application state:
+                # the engine per-op diff stream does not carry move
+                # semantics yet (DISCLOSED limitation — the interpretive
+                # core's diff stream does; a mirror view of a move-bearing
+                # doc should materialize from state instead)
+                continue
             rec: dict[str, Any] = {"type": "map", "obj": oid_of[obj_idx],
                                    "key": key}
             if present[i, f]:

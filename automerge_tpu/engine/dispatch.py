@@ -46,6 +46,13 @@ _LINK = {
                                  # + cumsum + hash over packed lanes)
     "span_fixed_s": 1e-4,        # host numpy span-merge per batch (fixed
                                  # array setup)
+    "move_lane_s": 6e-8,         # host numpy move-resolution per node/
+                                 # cand lane per doubling round (gathers
+                                 # + compares over packed lanes; measured
+                                 # ~0.05-0.08us/lane on the 2-core bench
+                                 # host at 128-1024 lanes)
+    "move_fixed_s": 2e-4,        # host numpy move-resolution per batch
+                                 # (array setup + 2 fixpoint rounds min)
 }
 
 
@@ -204,6 +211,38 @@ def merge_spans_adaptive(doc_spans: list, passes: int = 1):
     if plan.backend == "host":
         return plan, merge_spans_host(spans)
     return plan, merge_spans(spans)
+
+
+def plan_moves(n_docs: int, n_pad: int, k_pad: int,
+               passes: int = 1) -> Plan:
+    """Backend plan for a batched move cycle-resolution of `n_docs`
+    realms padded to `n_pad` node / `k_pad` candidate lanes
+    (engine/move_kernels.py). The wire is the two packed lane blocks;
+    the host alternative is the numpy fixpoint."""
+    from .pack import MOVE_CAND_FIELDS, MOVE_NODE_FIELDS
+
+    wire_bytes = n_docs * (len(MOVE_NODE_FIELDS) * n_pad
+                           + len(MOVE_CAND_FIELDS) * k_pad) * 4
+    dev = _device_cost(wire_bytes, passes)
+    host = (_LINK["move_fixed_s"]
+            + n_docs * (n_pad + k_pad) * _LINK["move_lane_s"])
+    return Plan("device" if dev < host else "host", dev, host)
+
+
+def resolve_moves_adaptive(packed: dict, passes: int = 1):
+    """Route a batched move resolution through the cheaper backend.
+    Returns (plan, result dict) — numpy arrays on the host path, device
+    arrays on the device path (same schema)."""
+    from ..utils import metrics
+    from .move_kernels import resolve_moves, resolve_moves_host
+
+    nodes = packed["nodes"]
+    plan = plan_moves(nodes.shape[0], nodes.shape[2],
+                      packed["cands"].shape[2], passes)
+    metrics.bump("engine_move_resolves", backend=plan.backend)
+    if plan.backend == "host":
+        return plan, resolve_moves_host(packed)
+    return plan, resolve_moves(packed["nodes"], packed["cands"])
 
 
 def _causal_order(changes):

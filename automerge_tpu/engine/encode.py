@@ -31,12 +31,38 @@ from ..core.change import Change
 from ..core.ids import ROOT_ID, HEAD, make_elem_id
 
 # Action codes
-A_MAKE_MAP, A_MAKE_LIST, A_MAKE_TEXT, A_INS, A_SET, A_DEL, A_LINK = range(7)
+(A_MAKE_MAP, A_MAKE_LIST, A_MAKE_TEXT, A_INS, A_SET, A_DEL, A_LINK,
+ A_MOVE) = range(8)
 _ACTION_CODE = {"makeMap": A_MAKE_MAP, "makeList": A_MAKE_LIST,
                 "makeText": A_MAKE_TEXT, "ins": A_INS, "set": A_SET,
-                "del": A_DEL, "link": A_LINK}
+                "del": A_DEL, "link": A_LINK, "move": A_MOVE}
 
 ASSIGN_CODES = (A_SET, A_DEL, A_LINK)
+
+# A move op is assign-LIKE for the kernels (action >= A_SET joins the
+# survivor analysis) but its field is the moved target's LOCATION field
+# on the root object: location ops of one target dominate each other
+# there regardless of destination — the same move-chain join the
+# snapshot compactor runs — and the destination rides in the value
+# identity, so the state hash still distinguishes every (dest, elem).
+LOC_KEY_PREFIX = "\x00loc\x00"
+
+
+def move_loc_key(op) -> str:
+    """Location-field key for one move op. Map children are globally
+    unique (uuid object ids) and their chains span destinations, so the
+    key is the child id alone; list element ids are LIST-scoped (two
+    lists can both hold an "A:2"), so their key includes the list — and a
+    list move always targets its own list. `elem` (present iff list
+    move) is the wire-level discriminator."""
+    if op.elem is not None and op.elem >= 0:
+        return f"{LOC_KEY_PREFIX}{op.obj}\x00{op.value}"
+    return LOC_KEY_PREFIX + op.value
+
+
+def move_value_key(op) -> tuple:
+    return ("__move__", op.obj, op.key or "",
+            op.elem if op.elem is not None else -1)
 
 
 _hash_memo: dict[str, int] = {}
@@ -65,6 +91,12 @@ def value_bytes(value) -> bytes:
     Python repr()."""
     if isinstance(value, tuple) and len(value) == 2 and value[0] == "__link__":
         return b"l:" + value[1].encode("utf-8", "surrogatepass")
+    if isinstance(value, tuple) and len(value) == 4 and value[0] == "__move__":
+        # ("__move__", dest_obj, dest_key, elem) — the C++ encoder's kind-8
+        # ValueKey produces identical bytes (deltaenc.cpp value_bytes)
+        return (b"m:" + value[1].encode("utf-8", "surrogatepass") + b"\x00"
+                + value[2].encode("utf-8", "surrogatepass")
+                + b":%d" % value[3])
     if value is None:
         return b"n"
     if value is True:
@@ -106,6 +138,8 @@ class ValueTable:
     def _key(value: Any):
         if isinstance(value, tuple) and len(value) == 2 and value[0] == "__link__":
             return ("link", value[1])
+        if isinstance(value, tuple) and len(value) == 4 and value[0] == "__move__":
+            return ("move", value[1], value[2], value[3])
         return (type(value).__name__, repr(value))
 
     def add(self, value: Any) -> None:
@@ -233,6 +267,8 @@ def encode_doc(changes: list[Change], actors: list[str] | None = None) -> DocEnc
                 values.add(op.value)
             elif code == A_LINK:
                 values.add(("__link__", op.value))
+            elif code == A_MOVE:
+                values.add(move_value_key(op))
     values.finalize()
 
     # -- canonical tables: content-keyed, delivery-order-independent -------
@@ -258,8 +294,11 @@ def encode_doc(changes: list[Change], actors: list[str] | None = None) -> DocEnc
     field_keys: set[tuple[int, str]] = set()
     for c in ready:
         for op in c.ops:
-            if _ACTION_CODE[op.action] in ASSIGN_CODES:
+            code = _ACTION_CODE[op.action]
+            if code in ASSIGN_CODES:
                 field_keys.add((obj_index[op.obj], op.key))
+            elif code == A_MOVE:
+                field_keys.add((0, move_loc_key(op)))
     fields = sorted(field_keys)
     fid_index = {fk: i for i, fk in enumerate(fields)}
     obj_uuids = [oid for oid, _ in objects]
@@ -305,6 +344,12 @@ def encode_doc(changes: list[Change], actors: list[str] | None = None) -> DocEnc
                 elif code == A_LINK:
                     value_arr[i], value_hash_arr[i] = values.id_and_hash(
                         ("__link__", op.value))
+            elif code == A_MOVE:
+                f = fid_index[(0, move_loc_key(op))]
+                fid[i] = f
+                fid_hash_arr[i] = fid_hashes[f]
+                value_arr[i], value_hash_arr[i] = values.id_and_hash(
+                    move_value_key(op))
             i += 1
 
     # -- list tables --------------------------------------------------------

@@ -32,9 +32,30 @@ in which this module is the right default today.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
+
+# Hardware guard (ROADMAP "carried small debts": prime suspect for the r5
+# config-1 `run_engine` hardware error, never hardware-run). This module
+# must not silently reach a TPU/GPU process: importing it on a non-CPU
+# default backend refuses loudly until a hardware-validation session runs
+# the sacrificial probe deliberately (AMTPU_ALLOW_DENSE_ON_DEVICE=1).
+# The check runs at import, before any jit can capture dense code.
+if os.environ.get("AMTPU_ALLOW_DENSE_ON_DEVICE") != "1":
+    try:
+        _backend = jax.default_backend()
+    except Exception:  # pragma: no cover — broken jax install
+        _backend = "cpu"
+    if _backend != "cpu":
+        raise NotImplementedError(
+            "engine.experimental_dense is quarantined on accelerator "
+            "backends: it has never executed on hardware and is the prime "
+            "suspect for the r5 TPU-window fault (ROADMAP item 5 / "
+            "TUNNEL_DIAGNOSIS.md). A hardware-validation session may opt "
+            "in explicitly with AMTPU_ALLOW_DENSE_ON_DEVICE=1.")
+
 import jax.numpy as jnp
 
 from .encode import A_DEL, A_SET

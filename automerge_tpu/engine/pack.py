@@ -615,3 +615,68 @@ def recombine_hashes(virtual_hashes: np.ndarray, owner: np.ndarray,
     np.add.at(out, owner, np.asarray(virtual_hashes)[:len(owner)]
               .astype(np.uint32))
     return out
+
+
+# ---------------------------------------------------------------------------
+# Move-resolution tables (ISSUE 15): the batched cycle-resolution working
+# set. One realm (the map-object forest or one list's spot-doubled
+# insertion forest, core/moves.MoveProblem) packs into two lane blocks:
+#
+#   nodes [D, 4, N_pad]:  mask, base_parent_slot (-1 root),
+#                         cand_off, cand_cnt
+#   cands [D, 3, K_pad]:  parent_slot, prio_hi, prio_lo
+#
+# Candidates are sorted per node by priority DESCENDING and concatenated
+# in node-slot order (cand_off/cand_cnt index the runs), so "the node's
+# current winner" is one gather at cand_off + ptr. prio_lo is the rank of
+# the candidate's (actor, moved-id) pair in the realm's sorted pair
+# table — integer comparisons reproduce the host tuple order exactly,
+# and priorities stay UNIQUE (the cycle-drop rule requires it).
+
+MOVE_NODE_FIELDS = ("node_mask", "base_parent", "cand_off", "cand_cnt")
+MOVE_CAND_FIELDS = ("cand_parent", "cand_hi", "cand_lo")
+MOVE_PRIO_PAD = np.iinfo(np.int32).max
+
+
+def pack_moves(problems: list) -> dict:
+    """Pack MoveProblems into the move-resolution lane layout. Returns
+    {"nodes": [D, 4, N_pad] int32, "cands": [D, 3, K_pad] int32}."""
+    from ..utils import metrics
+
+    d = len(problems)
+    n_max = max((len(p.nodes) for p in problems), default=0)
+    k_max = max((sum(len(c) for c in p.cands) for p in problems), default=0)
+    n_pad = pad_to_lanes(max(n_max, 1))
+    k_pad = pad_to_lanes(max(k_max, 1))
+    nodes = np.zeros((d, len(MOVE_NODE_FIELDS), n_pad), np.int32)
+    nodes[:, 1, :] = -1
+    cands = np.zeros((d, len(MOVE_CAND_FIELDS), k_pad), np.int32)
+    cands[:, 0, :] = -1
+    cands[:, 1:, :] = MOVE_PRIO_PAD
+    for i, p in enumerate(problems):
+        n = len(p.nodes)
+        if n == 0:
+            continue
+        # RANK-compress both priority components: raw lamport sums can
+        # exceed int32 on deep histories and a local unstamped preview
+        # op carries a 2^62 "wins over everything" sentinel — ranks are
+        # order-isomorphic, bounded by the candidate count, and can
+        # never collide with the MOVE_PRIO_PAD sentinel
+        hi_vals = sorted({c[0] for cl in p.cands for c in cl})
+        hi_rank = {v: r for r, v in enumerate(hi_vals)}
+        lo_pairs = sorted({c[1] for cl in p.cands for c in cl})
+        lo_rank = {pair: r for r, pair in enumerate(lo_pairs)}
+        nodes[i, 0, :n] = 1
+        nodes[i, 1, :n] = np.asarray(p.base[:n], np.int32) if p.base else -1
+        off = 0
+        for s in range(n):
+            cl = p.cands[s]
+            nodes[i, 2, s] = off
+            nodes[i, 3, s] = len(cl)
+            for (hi, lo, parent, _op) in cl:
+                cands[i, 0, off] = -1 if parent is None else parent
+                cands[i, 1, off] = hi_rank[hi]
+                cands[i, 2, off] = lo_rank[lo]
+                off += 1
+    metrics.bump("engine_move_tables_packed", d)
+    return {"nodes": nodes, "cands": cands}

@@ -37,8 +37,9 @@ from ..core.change import Change
 from ..core.ids import ROOT_ID, HEAD, make_elem_id
 from ..utils import flightrec, metrics, perfscope
 from .encode import (A_DEL, A_INS, A_LINK, A_MAKE_LIST, A_MAKE_MAP,
-                     A_MAKE_TEXT, A_SET, ASSIGN_CODES, _ACTION_CODE,
-                     ValueTable, content_hash, value_hash_of, _pad_to)
+                     A_MAKE_TEXT, A_MOVE, A_SET, ASSIGN_CODES, _ACTION_CODE,
+                     ValueTable, content_hash, move_loc_key, move_value_key,
+                     value_hash_of, _pad_to)
 from .kernels import apply_doc
 
 OP_COLS = ("op_mask", "action", "fid", "actor", "seq", "change_idx", "value",
@@ -514,6 +515,17 @@ class ResidentDocSet:
                     fid = -1
                     value = -1
                     fh = vh = 0
+                elif code == A_MOVE:
+                    # location field on the root object (encode.py's
+                    # move_loc_key contract; deltaenc.cpp mirrors it)
+                    if op.obj not in t.obj_index:
+                        raise KeyError(f"move into unknown object {op.obj}")
+                    lockey = move_loc_key(op)
+                    fid = t.fid_of(0, lockey)
+                    fh = content_hash(f"{ROOT_ID}\x00{lockey}")
+                    vkey = move_value_key(op)
+                    value = t.value_id(vkey)
+                    vh = value_hash_of(vkey)
                 else:  # assign
                     oi = t.obj_index[op.obj]
                     fid = t.fid_of(oi, op.key)

@@ -47,20 +47,40 @@ class ChangeContext:
 
     def __init__(self, doc_state):
         self.actor_id: str = doc_state.actor_id
-        self.builder: Builder = doc_state.opset.thaw()
+        self._builder: Builder = doc_state.opset.thaw()
+        self._preview_pending: list[Op] = []
         self.local: list[Op] = []
         self.undo_local: list[Op] = []
         self.mutable = True
 
+    @property
+    def builder(self) -> Builder:
+        """The preview working state, synced lazily: pending local ops
+        apply only when something READS builder state (read-your-writes
+        preserved — every read path goes through this property). A
+        write-only change block (the interactive keystroke shape: one
+        insert/delete, no reads after) never pays the preview apply at
+        all — the commit path re-applies the collected ops to the real
+        opset anyway, so the eager preview was pure duplicated work
+        (measured 44% of config 7's per-keystroke cost, r16)."""
+        pend = self._preview_pending
+        if pend:
+            self._preview_pending = []
+            for op in pend:
+                O.apply_op(self._builder, op)
+        return self._builder
+
     # -- op generation ------------------------------------------------------
 
     def _make_op(self, op: Op, undo_ops=None) -> None:
-        """Record a local op and apply it eagerly (automerge.js:11-18,
-        op_set.js:287-292)."""
+        """Record a local op; the preview state applies it lazily at the
+        next read (automerge.js:11-18, op_set.js:287-292 apply eagerly —
+        but their frontends are diff-driven and must; ours previews from
+        state)."""
         self.local.append(op)
         if undo_ops:
             self.undo_local.extend(u.stripped() for u in undo_ops)
-        O.apply_op(self.builder, op.stamped(self.actor_id, None))
+        self._preview_pending.append(op.stamped(self.actor_id, None))
 
     def insert_after(self, list_id: str, elem_id: str) -> str:
         """Insert a fresh element after `elem_id`; returns the new element's ID
@@ -92,7 +112,8 @@ class ChangeContext:
             elem_id = HEAD
             for item in value:
                 elem_id = self.insert_after(object_id, elem_id)
-                self.set_field(object_id, elem_id, item, top_level=False)
+                self.set_field(object_id, elem_id, item, top_level=False,
+                               fresh=True)
         elif isinstance(value, dict):
             self._make_op(Op("makeMap", object_id))
             for key, item in value.items():
@@ -122,8 +143,71 @@ class ChangeContext:
                         stack.append(op.value)
         return False
 
-    def set_field(self, object_id: str, key: str, value, top_level: bool) -> None:
-        """Assign a map field or list element (automerge.js:60-92)."""
+    def move_key(self, dest_id: str, dest_key: str, child_id: str) -> None:
+        """Reparent child object `child_id` under map `dest_id` at
+        `dest_key` as ONE move op (the r16 move plane, core/moves.py) —
+        the old location empties and the subtree is never duplicated.
+        Local cycles are refused eagerly like link cycles; CONCURRENT
+        cycles resolve deterministically at merge time."""
+        if not isinstance(dest_key, str) or not dest_key \
+                or dest_key.startswith("_"):
+            raise TypeError(f"Invalid destination key {dest_key!r}")
+        dest = self.builder.by_object.get(dest_id)
+        if dest is None:
+            raise ValueError("Destination object does not exist")
+        if dest.is_sequence:
+            raise TypeError("move_key destination must be a map")
+        if self.builder.by_object.get(child_id) is None:
+            raise ValueError("Moved object does not exist")
+        if child_id == dest_id or self._reaches(child_id, dest_id):
+            raise ValueError("Cannot move an object into its own subtree")
+        # undo = move back to the current effective location
+        child = self.builder.by_object[child_id]
+        prior = child.loc
+        if prior is None:
+            for ref in child.inbound:
+                if ref.action == "link":
+                    prior = ref
+                    break
+        undo = ([Op("move", prior.obj, key=prior.key, value=child_id)]
+                if prior is not None else None)
+        self._make_op(Op("move", dest_id, key=dest_key, value=child_id),
+                      undo)
+
+    def move_list_index(self, list_id: str, from_index: int,
+                        to_index: int) -> None:
+        """Reorder one list element: `to_index` is its position AFTER the
+        move (standard list.move semantics). One op — identity preserved,
+        concurrent edits on the element still apply."""
+        obj = self.builder.by_object.get(list_id)
+        if obj is None or not obj.is_sequence:
+            raise ValueError("List object does not exist")
+        keys = obj.elem_ids.keys
+        n = len(keys)
+        if not 0 <= from_index < n:
+            raise IndexError(f"move from index {from_index} out of range")
+        if not 0 <= to_index < n:
+            raise IndexError(f"move to index {to_index} out of range")
+        if from_index == to_index:
+            return
+        eid = keys[from_index]
+        rest = [k for i, k in enumerate(keys) if i != from_index]
+        anchor = HEAD if to_index == 0 else rest[to_index - 1]
+        elem = obj.max_elem + 1
+        # undo = move back after its current visible predecessor; the
+        # dest elem counter is allocated at UNDO time (api.undo) so a
+        # stale stamp can never tie with later inserts
+        back = HEAD if from_index == 0 else keys[from_index - 1]
+        self._make_op(Op("move", list_id, key=anchor, value=eid, elem=elem),
+                      [Op("move", list_id, key=back, value=eid)])
+
+    def set_field(self, object_id: str, key: str, value, top_level: bool,
+                  fresh: bool = False) -> None:
+        """Assign a map field or list element (automerge.js:60-92).
+        `fresh=True` marks a key this change block just created (a
+        freshly inserted element): its field ops are () by construction,
+        so the prior-state read — which would force the lazy preview to
+        apply — is skipped."""
         if not isinstance(key, str):
             raise TypeError(f"The key of a map entry must be a string, "
                             f"but {key!r} is a {type(key).__name__}")
@@ -132,7 +216,8 @@ class ChangeContext:
         if key.startswith("_"):
             raise TypeError(f"Map entries starting with underscore are not allowed: {key}")
 
-        field_ops = O.get_field_ops(self.builder, object_id, key)
+        field_ops = () if fresh else O.get_field_ops(self.builder,
+                                                     object_id, key)
         undo = None
         if top_level:
             undo = [Op("del", object_id, key=key)] if not field_ops else list(field_ops)
@@ -157,23 +242,38 @@ class ChangeContext:
             raise TypeError(f"Unsupported type of value: {type(value).__name__}")
 
     def splice(self, object_id: str, start: int, deletions: int, insertions) -> None:
-        """Delete/insert list elements at a position (automerge.js:94-115)."""
+        """Delete/insert list elements at a position (automerge.js:94-115).
+        Builder re-reads happen only when a LATER step needs the updated
+        preview (multi-deletion runs, inserts after deletes) — the
+        single-keystroke shapes (one del, or one insert) stay fully lazy."""
         obj = self.builder.by_object[object_id]
-        for _ in range(deletions):
+        anchor = None
+        if deletions and insertions:
+            # resolve the insertion anchor BEFORE deleting: the element
+            # left of `start` survives the deletions, so its id is the
+            # same anchor the post-delete index would yield
+            anchor = HEAD if start == 0 else obj.elem_ids.key_of(start - 1)
+        for i in range(deletions):
+            if i:
+                obj = self.builder.by_object[object_id]
             elem_id = obj.elem_ids.key_of(start)
             if elem_id is not None:
-                field_ops = O.get_field_ops(self.builder, object_id, elem_id)
+                field_ops = obj.fields.get(elem_id, ())
                 self._make_op(Op("del", object_id, key=elem_id), list(field_ops))
-                obj = self.builder.by_object[object_id]
 
-        elem_ids = self.builder.by_object[object_id].elem_ids
-        prev = HEAD if start == 0 else elem_ids.key_of(start - 1)
-        if prev is None and len(insertions) > 0:
+        if not insertions:
+            return
+        if anchor is None:
+            elem_ids = self.builder.by_object[object_id].elem_ids
+            anchor = HEAD if start == 0 else elem_ids.key_of(start - 1)
+        prev = anchor
+        if prev is None:
             raise IndexError(f"Cannot insert at index {start}, "
                              f"which is past the end of the list")
         for item in insertions:
             prev = self.insert_after(object_id, prev)
-            self.set_field(object_id, prev, item, top_level=True)
+            self.set_field(object_id, prev, item, top_level=True,
+                           fresh=True)
 
     def set_list_index(self, list_id: str, index, value) -> None:
         """Assign a list index; one-past-the-end assignment inserts

@@ -21,7 +21,8 @@ from .text import Text
 
 def _op_value(state, op, cache: dict) -> Any:
     """Application-visible value of a field op (op_set.js:399-405)."""
-    if op.action == "link":
+    if op.action == "link" or op.action == "move":
+        # a map move's value is the relocated child's object id
         return _materialize(state, op.value, cache)
     return op.value
 
@@ -114,20 +115,31 @@ def update_cache(opset: OpSet, diffs: list[dict], old_cache: dict) -> dict:
         if object_id != ROOT_ID:  # the root is rebuilt once, by build_root
             cache[object_id] = _build(opset, object_id, cache)
 
-    # Ancestor propagation: wave by wave toward the root.
+    # Ancestor propagation: wave by wave toward the root. A move-managed
+    # object walks its RESOLVED location only (obj.loc) — the raw inbound
+    # set also holds LOSING move candidates, which may cross-reference
+    # (A holds a losing move of B and vice versa) even though the
+    # resolved forest never cycles. The wave cap is a safety net against
+    # genuinely cyclic link graphs (a pre-move-era wart this walk
+    # previously looped on).
     wave = set(affected)
-    while wave:
+    for _depth in range(len(opset.by_object) + 1):
+        if not wave:
+            break
         parents: set[str] = set()
         for object_id in wave:
             obj = opset.by_object.get(object_id)
             if obj is None:
                 continue
-            for ref in obj.inbound:
-                parents.add(ref.obj)
+            if obj.loc is not None:
+                parents.add(obj.loc.obj)
+            else:
+                for ref in obj.inbound:
+                    parents.add(ref.obj)
         for parent_id in parents:
             if parent_id != ROOT_ID:
                 cache[parent_id] = _build(opset, parent_id, cache)
-        wave = parents
+        wave = parents - {ROOT_ID}
 
     return cache
 

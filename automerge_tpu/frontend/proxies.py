@@ -147,6 +147,19 @@ class MapProxy:
         for key, value in values.items():
             self[key] = value
 
+    def move(self, key: str, dest: "MapProxy", dest_key: str | None = None
+             ) -> None:
+        """Reparent the child object at `key` under `dest` as ONE move op
+        (the r16 move plane): `board.move("card3", done_column)` instead
+        of a delete + re-insert of the whole subtree."""
+        ops = O.get_field_ops(self._ctx.builder, self._oid, key)
+        if not ops or ops[0].action not in ("link", "move"):
+            raise TypeError(f"{key!r} does not hold a child object")
+        if not isinstance(dest, MapProxy):
+            raise TypeError("move destination must be a map proxy")
+        self._ctx.move_key(dest._oid, dest_key if dest_key is not None
+                           else key, ops[0].value)
+
 
 class ListProxy(ArrayReadOps):
     __slots__ = ("_ctx", "_oid")
@@ -277,6 +290,14 @@ class ListProxy(ArrayReadOps):
         value = value.to_plain() if hasattr(value, "to_plain") else value
         self._ctx.splice(self._oid, index, 1, [])
         return value
+
+    def move(self, from_index: int, to_index: int) -> "ListProxy":
+        """Reorder one element as ONE move op (`to_index` is its position
+        after the move — standard list.move semantics). Identity is
+        preserved: concurrent edits on the element still apply."""
+        self._ctx.move_list_index(self._oid, parse_list_index(from_index),
+                                  parse_list_index(to_index))
+        return self
 
     def shift(self) -> Any:
         if len(self) == 0:

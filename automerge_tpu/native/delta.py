@@ -99,6 +99,9 @@ def _decode_value(tag: int, bits: int, s: str):
         return int(s)
     if tag == 7:
         return ("__link__", s)
+    if tag == 8:
+        obj, _sep, key = s.partition("\x00")
+        return ("__move__", obj, key, int(bits))
     raise ValueError(f"bad native value tag {tag}")
 
 

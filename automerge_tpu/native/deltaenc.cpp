@@ -80,7 +80,7 @@ enum VTag : int8_t {
 // action codes (engine/encode.py == storage._ACTIONS order)
 enum Action : int8_t {
   A_MAKE_MAP = 0, A_MAKE_LIST = 1, A_MAKE_TEXT = 2, A_INS = 3,
-  A_SET = 4, A_DEL = 5, A_LINK = 6,
+  A_SET = 4, A_DEL = 5, A_LINK = 6, A_MOVE = 7,
 };
 
 const char kRootId[] = "00000000-0000-0000-0000-000000000000";
@@ -88,7 +88,9 @@ const char kRootId[] = "00000000-0000-0000-0000-000000000000";
 // ---------------------------------------------------------------------------
 // value identity — the arrival-ordered interning key. Mirrors
 // ValueTable._key distinctions: 1 / 1.0 / True / "1" / link("1") all differ.
-// kind: 0 null, 1 false, 2 true, 3 int, 4 double, 5 str, 6 bigint, 7 link.
+// kind: 0 null, 1 false, 2 true, 3 int, 4 double, 5 str, 6 bigint, 7 link,
+// 8 move destination (str = dest_obj + '\0' + dest_key, bits = dest elem
+// or -1 — mirrors engine/encode.py's ("__move__", obj, key, elem) key).
 
 struct ValueKey {
   int8_t kind;
@@ -127,6 +129,10 @@ std::string value_bytes(const ValueKey& k) {
     case 5: return "s:" + k.str;
     case 6: return "i:" + k.str;  // bigint: decimal token, same "i:" prefix
     case 7: return "l:" + k.str;
+    case 8: {
+      snprintf(buf, sizeof buf, ":%lld", static_cast<long long>(k.bits));
+      return "m:" + k.str + buf;  // encode.py value_bytes __move__ branch
+    }
     default: return "";
   }
 }
@@ -408,6 +414,55 @@ int32_t amtpu_denc_apply_frames(
           e->ins_rows.push_back(parent_slot);
           e->ins_rows.push_back(efid);
         }
+      } else if (code == A_MOVE) {
+        // a move's field is the moved target's LOCATION field on the
+        // root object ("\0loc\0" + moved id): location ops of one target
+        // dominate each other there regardless of destination, exactly
+        // matching the host compactor's move-chain join and keeping the
+        // state hash replica-independent (engine/resident.py twin)
+        std::string obj = table_get(v.objects_blob, v.objects_off,
+                                    v.op_obj[op]);
+        auto oit = t.obj_index.find(obj);
+        if (oit == t.obj_index.end()) {
+          snprintf(errbuf, errlen, "move into unknown object");
+          return -1;
+        }
+        std::string moved = v.op_vstr[op] >= 0
+            ? table_get(v.strings_blob, v.strings_off, v.op_vstr[op])
+            : std::string();
+        std::string lockey("\0loc\0", 5);
+        if (v.op_elem[op] >= 0) {
+          // list move: element ids are list-scoped, key by (list, elem id)
+          // — encode.py move_loc_key twin
+          lockey += obj;
+          lockey.push_back('\0');
+        }
+        lockey += moved;
+        fid = e->fid_of(doc, t, 0, lockey);
+        std::string fk = kRootId;
+        fk.push_back('\0');
+        fk += lockey;
+        fh = content_hash(fk);
+        ValueKey vk;
+        vk.kind = 8;
+        vk.bits = v.op_elem[op];
+        std::string key = v.op_key[op] >= 0
+            ? table_get(v.keys_blob, v.keys_off, v.op_key[op])
+            : std::string();
+        vk.str = obj;
+        vk.str.push_back('\0');
+        vk.str += key;
+        auto vit = t.value_ids.find(vk);
+        if (vit != t.value_ids.end()) {
+          value = vit->second;
+        } else {
+          value = static_cast<int32_t>(t.value_ids.size());
+          t.value_ids.emplace(vk, value);
+          e->new_val_doc.push_back(doc);
+          e->new_vals.push_back({vk.kind, vk.bits, vk.str});
+        }
+        std::string vb = value_bytes(vk);
+        vh = static_cast<int32_t>(crc32(vb.data(), vb.size()) & 0x7FFFFFFFu);
       } else {  // set / del / link
         std::string obj = table_get(v.objects_blob, v.objects_off,
                                     v.op_obj[op]);

@@ -61,7 +61,7 @@ class WireColumns:
             action = _ACTIONS[self.op_action[j]]
             key = self.keys[self.op_key[j]] if self.op_key[j] >= 0 else None
             elem = int(self.op_elem[j]) if self.op_elem[j] >= 0 else None
-            if action in ("set", "link"):
+            if action in ("set", "link", "move"):
                 value = self.op_value(j)
             else:
                 value = None
@@ -110,7 +110,7 @@ class WireColumns:
             for j in range(o_off[i], o_off[i + 1]):
                 action = _ACTIONS[o_act[j]]
                 value = None
-                if action in ("set", "link"):
+                if action in ("set", "link", "move"):
                     value = _decode_vtag(o_vtag[j], o_vint[j], o_vdbl[j],
                                          o_vstr[j], strings)
                 op = new_op(Op)
@@ -171,7 +171,7 @@ class _Interner:
 
 def _encode_value(op, strings: _Interner):
     """(vtag, vint, vdbl, vstr) for one op, matching WireColumns.op_value."""
-    if op.action not in ("set", "link"):
+    if op.action not in ("set", "link", "move"):
         return V_NONE, 0, 0.0, -1
     v = op.value
     if v is None:

@@ -45,7 +45,7 @@ enum VTag : int8_t { V_NONE = 0, V_NULL = 1, V_FALSE = 2, V_TRUE = 3,
 
 enum Action : int8_t { A_MAKE_MAP = 0, A_MAKE_LIST = 1, A_MAKE_TEXT = 2,
                        A_INS = 3, A_SET = 4, A_DEL = 5, A_LINK = 6,
-                       A_BAD = -1 };
+                       A_MOVE = 7, A_BAD = -1 };
 
 struct Parsed {
   // per change
@@ -254,6 +254,7 @@ Action action_code(const std::string& s) {
   if (s == "ins") return A_INS;
   if (s == "del") return A_DEL;
   if (s == "link") return A_LINK;
+  if (s == "move") return A_MOVE;
   if (s == "makeMap") return A_MAKE_MAP;
   if (s == "makeList") return A_MAKE_LIST;
   if (s == "makeText") return A_MAKE_TEXT;

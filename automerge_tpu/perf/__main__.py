@@ -186,6 +186,11 @@ def main(argv=None) -> int:
         # fault, assert the supervised link self-heals
         from . import remediate
         return remediate.smoke_main(rest)
+    if cmd == "move":
+        # the move-plane smoke (verify.sh stage 2): concurrent cycle
+        # storm on two services, convergence + kernel parity asserted
+        from . import moveplane
+        return moveplane.smoke_main(rest)
     if cmd == "bootstrap":
         # the replica-bootstrap smoke (verify.sh stage 2): deep-history
         # doc -> snapshot -> cold-boot a fresh replica, byte-equal hashes
@@ -200,7 +205,7 @@ def main(argv=None) -> int:
         resident.main(rest)
         return 0
     print(f"unknown command {cmd!r}; expected one of "
-          "report, check, contention, doctor, explain, top, remediate, "
+          "report, check, contention, doctor, explain, top, remediate, move, "
           "bootstrap, roofline, resident",
           file=sys.stderr)
     return 2

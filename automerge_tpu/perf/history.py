@@ -130,6 +130,17 @@ SUB_REDUNDANCY_MAX = 1.2
 #: converge SLO (mirrors perf/slo.py DEFAULT_CONVERGE_P99_S).
 SUB_CONVERGE_P99_BUDGET_S = 2.0
 
+#: move-plane gates (r16, config 16). All ABSOLUTE — properties of the
+#: move plane, not of the host:
+#: move-as-atom must beat the delete+reinsert emulation by at least
+#: this factor on BOTH wire-frame and archived-log bytes for subtree
+#: reparents (the capability headline: one op vs re-shipping the tree),
+MOVE_BYTES_RATIO_MIN = 5.0
+#: and one batched winner+cycle resolution must beat the per-op host
+#: walk on a >= 1K mutually-concurrent move storm (recorded ~x196; the
+#: floor only guards the direction).
+MOVE_RESOLVE_SPEEDUP_MIN = 1.0
+
 #: remediation gates (r13, config 14). All ABSOLUTE — properties of the
 #: remediation code, not of the host:
 #: every injected fault class must return the live fleet to SLO-green
@@ -303,7 +314,24 @@ def _norm_configs(raw) -> dict:
                                        "bootstrap_docs_per_fleet",
                                        "bootstrap_changes_per_doc",
                                        "bootstrap_fallbacks",
-                                       "compaction_ratio")
+                                       "compaction_ratio",
+                                       # the move plane (r16, config
+                                       # 16): atom-vs-emulation byte
+                                       # ratios, batched-vs-per-op
+                                       # resolution, in-run parity +
+                                       # convergence verdicts
+                                       "move_wire_ratio_x",
+                                       "move_archive_ratio_x",
+                                       "move_atom_ops_per_s",
+                                       "reorder_ops_per_s",
+                                       "move_resolve_speedup_x",
+                                       "move_batch_resolve_s",
+                                       "move_perop_resolve_s",
+                                       "move_storm_moves",
+                                       "move_cycles_dropped",
+                                       "move_kernel_parity",
+                                       "move_pallas_parity",
+                                       "move_storm_converged")
                      if isinstance(v.get(k), (int, float, str))}
         elif isinstance(v, (int, float)):
             entry = {"speedup": v}
@@ -869,6 +897,46 @@ def check(path: str | None = None, record: dict | None = None,
                         if par else "DIVERGED"))
         if not par:
             rc = 1
+
+    # move-plane gates (r16, config 16): atom-vs-emulation byte ratios,
+    # batched-resolution direction, and the in-run parity/convergence
+    # verdicts. All absolute; skip-clean without config 16; each field
+    # judged independently.
+    def _mv(r: dict):
+        return ((r.get("configs") or {}).get("16") or {})
+
+    for field, label in (("move_wire_ratio_x", "wire-frame"),
+                         ("move_archive_ratio_x", "archived-log")):
+        val = _mv(current).get(field)
+        if isinstance(val, (int, float)):
+            verdict = ("OK" if val >= MOVE_BYTES_RATIO_MIN
+                       else "MOVE NOT BEATING DELETE+REINSERT")
+            lines.append(
+                f"  move-as-atom {label} bytes (config 16): x{val:.2f} "
+                f"of the delete+reinsert emulation (floor >= "
+                f"x{MOVE_BYTES_RATIO_MIN}) -> {verdict}")
+            if val < MOVE_BYTES_RATIO_MIN:
+                rc = 1
+    spd = _mv(current).get("move_resolve_speedup_x")
+    if isinstance(spd, (int, float)):
+        verdict = ("OK" if spd > MOVE_RESOLVE_SPEEDUP_MIN
+                   else "BATCHED RESOLUTION NOT FASTER")
+        moves_n = _mv(current).get("move_storm_moves")
+        lines.append(
+            f"  batched move resolution (config 16): x{spd:.1f} vs the "
+            f"per-op host walk on {moves_n} concurrent moves -> {verdict}")
+        if spd <= MOVE_RESOLVE_SPEEDUP_MIN:
+            rc = 1
+    for field, label in (("move_kernel_parity", "host/XLA parity"),
+                         ("move_pallas_parity", "pallas parity"),
+                         ("move_storm_converged",
+                          "two-replica storm convergence")):
+        val = _mv(current).get(field)
+        if val is not None:
+            lines.append(f"  move {label}: "
+                         + ("OK (asserted in-run)" if val else "FAILED"))
+            if not val:
+                rc = 1
 
     # keystroke-flatness gate (r8, config 7): latency at 4x document
     # length over 1x must stay under the ceiling. A RATIO is

@@ -32,7 +32,8 @@ import numpy as np
 from .core.change import Change, Op
 
 FORMAT_VERSION = 1
-_ACTIONS = ("makeMap", "makeList", "makeText", "ins", "set", "del", "link")
+_ACTIONS = ("makeMap", "makeList", "makeText", "ins", "set", "del", "link",
+            "move")
 _ACTION_IDX = {a: i for i, a in enumerate(_ACTIONS)}
 
 
@@ -85,7 +86,9 @@ def save_binary(doc) -> bytes:
         deps_off[i + 1] = len(deps_actor_l)
         for op in c.ops:
             key_id = keys.add(op.key) if op.key is not None else -1
-            if op.action == "set":
+            if op.action in ("set", "move"):
+                # a move's value is the moved element/object id string;
+                # the scalar table round-trips it exactly
                 vkind, vid = 1, value_id(op.value)
             elif op.action == "link":
                 vkind, vid = 2, objects.add(op.value)

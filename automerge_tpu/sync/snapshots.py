@@ -71,6 +71,28 @@ from .logarchive import timed_fsync
 
 SNAP_MAGIC = b"AMSS1"
 _ASSIGNS = ("set", "del", "link")
+# a MAP move joins the domination pass on its target's LOCATION field —
+# the same key the engine encoders use (engine/encode.move_loc_key): a
+# reparent chain compacts exactly like an assign chain, only the
+# surviving position is live state. A map location op dominated by a
+# later location op of the same child can never win resolution nor serve
+# as its cycle fallback (core/moves.py prunes it at admission for the
+# same reason), so dropping it here is exact for any suffix. LIST moves
+# (elem >= 0) are deliberately EXEMPT: a dominated list move is still
+# "this element has moved" awareness evidence for the ghost/placed
+# anchoring split (opset.anchored_at_placed), so dropping it would shift
+# siblings admitted in between — they compact as ordinary kept ops.
+_LOC_FIELD = "\x00loc\x00"
+
+
+def _joins_move_chain(op) -> bool:
+    return op.action == "move" and (op.elem is None or op.elem < 0)
+
+
+def _field_of(op):
+    if op.action == "move":
+        return (_LOC_FIELD, op.value)
+    return (op.obj, op.key)
 
 #: loaded-image cache entries kept (LRU by doc)
 CACHE_SNAPS = int(os.environ.get("AMTPU_SNAPSHOT_CACHE_DOCS", "8"))
@@ -139,15 +161,16 @@ def compact_prefix(changes) -> dict:
         if c.seq > clock.get(c.actor, 0):
             clock[c.actor] = c.seq
         ops_in += len(c.ops)
-        has_assign = any(op.action in _ASSIGNS for op in c.ops)
+        has_assign = any(op.action in _ASSIGNS or _joins_move_chain(op)
+                         for op in c.ops)
         if has_assign:
             own = dict(row)
             # a change's own assigns dominate earlier same-field assigns
             # of the same actor (clock row holds own actor at seq-1)
             for op in c.ops:
-                if op.action not in _ASSIGNS:
+                if op.action not in _ASSIGNS and not _joins_move_chain(op):
                     continue
-                f = fld.setdefault((op.obj, op.key), {})
+                f = fld.setdefault(_field_of(op), {})
                 for a, s in own.items():
                     if s > f.get(a, 0):
                         f[a] = s
@@ -159,8 +182,8 @@ def compact_prefix(changes) -> dict:
     for c, row in zip(changes, rows):
         ops = []
         for op in c.ops:
-            if op.action in _ASSIGNS:
-                if fld[(op.obj, op.key)].get(c.actor, 0) >= c.seq:
+            if op.action in _ASSIGNS or _joins_move_chain(op):
+                if fld[_field_of(op)].get(c.actor, 0) >= c.seq:
                     continue            # dominated: dead forever
             ops.append(op)
         if ops:

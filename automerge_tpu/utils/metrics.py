@@ -108,6 +108,29 @@ COUNTERS: dict[str, str] = {
     "engine_span_merges":
         "batched span-table merge dispatches (engine/span_kernels.py) "
         "{backend=host|device}",
+    # move plane (core/moves.py + engine/move_kernels.py): one-op
+    # reparenting with deterministic cycle resolution (ISSUE 15)
+    "core_moves_applied":
+        "move ops admitted through the per-op interpretive path",
+    "sync_move_batches_merged":
+        "change batches admitted through the batched move plane (one "
+        "winner+cycle resolution per touched realm)",
+    "sync_move_ops_sequential":
+        "move ops from changes covering the local frontier (classified "
+        "at admission via admit_change_header)",
+    "sync_move_ops_concurrent":
+        "move ops concurrent with the local frontier (the only moves "
+        "that can conflict or cycle)",
+    "sync_move_cycles_dropped":
+        "move candidates dropped by deterministic cycle resolution "
+        "(losers become no-ops; the element falls back to its next "
+        "candidate or base position)",
+    "engine_move_tables_packed":
+        "move-resolution realms packed into the node/candidate lane "
+        "layout (engine/pack.pack_moves)",
+    "engine_move_resolves":
+        "batched move cycle-resolution dispatches "
+        "(engine/move_kernels.py) {backend=host|device}",
     # engine — docs-major device engine + adaptive router
     "engine_docs_reconciled": "documents reconciled by the batched kernel",
     "engine_ops_reconciled": "ops reconciled by the batched kernel",

@@ -80,18 +80,26 @@ _LEAF = 0       # (_LEAF, hash, key, value)
 _COLL = 1       # (_COLL, hash, ((k, v), ...))
 _BITMAP = 2     # (_BITMAP, bitmap, (child, ...))
 
+_BM_ABSENT = object()   # _bm_set's "key was not present" old-value marker
+
 
 def _bm_set(node, shift, h, key, value):
+    """Returns (new_node, added, old_value) — `old_value` is _BM_ABSENT
+    when the key was not present, so writers that need the displaced
+    value (CowDict.__setitem__'s existed-in-base check) get it from the
+    SAME walk instead of paying a second full lookup (the r16 keystroke
+    profile's worst single overhead: every shared-mode write walked the
+    overlay twice)."""
     if node is None:
-        return (_LEAF, h, key, value), 1
+        return (_LEAF, h, key, value), 1, _BM_ABSENT
     kind = node[0]
     if kind == _LEAF:
         nh, nk = node[1], node[2]
         if nh == h and nk == key:
-            return (_LEAF, h, key, value), 0
+            return (_LEAF, h, key, value), 0, node[3]
         if nh == h:
-            return (_COLL, h, ((nk, node[3]), (key, value))), 1
-        merged, _ = _bm_set(None, shift, nh, nk, node[3])
+            return (_COLL, h, ((nk, node[3]), (key, value))), 1, _BM_ABSENT
+        merged, _, _ = _bm_set(None, shift, nh, nk, node[3])
         wrapped = (_BITMAP, 1 << ((nh >> shift) & _MASK), (merged,))
         return _bm_set(wrapped, shift, h, key, value)
     if kind == _COLL:
@@ -100,20 +108,21 @@ def _bm_set(node, shift, h, key, value):
             for i, (k, _v) in enumerate(entries):
                 if k == key:
                     return (_COLL, h, entries[:i] + ((key, value),)
-                            + entries[i + 1:]), 0
-            return (_COLL, h, entries + ((key, value),)), 1
+                            + entries[i + 1:]), 0, entries[i][1]
+            return (_COLL, h, entries + ((key, value),)), 1, _BM_ABSENT
         wrapped = (_BITMAP, 1 << ((node[1] >> shift) & _MASK), (node,))
         return _bm_set(wrapped, shift, h, key, value)
     bitmap, children = node[1], node[2]
     bit = 1 << ((h >> shift) & _MASK)
     idx = bin(bitmap & (bit - 1)).count("1")
     if bitmap & bit:
-        child, added = _bm_set(children[idx], shift + _SHIFT, h, key, value)
+        child, added, old = _bm_set(children[idx], shift + _SHIFT, h, key,
+                                    value)
         return (_BITMAP, bitmap,
-                children[:idx] + (child,) + children[idx + 1:]), added
+                children[:idx] + (child,) + children[idx + 1:]), added, old
     leaf = (_LEAF, h, key, value)
     return (_BITMAP, bitmap | bit,
-            children[:idx] + (leaf,) + children[idx:]), 1
+            children[:idx] + (leaf,) + children[idx:]), 1, _BM_ABSENT
 
 
 def _bm_get(node, shift, h, key, default):
@@ -191,9 +200,17 @@ class PMap:
         return _bm_get(self._root, 0, hash(key) & 0xFFFFFFFF, key, default)
 
     def set(self, key, value) -> "PMap":
-        root, added = _bm_set(self._root, 0, hash(key) & 0xFFFFFFFF,
-                              key, value)
+        root, added, _old = _bm_set(self._root, 0, hash(key) & 0xFFFFFFFF,
+                                    key, value)
         return PMap(root, self._size + added)
+
+    def set_lookup(self, key, value):
+        """(new map, displaced value or the _BM_ABSENT marker) from ONE
+        walk — the write-path twin of get() for callers that need the
+        old value anyway (CowDict.__setitem__)."""
+        root, added, old = _bm_set(self._root, 0, hash(key) & 0xFFFFFFFF,
+                                   key, value)
+        return PMap(root, self._size + added), old
 
     def delete(self, key) -> "PMap":
         root, removed = _bm_delete(self._root, 0, hash(key) & 0xFFFFFFFF, key)
@@ -294,8 +311,12 @@ class CowDict:
     # -- reads -------------------------------------------------------------
 
     def get(self, key, default=None):
-        if len(self._over):
-            v = self._over.get(key, _ABSENT)
+        over = self._over
+        if over._size:
+            # inlined PMap.get (this is the engine's hottest read: ~20
+            # calls per keystroke through the apply path)
+            v = _bm_get(over._root, 0, hash(key) & 0xFFFFFFFF, key,
+                        _ABSENT)
             if v is not _ABSENT:
                 return default if v is _DELETED else v
         v = self._base.get(key, _ABSENT)
@@ -348,8 +369,14 @@ class CowDict:
 
     def __setitem__(self, key, value) -> None:
         if self._shared:
-            existed = self.get(key, _DELETED) is not _DELETED
-            self._over = self._over.set(key, value)
+            # one overlay walk, not two: set_lookup returns the value it
+            # displaced, and only a key absent from the overlay needs the
+            # (plain-dict-cheap) base membership probe
+            self._over, old = self._over.set_lookup(key, value)
+            if old is _BM_ABSENT:
+                existed = key in self._base
+            else:
+                existed = old is not _DELETED
             if not existed:
                 self._size += 1
             self._maybe_rebase()

@@ -27,7 +27,12 @@
 #          cold-boots from snapshot + archived tail with byte-equal
 #          converged hashes (docs/INTERNALS.md "The storage tier";
 #          the fleet-scale gate is bench config 15 under `make
-#          perfcheck`). Never fails verify — a CPU-only
+#          perfcheck`), and the move smoke: a concurrent cycle storm
+#          (A->B + B->A reparents, conflicting list reorders) on two
+#          services in both delivery orders, convergence + cycle-drop +
+#          host/XLA/pallas resolution parity asserted (docs/INTERNALS.md
+#          "The move plane"; the fleet-scale gate is bench config 16
+#          under `make perfcheck`). Never fails verify — a CPU-only
 #          image or a missing/empty history must not block the build
 #          (TUNNEL_DIAGNOSIS.md: TPU absence is an environment fact, not
 #          a code defect). Run `make perfcheck` for the enforcing gate.
@@ -55,6 +60,8 @@ JAX_PLATFORMS=cpu python -m automerge_tpu.perf remediate --smoke \
     || echo "chaos-recovery smoke FAILED (informational here; enforced by tests + perf check)"
 JAX_PLATFORMS=cpu python -m automerge_tpu.perf bootstrap --smoke \
     || echo "bootstrap smoke FAILED (informational here; enforced by tests + perf check)"
+JAX_PLATFORMS=cpu python -m automerge_tpu.perf move --smoke \
+    || echo "move smoke FAILED (informational here; enforced by tests + perf check)"
 
 echo "== stage 3/3: tier-1 suite (ROADMAP.md) =="
 set -o pipefail

@@ -1,0 +1,756 @@
+"""The move plane (ISSUE 15 / r16): move-as-atom reparenting for maps and
+lists with deterministic batched cycle resolution.
+
+Pins, in rough dependency order:
+- map/list move semantics through the interpretive core (winner rule,
+  cycle survivor determinism, ghost anchoring, fallback chains);
+- delivery-order independence (the whole point of a CRDT op class) via
+  seeded storms and a hypothesis driver over random two-writer programs;
+- walk/host/XLA/pallas resolution parity on packed realms;
+- the batched admission plane == the per-op path, including the
+  kernel-routed configuration;
+- wire/storage ride-along (JSON, binary, columnar frames, the native
+  C++ parse) and engine-hash convergence across services;
+- a two-service fleet storm with a green ConvergenceAuditor;
+- the frontend proxy API;
+- the experimental_dense non-CPU import guard (ROADMAP carried debt).
+"""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+try:
+    from hypothesis import given, settings
+    from hypothesis import strategies as st
+    HAVE_HYPOTHESIS = True
+except ImportError:   # the seeded fallback driver below still runs
+    HAVE_HYPOTHESIS = False
+
+import automerge_tpu.api as am
+from automerge_tpu.core.change import Change, Op
+from automerge_tpu.core.ids import ROOT_ID
+from automerge_tpu.core.moves import (MoveProblem, _resolve_walk,
+                                      try_apply_move_batch)
+from automerge_tpu.core.opset import OpSet
+from automerge_tpu.frontend.materialize import materialize_root
+
+
+def mat(opset):
+    return materialize_root("t", opset)
+
+
+def mat_j(opset):
+    return json.dumps(mat(opset), sort_keys=True, default=str)
+
+
+def base_doc():
+    """root { k0..k4: maps f0..f4, L: [v1..v5] } in one change by A."""
+    ops = []
+    for i in range(5):
+        ops.append(Op("makeMap", f"f{i}"))
+        ops.append(Op("link", ROOT_ID, key=f"k{i}", value=f"f{i}"))
+    ops.append(Op("makeList", "L"))
+    ops.append(Op("link", ROOT_ID, key="L", value="L"))
+    prev = "_head"
+    for e in range(1, 6):
+        ops.append(Op("ins", "L", key=prev, elem=e))
+        ops.append(Op("set", "L", key=f"A:{e}", value=f"v{e}"))
+        prev = f"A:{e}"
+    chs = [Change("A", 1, {}, ops)]
+    opset, _ = OpSet.init().add_changes(chs)
+    return opset, chs
+
+
+# ---------------------------------------------------------------------------
+# map realm semantics
+
+
+def test_map_move_reparents_and_empties_old_location():
+    opset, _ = base_doc()
+    out, diffs = opset.add_changes([Change("A", 2, {}, [
+        Op("move", "f1", key="sub", value="f0")])])
+    m = mat(out)
+    assert "k0" not in m
+    assert m["k1"]["sub"] == {}
+    # both the removal and the placement emitted standard map records
+    acts = {(d["action"], d["obj"]) for d in diffs}
+    assert ("remove", ROOT_ID) in acts
+    assert ("set", "f1") in acts
+
+
+def test_map_move_chain_latest_wins():
+    opset, _ = base_doc()
+    out, _ = opset.add_changes([
+        Change("A", 2, {}, [Op("move", "f1", key="s", value="f0")]),
+        Change("A", 3, {}, [Op("move", "f2", key="s", value="f0")])])
+    m = mat(out)
+    assert "k0" not in m and "s" not in m["k1"]
+    assert m["k2"]["s"] == {}
+
+
+def test_concurrent_map_moves_same_child_highest_actor_wins_both_orders():
+    opset, _ = base_doc()
+    mb = Change("B", 1, {"A": 1}, [Op("move", "f1", key="b", value="f0")])
+    mc = Change("C", 1, {"A": 1}, [Op("move", "f2", key="c", value="f0")])
+    r1, _ = opset.add_changes([mb])
+    r1, _ = r1.add_changes([mc])
+    r2, _ = opset.add_changes([mc])
+    r2, _ = r2.add_changes([mb])
+    assert mat_j(r1) == mat_j(r2)
+    m = mat(r1)
+    assert m["k2"]["c"] == {}          # C > B
+    assert "b" not in m["k1"] and "k0" not in m
+
+
+def test_concurrent_cycle_survivor_deterministic_both_orders():
+    opset, _ = base_doc()
+    # B: f0 under f1; C: f1 under f0 — a 2-cycle. C wins (higher actor),
+    # B's move drops, f0 falls back to its base link at root.k0.
+    mb = Change("B", 1, {"A": 1}, [Op("move", "f1", key="in", value="f0")])
+    mc = Change("C", 1, {"A": 1}, [Op("move", "f0", key="in", value="f1")])
+    r1, _ = opset.add_changes([mb])
+    r1, _ = r1.add_changes([mc])
+    r2, _ = opset.add_changes([mc])
+    r2, _ = r2.add_changes([mb])
+    assert mat_j(r1) == mat_j(r2)
+    m = mat(r1)
+    assert m["k0"] == {"in": {}}       # f1 lives under f0
+    assert "k1" not in m               # f1 moved away from root
+    # never duplicated, never orphaned: f1 appears exactly once
+    assert mat_j(r1).count('"in"') == 1
+
+
+def test_three_cycle_resolves_deterministically():
+    opset, _ = base_doc()
+    moves = [Change("B", 1, {"A": 1},
+                    [Op("move", "f1", key="m", value="f0")]),
+             Change("C", 1, {"A": 1},
+                    [Op("move", "f2", key="m", value="f1")]),
+             Change("D", 1, {"A": 1},
+                    [Op("move", "f0", key="m", value="f2")])]
+    mats = set()
+    for order in ([0, 1, 2], [2, 1, 0], [1, 0, 2]):
+        cur = opset
+        for i in order:
+            cur, _ = cur.add_changes([moves[i]])
+        mats.add(mat_j(cur))
+    assert len(mats) == 1
+    # the minimum-priority edge (actor B) dropped; its child is back at
+    # the base link
+    assert "k0" in mat(cur)
+
+
+def test_move_wins_over_concurrent_dest_overwrite_rules():
+    opset, _ = base_doc()
+    # a causally-LATER set at the destination key kills the placement
+    out, _ = opset.add_changes([
+        Change("A", 2, {}, [Op("move", "f1", key="s", value="f0")]),
+        Change("A", 3, {}, [Op("set", "f1", key="s", value=7)])])
+    m = mat(out)
+    assert m["k1"]["s"] == 7
+    assert "k0" not in m               # the child stays gone (rm -rf)
+
+
+def test_moved_child_keeps_concurrent_interior_edits():
+    # the delete+reinsert emulation LOSES concurrent interior edits; the
+    # move op must keep them — the capability headline
+    opset, _ = base_doc()
+    mv = Change("B", 1, {"A": 1}, [Op("move", "f1", key="s", value="f0")])
+    ed = Change("C", 1, {"A": 1}, [Op("set", "f0", key="x", value=42)])
+    r1, _ = opset.add_changes([mv])
+    r1, _ = r1.add_changes([ed])
+    r2, _ = opset.add_changes([ed])
+    r2, _ = r2.add_changes([mv])
+    assert mat_j(r1) == mat_j(r2)
+    assert mat(r1)["k1"]["s"] == {"x": 42}
+
+
+# ---------------------------------------------------------------------------
+# list realm semantics
+
+
+def test_list_move_to_head_and_ghost_anchoring():
+    opset, _ = base_doc()
+    out, _ = opset.add_changes([Change("A", 2, {}, [
+        Op("move", "L", key="_head", value="A:3", elem=9)])])
+    assert list(mat(out)["L"]) == ["v3", "v1", "v2", "v4", "v5"]
+    # ghost semantics: elements anchored after the moved one do NOT ride
+    # along (the anchor relation is ordering, not containment)
+    out2, _ = opset.add_changes([Change("A", 2, {}, [
+        Op("move", "L", key="A:3", value="A:2", elem=9)])])
+    assert list(mat(out2)["L"]) == ["v1", "v3", "v2", "v4", "v5"]
+
+
+def test_concurrent_list_moves_same_element_converge_both_orders():
+    opset, _ = base_doc()
+    mb = Change("B", 1, {"A": 1},
+                [Op("move", "L", key="_head", value="A:2", elem=9)])
+    mc = Change("C", 1, {"A": 1},
+                [Op("move", "L", key="A:5", value="A:2", elem=9)])
+    r1, _ = opset.add_changes([mb])
+    r1, _ = r1.add_changes([mc])
+    r2, _ = opset.add_changes([mc])
+    r2, _ = r2.add_changes([mb])
+    l1, l2 = list(mat(r1)["L"]), list(mat(r2)["L"])
+    assert l1 == l2 == ["v1", "v3", "v4", "v5", "v2"]   # C wins
+
+
+def test_placement_aware_follower_rides_the_next_move():
+    opset, _ = base_doc()
+    # move v2 after v5, then type w right after it, then move v2 to the
+    # head: the placement-aware insert follows
+    cur, _ = opset.add_changes([Change("A", 2, {}, [
+        Op("move", "L", key="A:5", value="A:2", elem=9)])])
+    cur, _ = cur.add_changes([Change("A", 3, {}, [
+        Op("ins", "L", key="A:2", elem=10),
+        Op("set", "L", key="A:10", value="w")])])
+    assert list(mat(cur)["L"]) == ["v1", "v3", "v4", "v5", "v2", "w"]
+    cur, _ = cur.add_changes([Change("A", 4, {}, [
+        Op("move", "L", key="_head", value="A:2", elem=11)])])
+    assert list(mat(cur)["L"]) == ["v2", "w", "v1", "v3", "v4", "v5"]
+
+
+def test_move_of_tombstone_and_concurrent_resurrection():
+    opset, _ = base_doc()
+    # B deletes v2 while C moves it to the head: the concurrent move
+    # repositions the tombstone; a concurrent set resurrects it THERE
+    dl = Change("B", 1, {"A": 1}, [Op("del", "L", key="A:2")])
+    mv = Change("C", 1, {"A": 1},
+                [Op("move", "L", key="_head", value="A:2", elem=9)])
+    rs = Change("D", 1, {"A": 1}, [Op("set", "L", key="A:2", value="R")])
+    orders = [(dl, mv, rs), (rs, mv, dl), (mv, dl, rs)]
+    mats = set()
+    for chs in orders:
+        cur = opset
+        for c in chs:
+            cur, _ = cur.add_changes([c])
+        mats.add(mat_j(cur))
+    assert len(mats) == 1
+    assert list(mat(cur)["L"]) == ["R", "v1", "v3", "v4", "v5"]
+
+
+def test_move_validation_errors():
+    opset, _ = base_doc()
+    with pytest.raises(ValueError):
+        opset.add_changes([Change("A", 2, {}, [
+            Op("move", "L", key="_head", value="A:99", elem=9)])])
+    with pytest.raises(ValueError):
+        opset.add_changes([Change("A", 2, {}, [
+            Op("move", "L", key="A:77", value="A:2", elem=9)])])
+    with pytest.raises(ValueError):
+        opset.add_changes([Change("A", 2, {}, [
+            Op("move", "f0", key="x", value="nosuch")])])
+    with pytest.raises(ValueError):
+        opset.add_changes([Change("A", 2, {}, [
+            Op("move", "f0", key="x", value=ROOT_ID)])])
+
+
+# ---------------------------------------------------------------------------
+# delivery-order independence: seeded + hypothesis drivers
+
+
+def _storm(rng, actor, k, elem_base):
+    chs = []
+    deps = {"A": 1}
+    ec = elem_base
+    seq = 0
+    for _ in range(k):
+        if rng.random() < 0.5:
+            child = f"f{rng.randrange(5)}"
+            dest = f"f{rng.randrange(5)}"
+            if dest == child:
+                dest = ROOT_ID
+            op = Op("move", dest, key=f"m{rng.randrange(3)}", value=child)
+        else:
+            e = rng.randrange(1, 6)
+            a = rng.randrange(0, 6)
+            anchor = "_head" if a == 0 else f"A:{a}"
+            if anchor == f"A:{e}":
+                anchor = "_head"
+            ec += 1
+            op = Op("move", "L", key=anchor, value=f"A:{e}", elem=ec)
+        seq += 1
+        chs.append(Change(actor, seq, dict(deps), [op]))
+        deps = {actor: seq}
+    return chs
+
+
+@pytest.mark.parametrize("seed", [0, 1, 7, 23])
+def test_two_writer_storm_three_delivery_orders_converge(seed):
+    rng = random.Random(seed)
+    opset, _ = base_doc()
+    sb = _storm(rng, "B", rng.randrange(2, 6), 100)
+    sc = _storm(rng, "C", rng.randrange(2, 6), 200)
+    r1 = opset
+    for c in sb + sc:
+        r1, _ = r1.add_changes([c])
+    r2 = opset
+    for c in sc + sb:
+        r2, _ = r2.add_changes([c])
+    mix, ib, ic = [], 0, 0
+    while ib < len(sb) or ic < len(sc):
+        if ib < len(sb) and (ic >= len(sc) or rng.random() < 0.5):
+            mix.append(sb[ib]); ib += 1
+        else:
+            mix.append(sc[ic]); ic += 1
+    r3 = opset
+    for c in mix:
+        r3, _ = r3.add_changes([c])
+    assert mat_j(r1) == mat_j(r2) == mat_j(r3)
+
+
+def _check_storm_converges(seed):
+    rng = random.Random(seed)
+    opset, _ = base_doc()
+    sb = _storm(rng, "B", rng.randrange(1, 5), 100)
+    sc = _storm(rng, "C", rng.randrange(1, 5), 200)
+    r1 = opset
+    for c in sb + sc:
+        r1, _ = r1.add_changes([c])
+    r2 = opset
+    for c in sc + sb:
+        r2, _ = r2.add_changes([c])
+    assert mat_j(r1) == mat_j(r2)
+
+
+if HAVE_HYPOTHESIS:
+    @settings(max_examples=25, deadline=None)
+    @given(st.integers(0, 10**9))
+    def test_hypothesis_move_storms_converge(seed):
+        _check_storm_converges(seed)
+else:
+    @pytest.mark.parametrize("seed", list(range(1000, 1025)))
+    def test_hypothesis_move_storms_converge(seed):
+        _check_storm_converges(seed)
+
+
+# ---------------------------------------------------------------------------
+# kernel parity: walk == host numpy == XLA == pallas(interpret)
+
+
+def _rand_problem(rng, n_nodes, n_moves):
+    p = MoveProblem()
+    for i in range(n_nodes):
+        p.slot(f"n{i}")
+    for s in range(n_nodes):
+        p.base[s] = rng.randrange(-1, s) if s else -1
+    prios = rng.sample(range(10_000), n_moves)
+    by_node = {}
+    for m in range(n_moves):
+        s = rng.randrange(n_nodes)
+        by_node.setdefault(s, []).append(
+            (prios[m] // 40, ("a%02d" % (prios[m] % 40), "v"),
+             rng.randrange(-1, n_nodes)))
+    for s, cl in by_node.items():
+        cl.sort(key=lambda t: (t[0], t[1]), reverse=True)
+        p.cands[s] = [(hi, lo, tgt, None) for (hi, lo, tgt) in cl]
+        p.moved.append(s)
+    return p
+
+
+def test_kernel_triple_parity_on_random_realms():
+    from automerge_tpu.engine.move_kernels import (
+        pack_moves, resolve_moves, resolve_moves_host,
+        resolve_moves_pallas)
+
+    rng = random.Random(4242)
+    probs = [_rand_problem(rng, rng.randrange(2, 48), rng.randrange(0, 40))
+             for _ in range(20)]
+    packed = pack_moves(probs)
+    host = resolve_moves_host(packed)
+    xla = {k: np.asarray(v) for k, v in
+           resolve_moves(packed["nodes"], packed["cands"]).items()}
+    pls = resolve_moves_pallas(packed, interpret=True)
+    for i, p in enumerate(probs):
+        ptr_walk, dropped_walk = _resolve_walk(p)
+        n = len(p.nodes)
+        assert list(host["ptr"][i][:n]) == ptr_walk
+        assert int(host["dropped"][i]) == dropped_walk
+    for k in ("ptr", "parent", "dropped"):
+        assert (host[k] == xla[k]).all(), k
+        assert (host[k] == pls[k]).all(), k
+    assert (host["hash"] == xla["hash"]).all()
+    assert (host["hash"] == pls["hash"]).all()
+
+
+def test_kernel_drops_min_priority_edge_per_cycle():
+    from automerge_tpu.engine.move_kernels import (pack_moves,
+                                                   resolve_moves_host)
+    p = MoveProblem()
+    for i in range(4):
+        p.slot(i)
+        p.base[i] = -1
+    # 0 -> 1 (prio 9) and 1 -> 0 (prio 5): cycle; the prio-5 edge drops
+    p.cands[0] = [(9, ("b", "x"), 1, None)]
+    p.cands[1] = [(5, ("a", "y"), 0, None)]
+    p.moved = [0, 1]
+    out = resolve_moves_host(pack_moves([p]))
+    assert list(out["ptr"][0][:4]) == [0, 1, 0, 0]
+    assert int(out["dropped"][0]) == 1
+    assert out["resolved"][0][:4].all()
+    ptr_walk, dropped = _resolve_walk(p)
+    assert ptr_walk == [0, 1, 0, 0] and dropped == 1
+
+
+def test_pallas_node_cap_is_loud():
+    from automerge_tpu.engine.move_kernels import (PALLAS_MAX_NODES,
+                                                   move_round_pallas)
+    n = PALLAS_MAX_NODES * 2
+    nodes = np.zeros((1, 4, n), np.int32)
+    cands = np.zeros((1, 3, 128), np.int32)
+    ptr = np.zeros((1, n), np.int32)
+    with pytest.raises(ValueError, match="caps at"):
+        move_round_pallas(nodes, cands, ptr, interpret=True)
+
+
+# ---------------------------------------------------------------------------
+# the batched admission plane
+
+
+def _concurrent_storm(n_objs, k, writers=5):
+    ops = []
+    for i in range(n_objs):
+        ops.append(Op("makeMap", f"o{i:04d}"))
+        ops.append(Op("link", ROOT_ID, key=f"o{i:04d}", value=f"o{i:04d}"))
+    base, _ = OpSet.init().add_changes([Change("A", 1, {}, ops)])
+    rng = random.Random(99)
+    movers = rng.sample(range(n_objs), k)
+    chs = []
+    wseq = {}
+    for j, m in enumerate(movers):
+        dst = rng.randrange(n_objs)
+        while dst == m:
+            dst = rng.randrange(n_objs)
+        w = f"w{j % writers}"
+        s = wseq.get(w, 0) + 1
+        wseq[w] = s
+        deps = {"A": 1}
+        if s > 1:
+            deps[w] = s - 1
+        chs.append(Change(w, s, deps,
+                          [Op("move", f"o{dst:04d}", key=f"s{j}",
+                              value=f"o{m:04d}")]))
+    return base, chs
+
+
+def test_move_batch_plane_equals_per_op_path(monkeypatch):
+    base, chs = _concurrent_storm(48, 40)
+    perop = base
+    for c in chs:
+        perop, _ = perop.add_changes([c])
+    batched, diffs = base.add_changes(chs, move_batch=True)
+    assert diffs and diffs[0]["action"] == "batch"
+    assert mat_j(perop) == mat_j(batched)
+    # kernel-routed configuration resolves identically
+    monkeypatch.setenv("AMTPU_MOVE_KERNEL_MIN", "4")
+    routed, _ = base.add_changes(chs, move_batch=True)
+    assert mat_j(routed) == mat_j(perop)
+
+
+def test_move_batch_classifies_sequential_vs_concurrent():
+    from automerge_tpu.utils import metrics
+    base, chs = _concurrent_storm(40, 34)
+    snap0 = metrics.snapshot()
+    out, _ = base.add_changes(chs, move_batch=True)
+    snap = metrics.snapshot()
+    conc = (snap.get("sync_move_ops_concurrent", 0)
+            - snap0.get("sync_move_ops_concurrent", 0))
+    seqn = (snap.get("sync_move_ops_sequential", 0)
+            - snap0.get("sync_move_ops_sequential", 0))
+    # first change of each writer set covers the frontier only for the
+    # very first one; everything else is cross-writer concurrent
+    assert seqn >= 1
+    assert conc + seqn == 34
+
+
+def test_move_batch_falls_back_on_mixed_ops():
+    base, chs = _concurrent_storm(40, 34)
+    mixed = chs + [Change("z", 1, {"A": 1},
+                          [Op("set", "o0000", key="p", value=1)])]
+    out, diffs = base.add_changes(mixed, move_batch=True)
+    # ineligible batch fell through to the generic path: per-op records
+    assert all(d.get("action") != "batch" for d in diffs)
+    perop = base
+    for c in mixed:
+        perop, _ = perop.add_changes([c])
+    assert mat_j(out) == mat_j(perop)
+
+
+# ---------------------------------------------------------------------------
+# wire / storage / engine ride-along
+
+
+def test_wire_and_storage_roundtrips_with_moves():
+    from automerge_tpu.native.wire import (changes_to_columns,
+                                           parse_changes_json)
+    from automerge_tpu.sync.frames import bytes_to_columns, columns_to_bytes
+
+    opset, chs = base_doc()
+    mv = [Change("A", 2, {}, [Op("move", "f1", key="s", value="f0"),
+                              Op("move", "L", key="_head", value="A:3",
+                                 elem=9)])]
+    all_chs = chs + mv
+    # columnar frame roundtrip
+    cols = bytes_to_columns(columns_to_bytes(changes_to_columns(all_chs)))
+    assert cols.to_changes() == all_chs
+    # native C++ JSON parse agrees with the Python object form
+    raw = json.dumps([c.to_dict() for c in all_chs])
+    ncols = parse_changes_json(raw)
+    if ncols is not None:
+        assert ncols.to_changes() == all_chs
+    # api save/load (JSON) preserves semantics
+    r1, _ = OpSet.init().add_changes(all_chs)
+    d = am.init("x")
+    from automerge_tpu.frontend.materialize import apply_changes_to_doc
+    d = apply_changes_to_doc(d, d._doc.opset, all_chs, incremental=False)
+    r2 = am.load(am.save(d), "y")
+    assert am.inspect(r2) == mat(r1)
+
+
+def test_binary_storage_roundtrip_with_moves():
+    from automerge_tpu.storage import load_binary, save_binary
+    d = am.init("u")
+    d = am.change(d, lambda x: x.update({"a": {"n": 1}, "b": {},
+                                         "l": [1, 2, 3]}))
+    d = am.change(d, lambda x: x["a"] if False else x.move("a", x["b"]))
+    d = am.change(d, lambda x: x["l"].move(2, 0))
+    r = load_binary(save_binary(d), "v")
+    assert am.inspect(r) == am.inspect(d) == {
+        "b": {"a": {"n": 1}}, "l": [3, 1, 2]}
+
+
+def test_engine_rows_hash_convergence_with_moves():
+    from automerge_tpu.engine.resident_rows import ResidentRowsDocSet
+    opset, chs = base_doc()
+    sb = _storm(random.Random(5), "B", 4, 100)
+    sc = _storm(random.Random(6), "C", 4, 200)
+    e1 = ResidentRowsDocSet(["d"])
+    e1.apply_rounds([{"d": chs + sb + sc}])
+    e2 = ResidentRowsDocSet(["d"])
+    e2.apply_rounds([{"d": chs}, {"d": sc}, {"d": sb}])
+    assert e1.hashes()[0] == e2.hashes()[0]
+
+
+def test_bulk_build_refuses_moves_and_falls_back():
+    from automerge_tpu.core.bulkload import try_bulk_build
+    from automerge_tpu.native.wire import changes_to_columns
+    opset, chs = base_doc()
+    mv = [Change("A", 2, {}, [Op("move", "f1", key="s", value="f0")])]
+    assert try_bulk_build(changes_to_columns(chs + mv)) is None
+    # and load() still yields correct state via the interpretive fallback
+    text = json.dumps([c.to_dict() for c in chs + mv])
+    d = am.load(text, "z")
+    assert am.inspect(d)["k1"]["s"] == {}
+
+
+def test_two_service_fleet_move_storm_auditor_green():
+    from automerge_tpu.sync.audit import ConvergenceAuditor
+    from automerge_tpu.sync.connection import Connection
+    from automerge_tpu.sync.service import EngineDocSet
+
+    sa, sb = EngineDocSet(backend="rows"), EngineDocSet(backend="rows")
+    qa, qb = [], []
+    ca = Connection(sa, qa.append, wire="columnar")
+    cb = Connection(sb, qb.append, wire="columnar")
+    ca.open()
+    cb.open()
+
+    def pump():
+        for _ in range(150):
+            moved = False
+            while qa:
+                cb.receive_msg(qa.pop(0)); moved = True
+            while qb:
+                ca.receive_msg(qb.pop(0)); moved = True
+            if not moved:
+                return
+
+    opset, chs = base_doc()
+    sa.apply_changes("d", chs)
+    pump()
+    for c in _storm(random.Random(11), "B", 6, 100):
+        sa.apply_changes("d", [c])
+    for c in _storm(random.Random(12), "C", 6, 200):
+        sb.apply_changes("d", [c])
+    pump()
+    assert sa.hashes() == sb.hashes()
+    assert sa.materialize("d") == sb.materialize("d")
+    aud = ConvergenceAuditor(sa, ca, period_s=0)
+    aud.audit_once()
+    pump()
+    assert aud.rounds_clean == 1 and aud.divergences == []
+    ca.close()
+    cb.close()
+
+
+# ---------------------------------------------------------------------------
+# frontend API
+
+
+def test_proxy_move_map_and_list():
+    d = am.init("u1")
+    d = am.change(d, lambda x: x.update(
+        {"tree": {"a": {"f": 1}, "b": {}}, "l": ["a", "b", "c", "d"]}))
+    d = am.change(d, lambda x: x["tree"].move("a", x["tree"]["b"]))
+    d = am.change(d, lambda x: x["l"].move(3, 1))
+    assert am.inspect(d) == {"tree": {"b": {"a": {"f": 1}}},
+                             "l": ["a", "d", "b", "c"]}
+
+
+def test_proxy_move_refuses_local_cycle_and_bad_args():
+    d = am.init("u1")
+    d = am.change(d, lambda x: x.update({"a": {"b": {}}}))
+    with pytest.raises(ValueError, match="own subtree"):
+        am.change(d, lambda x: x["a"].move("b", x["a"]["b"]))
+    with pytest.raises(TypeError):
+        am.change(d, lambda x: x["a"].move("b", "not-a-proxy"))
+    d2 = am.change(d, lambda x: x.__setitem__("l", [1, 2]))
+    with pytest.raises(IndexError):
+        am.change(d2, lambda x: x["l"].move(0, 5))
+
+
+def test_move_merges_across_replicas_through_api():
+    d = am.init("u1")
+    d = am.change(d, lambda x: x.update({"a": {"n": 1}, "b": {}}))
+    e = am.merge(am.init("u2"), d)
+    d = am.change(d, lambda x: x.move("a", x["b"]))
+    e = am.change(e, lambda x: x["a"].__setitem__("n", 5))
+    d2 = am.merge(d, e)
+    e2 = am.merge(e, d)
+    assert am.inspect(d2) == am.inspect(e2) == {"b": {"a": {"n": 5}}}
+
+
+# ---------------------------------------------------------------------------
+# experimental_dense guard (ROADMAP carried debt)
+
+
+def test_experimental_dense_refuses_non_cpu_backend(monkeypatch):
+    import importlib
+    import sys
+
+    import jax
+
+    import automerge_tpu.engine.experimental_dense as xd
+    # importable on CPU (the product state of this image)
+    assert hasattr(xd, "reconcile_dense") or hasattr(xd, "dense_cost")
+    monkeypatch.delenv("AMTPU_ALLOW_DENSE_ON_DEVICE", raising=False)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    sys.modules.pop("automerge_tpu.engine.experimental_dense")
+    try:
+        with pytest.raises(NotImplementedError, match="quarantined"):
+            importlib.import_module(
+                "automerge_tpu.engine.experimental_dense")
+        # the opt-in env knob lets a hardware-validation session through
+        monkeypatch.setenv("AMTPU_ALLOW_DENSE_ON_DEVICE", "1")
+        mod = importlib.import_module(
+            "automerge_tpu.engine.experimental_dense")
+        assert hasattr(mod, "dense_cost")
+    finally:
+        sys.modules.pop("automerge_tpu.engine.experimental_dense", None)
+    monkeypatch.undo()
+    importlib.import_module("automerge_tpu.engine.experimental_dense")
+
+
+# ---------------------------------------------------------------------------
+# post-review regression pins (r16 review findings, all applied)
+
+
+def test_move_batch_plane_list_realm(monkeypatch):
+    """Review find #1: an all-LIST-move batch crashed the deferred index
+    rebuild (rebuild_elem_ids without state). Pin the list-realm batch
+    against the per-op path, walk- and kernel-routed."""
+    ops = [Op("makeList", "L"), Op("link", ROOT_ID, key="l", value="L")]
+    prev = "_head"
+    for e in range(1, 13):
+        ops.append(Op("ins", "L", key=prev, elem=e))
+        ops.append(Op("set", "L", key=f"A:{e}", value=f"v{e}"))
+        prev = f"A:{e}"
+    base, _ = OpSet.init().add_changes([Change("A", 1, {}, ops)])
+    rng = random.Random(17)
+    chs = []
+    wseq = {}
+    ec = 100
+    for j in range(40):
+        w = f"w{j % 4}"
+        s = wseq.get(w, 0) + 1
+        wseq[w] = s
+        deps = {"A": 1}
+        if s > 1:
+            deps[w] = s - 1
+        e = rng.randrange(1, 13)
+        a = rng.randrange(0, 13)
+        anchor = "_head" if a == 0 else f"A:{a}"
+        if anchor == f"A:{e}":
+            anchor = "_head"
+        ec += 1
+        chs.append(Change(w, s, deps,
+                          [Op("move", "L", key=anchor, value=f"A:{e}",
+                              elem=ec)]))
+    perop = base
+    for c in chs:
+        perop, _ = perop.add_changes([c])
+    batched, diffs = base.add_changes(chs, move_batch=True)
+    assert diffs and diffs[0]["action"] == "batch"
+    assert mat_j(batched) == mat_j(perop)
+    monkeypatch.setenv("AMTPU_MOVE_KERNEL_MIN", "4")
+    routed, _ = base.add_changes(chs, move_batch=True)
+    assert mat_j(routed) == mat_j(perop)
+
+
+def test_local_preview_move_survives_kernel_routing(monkeypatch):
+    """Review find #2: a local unstamped move previews with a 2^62
+    priority sentinel, which overflowed the int32 pack lanes once the
+    realm was big enough to route through the kernels. Priorities now
+    rank-compress at pack time."""
+    monkeypatch.setenv("AMTPU_MOVE_KERNEL_MIN", "1")
+    d = am.init("u")
+    d = am.change(d, lambda x: x.update(
+        {f"o{i}": {} for i in range(4)} | {"dest": {}}))
+    for i in range(3):
+        d = am.change(d, lambda x, i=i: x.move(f"o{i}", x["dest"]))
+    assert set(am.inspect(d)["dest"]) == {"o0", "o1", "o2"}
+
+
+def test_move_undo_redo_roundtrip():
+    """Review find #3: moves recorded no undo ops — can_undo lied and
+    undo silently kept the move applied."""
+    d = am.init("u")
+    d = am.change(d, lambda x: x.update({"a": {"n": 1}, "b": {},
+                                         "l": ["x", "y", "z"]}))
+    d = am.change(d, lambda x: x.move("a", x["b"]))
+    assert am.inspect(d) == {"b": {"a": {"n": 1}}, "l": ["x", "y", "z"]}
+    d = am.undo(d)
+    assert am.inspect(d) == {"a": {"n": 1}, "b": {}, "l": ["x", "y", "z"]}
+    d = am.redo(d)
+    assert am.inspect(d) == {"b": {"a": {"n": 1}}, "l": ["x", "y", "z"]}
+    d = am.change(d, lambda x: x["l"].move(2, 0))
+    assert am.inspect(d)["l"] == ["z", "x", "y"]
+    d = am.undo(d)
+    assert am.inspect(d)["l"] == ["x", "y", "z"]
+    d = am.redo(d)
+    assert am.inspect(d)["l"] == ["z", "x", "y"]
+
+
+def test_cycle_drop_metric_counts_once_not_per_admission():
+    """Review find #4: a standing resolved cycle re-counted on every
+    later unrelated admission; the metric now reports the DELTA vs the
+    realm's previous resolution."""
+    from automerge_tpu.utils import metrics
+    opset, _ = base_doc()
+    snap0 = metrics.snapshot().get("sync_move_cycles_dropped", 0)
+    cur, _ = opset.add_changes([Change("B", 1, {"A": 1}, [
+        Op("move", "f1", key="in", value="f0")])])
+    cur, _ = cur.add_changes([Change("C", 1, {"A": 1}, [
+        Op("move", "f0", key="in", value="f1")])])
+    after_cycle = metrics.snapshot().get("sync_move_cycles_dropped", 0)
+    assert after_cycle - snap0 == 1
+    for k in range(3):   # unrelated move traffic over the same realm
+        cur, _ = cur.add_changes([Change("D", k + 1,
+                                         {"A": 1} if k == 0 else {"D": k},
+                                         [Op("move", "f3", key=f"m{k}",
+                                             value="f4")])])
+    assert metrics.snapshot().get("sync_move_cycles_dropped", 0) \
+        == after_cycle

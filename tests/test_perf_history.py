@@ -656,3 +656,90 @@ def test_norm_configs_carries_remed_fields():
     assert c14["remed_dry_run_clean"] == 1
     assert c14["reconnects_total"] == 3
     assert "faults" not in c14
+
+
+def test_move_gates_ok_over_and_absent(tmp_path):
+    """Config-16 move-plane gates: atom-vs-emulation byte ratios (wire +
+    archive), batched-resolution direction, kernel/pallas parity and the
+    two-replica storm verdict — all absolute, each judged independently;
+    runs without config 16 skip cleanly."""
+    p = str(tmp_path / "h.jsonl")
+
+    def mrec(wire=6.7, arch=6.9, spd=196.0, kpar=1, ppar=1, conv=1,
+             source="test"):
+        return _rec(1000, source=source,
+                    configs={"16": {"move_wire_ratio_x": wire,
+                                    "move_archive_ratio_x": arch,
+                                    "move_resolve_speedup_x": spd,
+                                    "move_storm_moves": 1536,
+                                    "move_kernel_parity": kpar,
+                                    "move_pallas_parity": ppar,
+                                    "move_storm_converged": conv}})
+
+    _write(p, [mrec(), mrec(source="ok")])
+    rc, lines = history.check(path=p)
+    assert rc == 0, lines
+    assert any("move-as-atom wire-frame" in ln and "OK" in ln
+               for ln in lines)
+    assert any("move-as-atom archived-log" in ln and "OK" in ln
+               for ln in lines)
+    assert any("batched move resolution" in ln and "OK" in ln
+               for ln in lines)
+    assert any("move host/XLA parity: OK" in ln for ln in lines)
+    assert any("move pallas parity: OK" in ln for ln in lines)
+    assert any("move two-replica storm convergence: OK" in ln
+               for ln in lines)
+
+    _write(p, [mrec(), mrec(wire=3.0, source="fat-wire")])
+    rc, lines = history.check(path=p)
+    assert rc == 1
+    assert any("MOVE NOT BEATING DELETE+REINSERT" in ln for ln in lines)
+
+    _write(p, [mrec(), mrec(spd=0.8, source="slow-batch")])
+    rc, lines = history.check(path=p)
+    assert rc == 1
+    assert any("BATCHED RESOLUTION NOT FASTER" in ln for ln in lines)
+
+    _write(p, [mrec(), mrec(ppar=0, source="diverged")])
+    rc, lines = history.check(path=p)
+    assert rc == 1
+    assert any("move pallas parity: FAILED" in ln for ln in lines)
+
+    # a record missing only the wire ratio must not vacate the others
+    bad = mrec(conv=0, source="partial")
+    del bad["configs"]["16"]["move_wire_ratio_x"]
+    _write(p, [mrec(), bad])
+    rc, lines = history.check(path=p)
+    assert rc == 1
+    assert any("move two-replica storm convergence: FAILED" in ln
+               for ln in lines)
+
+    _write(p, [mrec(), _rec(1000, source="no-cfg16")])
+    rc, lines = history.check(path=p)
+    assert rc == 0
+    assert not any("move" in ln for ln in lines)
+
+
+def test_norm_configs_carries_move_fields():
+    rec = {"backend": "cpu", "value": 10, "configs": {
+        "16": {"move_wire_ratio_x": 6.73, "move_archive_ratio_x": 6.93,
+               "move_atom_ops_per_s": 2287.8,
+               "reorder_ops_per_s": 3594.8,
+               "move_resolve_speedup_x": 196.03,
+               "move_batch_resolve_s": 0.058,
+               "move_perop_resolve_s": 11.35,
+               "move_storm_moves": 1536,
+               "move_cycles_dropped": 2,
+               "move_kernel_parity": True,
+               "move_pallas_parity": True,
+               "move_storm_converged": True,
+               "protocol": "(string fields ride the detail sidecar)"}}}
+    out = history.record_from_bench(rec)
+    c16 = out["configs"]["16"]
+    assert c16["move_wire_ratio_x"] == 6.73
+    assert c16["move_archive_ratio_x"] == 6.93
+    assert c16["move_resolve_speedup_x"] == 196.03
+    assert c16["move_storm_moves"] == 1536
+    assert c16["move_kernel_parity"] is True
+    assert c16["move_storm_converged"] is True
+    assert "protocol" not in c16  # prose rides the detail sidecar only

@@ -547,3 +547,80 @@ def test_remediation_re_bootstrap_rides_quarantine():
     assert booted == ["p1"]
     s = metrics.snapshot()
     assert s.get("obs_remed_actions{action=re_bootstrap}") == 1
+
+
+def test_compaction_with_move_history_boots_byte_equal(tmp_path):
+    """ISSUE-15 satellite: a compaction round over a doc whose history
+    includes MOVES (map reparent chains, concurrent cycles, list
+    reorders) boots byte-equal to full replay. The domination join
+    treats a map move chain like an assign chain — only the surviving
+    position is live state — while list moves ride whole (they are
+    anchoring-awareness evidence, sync/snapshots.py)."""
+    from automerge_tpu.core.change import Change, Op
+    from automerge_tpu.core.ids import ROOT_ID
+    from automerge_tpu.core.opset import OpSet
+    from automerge_tpu.frontend.materialize import materialize_root
+
+    ops = []
+    for i in range(4):
+        ops.append(Op("makeMap", f"f{i}"))
+        ops.append(Op("link", ROOT_ID, key=f"k{i}", value=f"f{i}"))
+    ops.append(Op("makeList", "L"))
+    ops.append(Op("link", ROOT_ID, key="L", value="L"))
+    prev = "_head"
+    for e in range(1, 5):
+        ops.append(Op("ins", "L", key=prev, elem=e))
+        ops.append(Op("set", "L", key=f"A:{e}", value=f"v{e}"))
+        prev = f"A:{e}"
+    chs = [Change("A", 1, {}, ops)]
+    # a map move CHAIN (only the last survives compaction), a concurrent
+    # cross-move cycle, and list reorders incl. a same-element conflict
+    chs.append(Change("A", 2, {}, [
+        Op("move", "f1", key="s", value="f0")]))
+    chs.append(Change("A", 3, {}, [
+        Op("move", "f2", key="s", value="f0")]))
+    chs.append(Change("B", 1, {"A": 3}, [
+        Op("move", "f3", key="c", value="f2")]))
+    chs.append(Change("C", 1, {"A": 3}, [
+        Op("move", "f2", key="c", value="f3")]))
+    chs.append(Change("B", 2, {"B": 1}, [
+        Op("move", "L", key="_head", value="A:3", elem=9)]))
+    chs.append(Change("C", 2, {"C": 1}, [
+        Op("move", "L", key="A:4", value="A:3", elem=9)]))
+
+    comp = compact_prefix(chs)
+    # the dominated first hop of the map chain compacts away
+    kept_moves = [op for c in comp["kept"] for op in c.ops
+                  if op.action == "move"]
+    assert not any(op.obj == "f1" and op.value == "f0"
+                   for op in kept_moves)
+    full, _ = OpSet.init().add_changes(chs)
+    replay, _ = OpSet.init().add_changes(comp["kept"])
+    assert materialize_root("t", full) == materialize_root("t", replay)
+
+    # service-level: snapshot image + tail boot is byte-equal to a full
+    # replay boot (the r15 tier contract extended to the r16 op class)
+    srv = _mk_service(tmp_path)
+    srv.apply_changes("doc", chs[:-2])
+    assert srv.write_snapshots(["doc"])["doc"]["n_changes"]
+    srv.apply_changes("doc", chs[-2:])
+    srv.archive_logs()
+    h0 = np.uint32(srv.hashes()["doc"])
+    replay_svc = EngineDocSet(backend="rows",
+                              log_archive_dir=str(tmp_path / "srv-arch"))
+    assert replay_svc.bootstrap_from_storage(["doc"])["doc"]["mode"] \
+        == "replay"
+    booted = EngineDocSet(backend="rows",
+                          log_archive_dir=str(tmp_path / "srv-arch"),
+                          snapshot_dir=str(tmp_path / "srv-snap"))
+    assert booted.bootstrap_from_storage(["doc"])["doc"]["mode"] \
+        == "snapshot"
+    # the concurrent tail sits above the causally-stable archive floor:
+    # deliver the full change list to both replicas (idempotent dedup
+    # absorbs the overlap — exactly what anti-entropy would ship)
+    for svc in (replay_svc, booted):
+        svc.apply_changes("doc", chs)
+    assert np.uint32(replay_svc.hashes()["doc"]) == h0
+    assert np.uint32(booted.hashes()["doc"]) == h0
+    assert booted.materialize("doc") == replay_svc.materialize("doc") \
+        == srv.materialize("doc")
