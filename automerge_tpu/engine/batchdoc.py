@@ -23,7 +23,7 @@ import jax
 from ..core.change import Change
 from ..core.ids import ROOT_ID
 from .encode import (A_MAKE_LIST, A_MAKE_MAP, A_MAKE_TEXT, DocEncoding,
-                     encode_doc, stack_docs)
+                     LOC_KEY_PREFIX, encode_doc, stack_docs)
 from .kernels import apply_doc
 
 
@@ -111,6 +111,30 @@ def decode_doc(enc: DocEncoding, out: dict[str, np.ndarray]) -> Any:
     for f, (obj_idx, key) in enumerate(enc.fields):
         fields_of_obj.setdefault(obj_idx, []).append((f, key))
 
+    # Move plane: `\x00loc\x00…` fields (engine/encode.py) are routing
+    # metadata, not document keys. Decode each present map-move winner
+    # (elem < 0) into a placement map and hide every loc field from the
+    # visible tree — the single-location rule renders a moved child only
+    # at its winning destination. List-move winners (elem >= 0) carry no
+    # visible-state change here: element ranks are move-agnostic by
+    # design (engine/diffs.py module docstring), so hiding the field is
+    # the whole job.
+    loc_fields: set[int] = set()
+    moved_to: dict[str, tuple[str, str]] = {}
+    for f, (obj_idx, key) in enumerate(enc.fields):
+        if not key.startswith(LOC_KEY_PREFIX):
+            continue
+        loc_fields.add(f)
+        if not present[f]:
+            continue
+        raw = enc.value_table.values[int(win_value[f])]
+        if (isinstance(raw, tuple) and len(raw) == 4
+                and raw[0] == "__move__" and raw[3] < 0):
+            moved_to[key[len(LOC_KEY_PREFIX):]] = (raw[1], raw[2])
+    moved_into: dict[str, list[tuple[str, str]]] = {}
+    for child, (dobj, dkey) in moved_to.items():
+        moved_into.setdefault(dobj, []).append((dkey, child))
+
     list_rows = {int(obj): row for row, obj in enumerate(enc.list_obj)
                  if obj >= 0}
 
@@ -127,12 +151,18 @@ def decode_doc(enc: DocEncoding, out: dict[str, np.ndarray]) -> Any:
 
     def build(obj_idx: int):
         t = obj_type[obj_idx]
+        oid = enc.objects[obj_idx][0]
         if t == A_MAKE_MAP:
             data = {}
             conflicts = {}
             for f, key in fields_of_obj.get(obj_idx, []):
-                if not present[f]:
+                if f in loc_fields or not present[f]:
                     continue
+                raw = enc.value_table.values[int(win_value[f])]
+                if (isinstance(raw, tuple) and len(raw) == 2
+                        and raw[0] == "__link__"
+                        and moved_to.get(raw[1]) not in (None, (oid, key))):
+                    continue   # single-location: child lives at its dest
                 data[key] = decode_value(int(win_value[f]))
                 survivors = ops_by_fid.get(f, [])
                 if len(survivors) > 1:
@@ -140,6 +170,9 @@ def decode_doc(enc: DocEncoding, out: dict[str, np.ndarray]) -> Any:
                     conflicts[key] = {
                         enc.actors[a]: decode_value(v)
                         for a, v in survivors if a != win_actor}
+            for dkey, child in moved_into.get(oid, []):
+                if child in obj_id_to_idx:
+                    data[dkey] = build(enc_obj_index(child))
             return (data, conflicts) if obj_idx == 0 else data
         # list or text
         row = list_rows.get(obj_idx)

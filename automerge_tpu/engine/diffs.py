@@ -20,6 +20,31 @@ Record shapes mirror the reference's (README.md:487-520):
   {"action": "insert"|"set"|"remove", "type": "list"|"text", "obj",
                        "index", ["value", ...]}
 
+Move-plane records (r17, closing the carried diff-plane debt): a MAP
+move (one-op reparenting, core/moves.py) emits through the ordinary map
+vocabulary — a `remove` at the child's previous location and a
+`set {link: True}` at its destination — so mirrors track reparents with
+no new record type; stale link records for a move-managed child are
+suppressed (the single-location rule, opset.apply_assign). A LIST move
+emits an explicit record:
+  {"action": "move", "type": "list"|"text", "obj": list_id,
+   "elem": moved_elem_id, "anchor": dest_anchor_eid, "counter": n}
+because the engine's element ranks are move-agnostic (moves admit as
+location-field assigns, never ins deltas) — index-accurate
+repositioning rides PerOpDiffStream or materialize(), and MirrorDoc
+deliberately ignores the record (its list stays in insertion order,
+exactly what the engine's own index basis reports).
+
+Two narrow residues, disclosed: the emitted map location is the
+location field's LWW survivor winner (highest actor in the
+non-dominated antichain) — the interpretive move plane additionally
+orders concurrent candidates by lamport, so an UNEQUAL-lamport
+concurrent-move race can resolve differently (equal-context races, the
+common case, agree); and move-CYCLE fallback (core/moves.py's drop-
+minimum-edge rule) is interpretive-only — the stream reports the
+dominating location op. Both land on the batched move kernels' turf
+(engine/move_kernels.py), not this decoder's.
+
 One deliberate difference, documented here because it changes how records
 compose: the reference emits diffs per OP in application order, while a
 resident round covers a whole change batch, so these are BATCH diffs — per
@@ -46,7 +71,8 @@ from typing import Any
 
 import numpy as np
 
-from .encode import A_MAKE_LIST, A_MAKE_MAP, A_MAKE_TEXT
+from .encode import (A_MAKE_LIST, A_MAKE_MAP, A_MAKE_TEXT,
+                     LOC_KEY_PREFIX)
 
 
 def _decode_value(t, value_id: int):
@@ -91,6 +117,12 @@ def decode_round_diffs(rset, chg_fid: np.ndarray, chg_elem: np.ndarray,
     if announced is None:
         announced = rset._diff_announced = {}
 
+    # per-doc map-move child -> last location EMITTED to the consumer
+    # ((obj id, key)); the baseline the next move's `remove` targets
+    homes_all = getattr(rset, "_diff_move_homes", None)
+    if homes_all is None:
+        homes_all = rset._diff_move_homes = {}
+
     diffs: dict[str, list] = {}
     for i in changed_docs.tolist():
         t = rset.tables[i]
@@ -99,6 +131,21 @@ def decode_round_diffs(rset, chg_fid: np.ndarray, chg_elem: np.ndarray,
         seq_objs = {oi for oi, k in kind_of.items()
                     if k in (A_MAKE_LIST, A_MAKE_TEXT)}
         records: list[dict] = []
+        homes = homes_all.setdefault(i, {})
+        # current resolved location per move-managed MAP child (the
+        # winning location-field survivor): the single-location rule's
+        # lookup table — a link record for a child that now lives
+        # elsewhere must not also present it at the link's field
+        moved_to: dict[str, tuple] = {}
+        for f2, (oi2, k2) in enumerate(t.fields):
+            if not k2.startswith(LOC_KEY_PREFIX):
+                continue
+            if f2 >= present.shape[1] or not present[i, f2]:
+                continue
+            v2, _ = _decode_value(t, int(win_value[i, f2]))
+            if (isinstance(v2, tuple) and len(v2) == 4
+                    and v2[0] == "__move__" and v2[3] < 0):
+                moved_to[k2[len(LOC_KEY_PREFIX):]] = (v2[1], v2[2])
 
         # create records for objects first seen by the diff consumer
         seen = announced.setdefault(i, 1)  # the root needs no create
@@ -137,22 +184,77 @@ def decode_round_diffs(rset, chg_fid: np.ndarray, chg_elem: np.ndarray,
             obj_idx, key = t.fields[f]
             if obj_idx in seq_objs:
                 continue
-            if key.startswith("\x00loc\x00"):
-                # move-plane location fields (engine/encode.move_loc_key)
-                # are hash/domination bookkeeping, not application state:
-                # the engine per-op diff stream does not carry move
-                # semantics yet (DISCLOSED limitation — the interpretive
-                # core's diff stream does; a mirror view of a move-bearing
-                # doc should materialize from state instead)
+            if key.startswith(LOC_KEY_PREFIX):
+                # move-plane location field (engine/encode.move_loc_key):
+                # the winning survivor IS the child's resolved location —
+                # emit the location update instead of filtering it
+                if not present[i, f]:
+                    continue
+                v, _ = _decode_value(t, int(win_value[i, f]))
+                if not (isinstance(v, tuple) and len(v) == 4
+                        and v[0] == "__move__"):
+                    continue
+                _tag, dest_obj, dest_key, delem = v
+                if delem >= 0:
+                    # LIST move: explicit record (see module docstring —
+                    # engine element ranks are move-agnostic, so the
+                    # reposition cannot be expressed as index patches)
+                    body = key[len(LOC_KEY_PREFIX):]
+                    lobj, _sep, eid = body.partition("\x00")
+                    loi = t.obj_index.get(lobj)
+                    records.append({
+                        "action": "move",
+                        "type": ("text" if kind_of.get(loi) == A_MAKE_TEXT
+                                 else "list"),
+                        "obj": lobj, "elem": eid, "anchor": dest_key,
+                        "counter": int(delem)})
+                    continue
+                # MAP move: remove at the previous location, link at the
+                # destination. Concurrent-move losers are not rendered as
+                # key conflicts (the interpretive stream does not either —
+                # they are location candidates, not field survivors).
+                child = key[len(LOC_KEY_PREFIX):]
+                old = homes.get(child)
+                if old is None:
+                    # first move this consumer sees: the child leaves
+                    # wherever earlier rounds' visible link winners put it
+                    # (fields changed THIS round are suppressed below
+                    # instead, so they never reached the mirror)
+                    for f2, (oi3, k3) in enumerate(t.fields):
+                        if (oi3 in seq_objs
+                                or k3.startswith(LOC_KEY_PREFIX)
+                                or f2 >= present.shape[1]
+                                or not present[i, f2] or chg_fid[i, f2]):
+                            continue
+                        v2, link2 = _decode_value(t, int(win_value[i, f2]))
+                        if link2 and v2 == child:
+                            records.append({"action": "remove",
+                                            "type": "map",
+                                            "obj": oid_of[oi3], "key": k3})
+                elif old != (dest_obj, dest_key):
+                    records.append({"action": "remove", "type": "map",
+                                    "obj": old[0], "key": old[1]})
+                if old != (dest_obj, dest_key):
+                    records.append({"action": "set", "type": "map",
+                                    "obj": dest_obj, "key": dest_key,
+                                    "value": child, "link": True})
+                homes[child] = (dest_obj, dest_key)
                 continue
             rec: dict[str, Any] = {"type": "map", "obj": oid_of[obj_idx],
                                    "key": key}
             if present[i, f]:
                 rec["action"] = "set"
                 v, is_link = _decode_value(t, int(win_value[i, f]))
-                rec["value"] = v
                 if is_link:
+                    loc = moved_to.get(v)
+                    if loc is not None and loc != (oid_of[obj_idx], key):
+                        # single-location rule: this child's position is
+                        # move-resolved elsewhere — the base/stale link
+                        # must not ALSO present it here
+                        continue
                     rec["link"] = True
+                    homes[v] = (oid_of[obj_idx], key)
+                rec["value"] = v
                 c = conflicts_of(f)
                 if c:
                     rec["conflicts"] = c

@@ -181,7 +181,12 @@ def plan_for(doc_changes: list, passes: int = 1) -> Plan:
             host += _LINK["bulk_fixed_s"] + doc_ops * _LINK["bulk_op_s"]
         else:
             host += doc_ops * _LINK["host_op_s"]
-    return Plan("device" if dev < host else "host", dev, host)
+    plan = Plan("device" if dev < host else "host", dev, host)
+    # the padded dims this scan already derived, kept for the dispatch
+    # ledger's padding-waste account (no second scan at the call site)
+    plan.dims = {"docs": (len(doc_changes), d_pad),
+                 "ops": (max_ops, ops_pad), "ins": (max_ins, ins_pad)}
+    return plan
 
 
 def plan_spans(n_docs: int, s_pad: int, passes: int = 1) -> Plan:
@@ -205,12 +210,19 @@ def merge_spans_adaptive(doc_spans: list, passes: int = 1):
     from .pack import pack_spans
     from .span_kernels import merge_spans, merge_spans_host
 
+    from . import dispatchledger
+
     spans = pack_spans(doc_spans)
     plan = plan_spans(spans.shape[0], spans.shape[2], passes)
     metrics.bump("engine_span_merges", backend=plan.backend)
-    if plan.backend == "host":
-        return plan, merge_spans_host(spans)
-    return plan, merge_spans(spans)
+    s_max = max((len(sp) for sp in doc_spans), default=0)
+    with dispatchledger.call_scope(
+            "spans", plan=plan, docs=len(doc_spans),
+            axes={"docs": (spans.shape[0], spans.shape[0]),
+                  "spans": (s_max, spans.shape[2])}):
+        if plan.backend == "host":
+            return plan, merge_spans_host(spans)
+        return plan, merge_spans(spans)
 
 
 def plan_moves(n_docs: int, n_pad: int, k_pad: int,
@@ -236,13 +248,26 @@ def resolve_moves_adaptive(packed: dict, passes: int = 1):
     from ..utils import metrics
     from .move_kernels import resolve_moves, resolve_moves_host
 
+    import numpy as _np
+
+    from . import dispatchledger
+
     nodes = packed["nodes"]
     plan = plan_moves(nodes.shape[0], nodes.shape[2],
                       packed["cands"].shape[2], passes)
     metrics.bump("engine_move_resolves", backend=plan.backend)
-    if plan.backend == "host":
-        return plan, resolve_moves_host(packed)
-    return plan, resolve_moves(packed["nodes"], packed["cands"])
+    # logical lane occupancy from the packed masks (row 0 is the node
+    # mask, row 3 the per-node candidate counts)
+    n_log = int(_np.asarray(nodes)[:, 0, :].sum(axis=1).max(initial=0))
+    k_log = int(_np.asarray(nodes)[:, 3, :].sum(axis=1).max(initial=0))
+    with dispatchledger.call_scope(
+            "moves", plan=plan, docs=nodes.shape[0],
+            axes={"docs": (nodes.shape[0], nodes.shape[0]),
+                  "nodes": (n_log, nodes.shape[2]),
+                  "cands": (k_log, packed["cands"].shape[2])}):
+        if plan.backend == "host":
+            return plan, resolve_moves_host(packed)
+        return plan, resolve_moves(packed["nodes"], packed["cands"])
 
 
 def _causal_order(changes):
@@ -346,8 +371,13 @@ def apply_batch_adaptive(doc_changes: list, passes: int = 1):
 
     from ..utils import metrics
 
+    from . import dispatchledger
+
     plan = plan_for(doc_changes, passes)
-    with metrics.trace("engine_dispatch", backend=plan.backend):
+    with metrics.trace("engine_dispatch", backend=plan.backend), \
+            dispatchledger.call_scope("apply", plan=plan,
+                                      docs=len(doc_changes),
+                                      axes=getattr(plan, "dims", None)):
         if plan.backend == "host":
             return plan, [apply_host(chs) for chs in doc_changes]
         from .batchdoc import apply_batch

@@ -28,6 +28,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import dispatchledger
 from .encode import _pad_to, content_hash
 from .resident import ResidentDocSet
 from .pack import pad_to_lanes
@@ -1047,13 +1048,15 @@ class ResidentRowsDocSet(ResidentDocSet):
             return jax.device_put(arr, self.device)
         return jnp.asarray(arr)
 
-    def _mark_trips_dirty(self, trip_list) -> None:
+    def _mark_trips_dirty(self, trip_list) -> set:
         """Hash invalidation for the lanes a batch of scatter triplets
         touches (BEFORE the dispatch: a failed dispatch leaves host truth
-        updated, so these lanes must re-reconcile either way)."""
+        updated, so these lanes must re-reconcile either way). Returns
+        the touched lane set (the dispatch ledger's docs-served count)."""
         touched = {int(d) for t in trip_list for d in np.unique(t[:, 1])}
         if touched:
             self._mark_hash_dirty(touched)
+        return touched
 
     def _dispatch_rounds(self, trip_list, pre_rows, interpret):
         p = _pad_to(max((len(t) for t in trip_list), default=1), 8)
@@ -1062,13 +1065,20 @@ class ResidentRowsDocSet(ResidentDocSet):
         for k, t in enumerate(trip_list):
             stacked[k, :len(t)] = t
             stacked[k, len(t):, 0] = oob
-        self._mark_trips_dirty(trip_list)
+        touched = self._mark_trips_dirty(trip_list)
         if pre_rows is not None:
             self.rows_dev = self._to_dev(pre_rows)
             self._dirty = False
-        self.rows_dev, hashes = metrics.dispatch_jit(
-            "scan_rounds", _scan_rounds,
-            self.rows_dev, self._to_dev(stacked), self.dims(), interpret)
+        with dispatchledger.call_scope(
+                "rows_scan", backend="device", docs=len(touched),
+                axes={"docs": (len(self.doc_ids), self.n_pad),
+                      "rounds": (len(trip_list), len(trip_list)),
+                      "trips": (max((len(t) for t in trip_list),
+                                    default=1), p)}):
+            self.rows_dev, hashes = metrics.dispatch_jit(
+                "scan_rounds", _scan_rounds,
+                self.rows_dev, self._to_dev(stacked), self.dims(),
+                interpret)
         self._hash_handle = None
         with perfscope.phase("readback"):
             vals = np.asarray(hashes)
@@ -1819,7 +1829,7 @@ class ResidentRowsDocSet(ResidentDocSet):
         over rounds collapses into a single gather-free scatter. Returns
         the device hash array without reading it back (None under
         lazy_dispatch — the next hashes() read reconciles)."""
-        self._mark_trips_dirty(trip_list)
+        touched = self._mark_trips_dirty(trip_list)
         if self.lazy_dispatch:
             # _cols_triplets already committed the round to the host
             # mirror; defer upload + reconcile to the next hash read —
@@ -1847,9 +1857,14 @@ class ResidentRowsDocSet(ResidentDocSet):
         if pre_rows is not None:
             self.rows_dev = self._to_dev(pre_rows)
             self._dirty = False
-        self.rows_dev, h = metrics.dispatch_jit(
-            "apply_final", _apply_final,
-            self.rows_dev, self._to_dev(padded), self.dims(), interpret)
+        with dispatchledger.call_scope(
+                "rows_apply", backend="device", docs=len(touched),
+                axes={"docs": (len(self.doc_ids), self.n_pad),
+                      "trips": (max(len(trips), 1), p)}):
+            self.rows_dev, h = metrics.dispatch_jit(
+                "apply_final", _apply_final,
+                self.rows_dev, self._to_dev(padded), self.dims(),
+                interpret)
         self._hash_handle = h  # polling hashes() between deltas is free
         return h
 
@@ -1908,9 +1923,12 @@ class ResidentRowsDocSet(ResidentDocSet):
             if self.rows_dev is None or self._dirty:
                 self.rows_dev = self._to_dev(self.rows_host)
                 self._dirty = False
-            h = metrics.dispatch_jit(
-                "reconcile_rows_hash", reconcile_rows_hash,
-                self.rows_dev, self.dims(), interpret)
+            with dispatchledger.call_scope(
+                    "rows_hash", backend="device", docs=len(dirty),
+                    axes={"docs": (n, self.n_pad)}):
+                h = metrics.dispatch_jit(
+                    "reconcile_rows_hash", reconcile_rows_hash,
+                    self.rows_dev, self.dims(), interpret)
             flightrec.record("rows_hash_readback", docs=n, cached=False)
             with perfscope.phase("readback"):
                 vals = np.asarray(h)
@@ -1937,9 +1955,12 @@ class ResidentRowsDocSet(ResidentDocSet):
         sel = np.asarray(idxs + [idxs[-1]] * (k_pad - k), np.int64)
         with perfscope.phase("pack"):
             sub = np.ascontiguousarray(self.rows_host[:, sel])
-        h = metrics.dispatch_jit(
-            "reconcile_rows_hash", reconcile_rows_hash,
-            self._to_dev(sub), self.dims(), interpret)
+        with dispatchledger.call_scope(
+                "rows_hash", backend="device", docs=k,
+                axes={"docs": (k, k_pad)}):
+            h = metrics.dispatch_jit(
+                "reconcile_rows_hash", reconcile_rows_hash,
+                self._to_dev(sub), self.dims(), interpret)
         flightrec.record("rows_hash_readback", docs=k, cached=False)
         with perfscope.phase("readback"):
             vals = np.asarray(h)

@@ -1,8 +1,8 @@
 """CLI for the performance plane: `python -m automerge_tpu.perf
-{report,check,contention,doctor,explain,top,remediate,roofline,resident}`
-(docs/OBSERVABILITY.md "Performance plane" / "Contention & convergence
-lag" / "Fleet health" / "Per-doc ledger & perf explain" / "Remediation
-plane").
+{report,check,contention,doctor,explain,top,dispatch,remediate,roofline,
+resident}` (docs/OBSERVABILITY.md "Performance plane" / "Contention &
+convergence lag" / "Fleet health" / "Per-doc ledger & perf explain" /
+"Remediation plane" / "Dispatch-efficiency ledger").
 
 - `doctor`  — ranked root-cause report: live against a fleet
   (--connect), or post-mortem against a BENCH_DETAIL.json / flight-
@@ -15,6 +15,10 @@ plane").
 - `top`     — live terminal dashboard (fleet table, SLO verdict strip,
   sparklines, per-doc hot list) driven by the fleet collector
   (perf/fleet.py).
+- `dispatch` — dispatch-efficiency report over the kernel-routing
+  ledger (engine/dispatchledger.py): amplification, padding waste,
+  per-kernel attribution, and the megabatch-opportunity projection.
+  Same three modes as the doctor, plus `--smoke` (verify.sh stage 2).
 - `remediate` — the chaos-recovery smoke (verify.sh stage 2): injects
   one conn_kill into a supervised TCP link and asserts the fleet
   self-heals (perf/remediate.py).
@@ -181,6 +185,9 @@ def main(argv=None) -> int:
     if cmd == "top":
         from . import top
         return top.main(rest)
+    if cmd == "dispatch":
+        from . import dispatchplane
+        return dispatchplane.main(rest)
     if cmd == "remediate":
         # the chaos-recovery smoke (verify.sh stage 2): one injected
         # fault, assert the supervised link self-heals
@@ -205,8 +212,8 @@ def main(argv=None) -> int:
         resident.main(rest)
         return 0
     print(f"unknown command {cmd!r}; expected one of "
-          "report, check, contention, doctor, explain, top, remediate, move, "
-          "bootstrap, roofline, resident",
+          "report, check, contention, doctor, explain, top, dispatch, "
+          "remediate, move, bootstrap, roofline, resident",
           file=sys.stderr)
     return 2
 

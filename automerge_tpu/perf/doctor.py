@@ -38,6 +38,12 @@ Cause classes (stable identifiers — the bench asserts on them):
                      disk — the chaos `disk_stall` fault class): slow
                      appends and slow bootstraps attribute to the
                      STORAGE tier, not the engine (r15 storage tier)
+    dispatch_amplification
+                     the engine is paying several routed dispatches per
+                     dirty doc (the dispatchledger window rollup), with
+                     padding-waste evidence — the regime ROADMAP #2's
+                     megabatching collapses; `perf dispatch` prints the
+                     opportunity report (r17 dispatch ledger)
 
 CLI: `python -m automerge_tpu.perf doctor [--post-mortem PATH]
 [--config N] [--json] [--connect host:port,... --ticks N]`. With no
@@ -264,6 +270,33 @@ def diagnose_snapshot(snapshot: dict, label: str = "snapshot",
             ev.append(f"{int(inj)} injected disk_stall fault(s) "
                       "disclosed — chaos run, not an organic disk")
         _cause(causes, "storage_stall", None, float(fsync_s), ev)
+
+    # dispatch-efficiency join (engine/dispatchledger.py): sustained
+    # per-doc dispatch amplification, with the pad-waste and per-kernel
+    # evidence the ledger's window rollup already folded
+    for sec in ((snapshot.get("dispatchledger") or {}).get("nodes")
+                or {}).values():
+        w = (sec or {}).get("window") or {}
+        amp = w.get("amplification")
+        disp = (w.get("dispatches") or 0) + (w.get("ambient") or 0)
+        if not isinstance(amp, (int, float)) or amp <= 2.0 or disp < 8:
+            continue
+        ev = [f"{int(disp)} dispatches over {w.get('dirty_docs')} dirty "
+              f"doc(s) in {w.get('rounds')} round(s): amplification "
+              f"x{amp:.2f}"]
+        waste = w.get("pad_waste_pct")
+        if isinstance(waste, (int, float)):
+            ev.append(f"padding waste {waste:.1f}% of padded lanes")
+        worst = sorted((w.get("kernels") or {}).items(),
+                       key=lambda kv: -(kv[1].get("calls") or 0))[:3]
+        if worst:
+            ev.append("top kernels: " + ", ".join(
+                f"{fam} x{k.get('calls')} ({k.get('wall_s')}s)"
+                for fam, k in worst))
+        ev.append("run `perf dispatch` for the megabatch-opportunity "
+                  "report")
+        _cause(causes, "dispatch_amplification", None,
+               float(w.get("wall_s") or amp), ev)
 
     retraced = sum(v for k, v in snapshot.items()
                    if isinstance(v, (int, float))

@@ -141,6 +141,17 @@ def extract_features(snapshot: dict) -> dict:
         v = _stage_p99(snapshot, stage)
         if v is not None:
             out[key] = v
+    # dispatch-efficiency window rollups (engine/dispatchledger.py —
+    # already windowed over the per-round ring, so instantaneous here;
+    # worst label wins on the rare multi-section snapshot)
+    for sec in ((snapshot.get("dispatchledger") or {}).get("nodes")
+                or {}).values():
+        w = (sec or {}).get("window") or {}
+        for src, key in (("amplification", "dispatch_amplification"),
+                         ("pad_waste_pct", "dispatch_pad_waste_pct")):
+            v = w.get(src)
+            if isinstance(v, (int, float)):
+                out[key] = max(float(v), out.get(key, 0.0))
     return out
 
 
@@ -510,6 +521,10 @@ class FleetCollector:
             "frames_dropped": _agg("frames_dropped", "sum"),
             "watchdog_fires": _agg("watchdog_fires", "sum"),
             "retraced": _agg("retraced", "sum"),
+            "dispatch_amplification": _agg("dispatch_amplification",
+                                           "max"),
+            "dispatch_pad_waste_pct": _agg("dispatch_pad_waste_pct",
+                                           "max"),
         }
         self._last_state = {
             "at": now,

@@ -113,6 +113,13 @@ SCRAPE_BUDGET_S = 0.25
 #: cost is a property of the ledger code, not of the traffic mix.
 LEDGER_BUDGET_PCT = 2.0
 
+#: dispatch-efficiency-ledger gate (r17, config 17): the dispatch
+#: ledger's duty cycle (scope/fold self time / traffic wall) must stay
+#: under this ABSOLUTE percentage — the same posture as the doc ledger's
+#: bound above, and for the same reason: an instrument that taxes the
+#: flush path it measures is the workload, not observability.
+DISPATCH_LEDGER_BUDGET_PCT = 2.0
+
 #: partial-replication gates (r12, config 13). All ABSOLUTE — each is a
 #: property of the subscription/relay code, not of the host:
 #: relay-tree total fan-out bytes must grow sublinearly in subscriber
@@ -331,7 +338,22 @@ def _norm_configs(raw) -> dict:
                                        "move_cycles_dropped",
                                        "move_kernel_parity",
                                        "move_pallas_parity",
-                                       "move_storm_converged")
+                                       "move_storm_converged",
+                                       # the dispatch-efficiency ledger
+                                       # (r17, config 17): baseline
+                                       # amplification + padding waste,
+                                       # ledger duty cycle, disabled-
+                                       # path parity, megabatch
+                                       # projection
+                                       "dispatch_amplification",
+                                       "dispatch_pad_waste_pct",
+                                       "dispatches_per_round",
+                                       "dispatch_ledger_overhead_pct",
+                                       "dispatch_disabled_parity",
+                                       "megabatch_dispatches_current",
+                                       "megabatch_dispatches_projected",
+                                       "megabatch_savings_pct",
+                                       "megabatch_worst_bucket")
                      if isinstance(v.get(k), (int, float, str))}
         elif isinstance(v, (int, float)):
             entry = {"speedup": v}
@@ -937,6 +959,47 @@ def check(path: str | None = None, record: dict | None = None,
                          + ("OK (asserted in-run)" if val else "FAILED"))
             if not val:
                 rc = 1
+
+    # dispatch-ledger gates (r17, config 17): the dispatch-efficiency
+    # ledger's own duty cycle must stay under the ABSOLUTE budget
+    # (DISPATCH_LEDGER_BUDGET_PCT — a property of the ledger code, like
+    # the doc ledger's bound), and the disabled path must have proved
+    # behavior parity in-run. Amplification / padding waste / megabatch
+    # projection are reported alongside — they are the BASELINE numbers
+    # fleet megabatching (ROADMAP #2) exists to shrink, so they inform
+    # rather than gate. Skip-clean: runs without config 17 never fail.
+    def _dd(r: dict):
+        return ((r.get("configs") or {}).get("17") or {})
+
+    cur_dp = _dd(current).get("dispatch_ledger_overhead_pct")
+    if isinstance(cur_dp, (int, float)):
+        verdict = ("OK" if cur_dp <= DISPATCH_LEDGER_BUDGET_PCT
+                   else "DISPATCH LEDGER OVER BUDGET")
+        lines.append(
+            f"  dispatch-ledger duty cycle (config 17): {cur_dp:.3f}% "
+            f"(budget <= {DISPATCH_LEDGER_BUDGET_PCT}%) -> {verdict}")
+        if cur_dp > DISPATCH_LEDGER_BUDGET_PCT:
+            rc = 1
+    dpar = _dd(current).get("dispatch_disabled_parity")
+    if dpar is not None:
+        lines.append("  dispatch-ledger disabled-path parity: "
+                     + ("OK (byte-equal hashes, zero rounds recorded)"
+                        if dpar else "DIVERGED"))
+        if not dpar:
+            rc = 1
+    amp = _dd(current).get("dispatch_amplification")
+    if isinstance(amp, (int, float)):
+        extra = [f"amplification x{amp}"]
+        pw = _dd(current).get("dispatch_pad_waste_pct")
+        if isinstance(pw, (int, float)):
+            extra.append(f"pad waste {pw}%")
+        mbc = _dd(current).get("megabatch_dispatches_current")
+        mbp = _dd(current).get("megabatch_dispatches_projected")
+        if isinstance(mbc, (int, float)) and isinstance(mbp, (int, float)):
+            extra.append(f"megabatch projection {int(mbc)} -> {int(mbp)} "
+                         "dispatches")
+        lines.append("  dispatch baseline (ROADMAP #2 divides these): "
+                     + "; ".join(extra))
 
     # keystroke-flatness gate (r8, config 7): latency at 4x document
     # length over 1x must stay under the ceiling. A RATIO is

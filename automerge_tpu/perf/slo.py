@@ -22,7 +22,7 @@ Spec format (docs/OBSERVABILITY.md "Fleet health") — a list of dicts or
                                       # INCREASE on this engine's watch)
      "description": "..."}
 
-The four defaults mirror the plane's acceptance bar:
+The five defaults mirror the plane's acceptance bar:
 
 - `converge_p99`: fleet max converge-stage p99 stays under bound;
 - `watchdog_clean`: zero NEW watchdog fires fleet-wide;
@@ -31,7 +31,12 @@ The four defaults mirror the plane's acceptance bar:
   compiles_total, + the same slack `perf check` grants) — a retrace
   storm is the classic silent perf cliff;
 - `collector_overhead`: the collector's own scrape p50 stays under
-  budget (a health plane must not degrade the fleet it watches).
+  budget (a health plane must not degrade the fleet it watches);
+- `dispatch_amplification`: fleet max dispatches-per-dirty-doc (the
+  dispatch ledger's window rollup) stays under bound — the number
+  ROADMAP #2's megabatching must divide, judged here so a regression
+  into dispatch-per-doc behavior breaches before it becomes a latency
+  incident.
 
 A signal the fleet has not produced yet (no oplag samples, empty
 history) evaluates to verdict None — "no data" is neither ok nor breach,
@@ -56,6 +61,13 @@ DEFAULT_SCRAPE_P50_S = 0.25
 #: (same shape as perf check's compile gate: pct growth + absolute)
 RETRACE_SLACK_PCT = 50.0
 RETRACE_ABS_SLACK = 2
+#: default bound on the fleet max dispatches-per-dirty-doc window
+#: rollup (engine/dispatchledger.py): a steady fleet batches a round's
+#: docs into a handful of routed calls, so the per-doc share stays well
+#: under one dispatch each — sustained amplification past this bound
+#: means the engine is dispatching per doc, exactly the regime ROADMAP
+#: #2's megabatching exists to collapse
+DEFAULT_DISPATCH_AMPLIFICATION = 8.0
 
 
 class Slo:
@@ -101,7 +113,9 @@ def retrace_budget_from_history(path: str | None = None) -> float | None:
 
 def default_slos(converge_p99_s: float = DEFAULT_CONVERGE_P99_S,
                  scrape_p50_s: float = DEFAULT_SCRAPE_P50_S,
-                 retrace_budget: float | None = None) -> list[Slo]:
+                 retrace_budget: float | None = None,
+                 dispatch_amplification: float =
+                 DEFAULT_DISPATCH_AMPLIFICATION) -> list[Slo]:
     return [
         Slo("converge_p99", "converge_p99_s", converge_p99_s,
             description="fleet max converge-stage p99 under bound"),
@@ -112,6 +126,10 @@ def default_slos(converge_p99_s: float = DEFAULT_CONVERGE_P99_S,
                         "compile budget"),
         Slo("collector_overhead", "scrape_p50_s", scrape_p50_s,
             description="collector scrape p50 under budget"),
+        Slo("dispatch_amplification", "dispatch_amplification",
+            dispatch_amplification,
+            description="fleet max dispatches per dirty doc under "
+                        "bound (engine/dispatchledger.py window)"),
     ]
 
 

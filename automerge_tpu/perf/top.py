@@ -14,7 +14,12 @@ One screen, three bands (docs/OBSERVABILITY.md "Fleet health"):
 - the **per-doc hot list** — the worst-lagging docs across every
   scraped node's convergence ledger (the `"docledger"` snapshot
   section, sync/docledger.py), with the `perf explain <doc>` handle for
-  the causal walk.
+  the causal walk;
+- the **dispatch-waste band** — per scraped node shipping a
+  `"dispatchledger"` section (engine/dispatchledger.py): window
+  amplification (dispatches per dirty doc), padding-waste %, and the
+  biggest padded bucket, with the `perf dispatch` handle for the full
+  megabatch-opportunity report.
 
 Keys (tty only): `q` quit · `p` pause/resume scraping ·
 `d` dump a `perf doctor` live report to a file and show the path.
@@ -110,6 +115,7 @@ def render(collector, slo_engine=None, width: int = 100) -> list[str]:
                 lines.append(f"{focus} {label:<9} {spark(series)} "
                              f"{_fmt(series[-1], nd=4)}")
     lines.extend(hot_doc_lines(collector))
+    lines.extend(dispatch_lines(collector))
     return [line[:width] for line in lines]
 
 
@@ -143,6 +149,52 @@ def hot_doc_lines(collector, limit: int = 5) -> list[str]:
         lines.append(f"  (+{truncated} tracked doc(s) beyond the export "
                      "cap — raise AMTPU_DOCLEDGER_K or pass --k to "
                      "perf explain)")
+    return lines
+
+
+def dispatch_lines(collector, limit: int = 5) -> list[str]:
+    """The dispatch-waste band: per ledger-shipping node, the window
+    amplification / padding-waste rollup and its biggest padded bucket
+    (engine/dispatchledger.py), worst amplification first. Empty when no
+    scraped node ships a `"dispatchledger"` section — the band simply
+    disappears (same contract as the hot-doc panel)."""
+    rows = []
+    for st in collector.nodes.values():
+        snap = st.last_snapshot
+        if not isinstance(snap, dict):
+            continue
+        for label, sec in ((snap.get("dispatchledger") or {})
+                           .get("nodes") or {}).items():
+            w = (sec or {}).get("window") or {}
+            if not w.get("dispatches") and not w.get("ambient"):
+                continue
+            buckets = sorted((w.get("buckets") or {}).items(),
+                             key=lambda kv: -(kv[1].get("padded") or 0))
+            rows.append({
+                "node": label,
+                "amp": w.get("amplification"),
+                "waste": w.get("pad_waste_pct"),
+                "dispatches": ((w.get("dispatches") or 0)
+                               + (w.get("ambient") or 0)),
+                "rounds": w.get("rounds"),
+                "bucket": buckets[0][0] if buckets else None,
+            })
+    if not rows:
+        return []
+    rows.sort(key=lambda r: -(r["amp"]
+                              if isinstance(r["amp"], (int, float))
+                              else -1.0))
+    lines = ["dispatch waste (amplification; `perf dispatch`):"]
+    for r in rows[:limit]:
+        lines.append(
+            f"  {str(r['node'])[:12]:<12} "
+            f"amp {_fmt(r['amp'], 'x', 2):>8} "
+            f"waste {_fmt(r['waste'], '%', 1):>7} "
+            f"{r['dispatches']:>5} disp/{r['rounds']} rnd"
+            + (f"  worst {r['bucket']}" if r["bucket"] else ""))
+    if len(rows) > limit:
+        lines.append(f"  (+{len(rows) - limit} more ledger node(s) — "
+                     "run `perf dispatch` for the full report)")
     return lines
 
 

@@ -30,6 +30,7 @@ from typing import Callable
 import os
 
 from ..core.change import Change
+from ..engine import dispatchledger
 from ..engine.resident import ResidentDocSet
 from ..engine.resident_rows import CompactionAnchorError, DeviceDispatchError
 from ..utils import chaos, flightrec, lockprof, metrics, oplag, perfscope
@@ -1121,7 +1122,11 @@ class EngineDocSet:
         phases0 = perfscope.phase_totals() if toks else None
         t0 = _time.perf_counter()
         with metrics.trace("sync_round_flush", tags={"round": round_no},
-                           **labels):
+                           **labels), \
+                dispatchledger.round_scope(
+                    len(self._pending),
+                    label=(f"shard{self._shard}"
+                           if self._shard is not None else None)):
             self._flush_pending_locked()
         if round_docs is not None:
             deltas = None

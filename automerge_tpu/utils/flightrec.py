@@ -97,6 +97,9 @@ EVENT_KINDS: dict[str, str] = {
                           "(engine/resident_rows.py)",
     "dispatch": "one jitted kernel dispatch (metrics.dispatch_jit; "
                 "kernel, retraced flag)",
+    "dispatch_round": "one flush round folded into the dispatch-"
+                      "efficiency ledger (engine/dispatchledger.py; "
+                      "round/docs/dispatches/amp)",
     "watchdog_fire": "a stall watchdog fired (metrics.watchdog; "
                      "name/budget_s)",
     "audit_state": "a convergence-audit digest round compared "

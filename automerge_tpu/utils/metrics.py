@@ -138,6 +138,14 @@ COUNTERS: dict[str, str] = {
     "engine_kernels_dispatched": "jitted kernel dispatches {kernel=...}",
     "engine_kernels_retraced":
         "jit compile-cache misses (retrace/compile) {kernel=...}",
+    # dispatch-efficiency ledger (engine/dispatchledger.py — r17)
+    "engine_dispatch_calls":
+        "routed kernel calls recorded by the dispatch-efficiency ledger "
+        "{family=...,backend=host|device} (engine/dispatchledger.py)",
+    "engine_dispatch_ambient":
+        "jitted dispatches observed with no routed call scope open "
+        "(engine/dispatchledger.note_jit; counted so nothing escapes "
+        "the amplification account)",
     # rows — docs-minor streaming engine
     "rows_rounds_batched": "round frames through the vectorized admission",
     "rows_rounds_fallback": "round frames through the per-round fallback",
@@ -433,6 +441,22 @@ GAUGES: dict[str, str] = {
     "sync_shed_active":
         "admission governor state: 1 while low-priority ingress is "
         "being delayed/shed, else 0 (sync/epochs.IngressGovernor)",
+    # dispatch-efficiency ledger (engine/dispatchledger.py — r17):
+    # window rollups over the per-round ring, refreshed on the fold
+    # cadence (no kernel/bucket labels here — the full attribution lives
+    # in the nested "dispatchledger" snapshot section)
+    "obs_dispatch_amplification":
+        "dispatches per dirty doc over the round window — the number "
+        "fleet megabatching must divide (engine/dispatchledger.py)",
+    "obs_dispatch_pad_waste_pct":
+        "padded-lane fraction computed for nobody, percent, over the "
+        "round window (engine/dispatchledger.py)",
+    "obs_dispatch_per_round":
+        "mean routed dispatches per flush round over the window "
+        "(engine/dispatchledger.py)",
+    "obs_dispatch_rounds_tracked":
+        "flush rounds currently held in the dispatch ledger's bounded "
+        "ring (engine/dispatchledger.py)",
     # remediation plane (perf/remediate.py — r13)
     "obs_remed_quarantined":
         "nodes currently quarantined by the remediation engine "
@@ -469,6 +493,10 @@ HISTOGRAMS: dict[str, str] = {
         "convergence-ledger self-time flushed per snapshot export "
         "(sync/docledger.py; sum/elapsed = the duty-cycle bound the "
         "config-12 perf-check gate holds under 2%)",
+    "obs_dispatch_ledger_s":
+        "dispatch-ledger self-time flushed per gauge refresh "
+        "(engine/dispatchledger.py; sum/elapsed = the duty-cycle bound "
+        "the config-17 perf-check gate holds under 2%)",
     "obs_remed_tick_s":
         "remediation-engine per-tick wall cost (perf/remediate.py; "
         "p50/interval = the steady-state duty cycle bench config 14 "
@@ -1255,5 +1283,10 @@ def dispatch_jit(kernel: str, fn, *args, **kwargs):
             from . import flightrec
             flightrec.record("dispatch", kernel=kernel,
                              **({"retraced": True} if retraced else {}))
+        except Exception:
+            pass
+        try:
+            from ..engine import dispatchledger
+            dispatchledger.note_jit(kernel, retraced)
         except Exception:
             pass

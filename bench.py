@@ -3616,6 +3616,162 @@ def run_move_config(n_dirs=48, files_per_dir=4, reparents=24,
         shutil.rmtree(root, ignore_errors=True)
 
 
+def run_dispatch_config(n_docs=1024, rounds=24, dirty_per_round=96,
+                        zipf_s=1.1):
+    """Config 17: dispatch-efficiency ledger on a 1K-doc zipf dirty
+    storm. Three claims, each asserted in-run:
+
+    1. the ledger accounts every coalesced flush round of a realistic
+       dirty storm — baseline **amplification** (dispatches per dirty
+       doc), padding-waste %, and the per-bucket megabatch-opportunity
+       projection land in the per-config metrics snapshot (BENCH_DETAIL
+       -> `perf dispatch --post-mortem`), stating the number ROADMAP
+       #2's fleet megabatching must divide;
+    2. the ledger's own duty cycle (scope/fold self time / traffic
+       wall) stays under 2% — gated again in `perf check`
+       (perf/history.py DISPATCH_LEDGER_BUDGET_PCT);
+    3. the disabled path is behavior-identical: the same storm re-run
+       under AMTPU_DISPATCHLEDGER=0 produces byte-equal per-doc hashes
+       and records ZERO new ledger rounds.
+
+    The service pins the eager (TPU-posture) dispatch path — on CPU the
+    rows backend normally defers reconciles to hash reads, which would
+    ledger the work as ambient pseudo-rounds instead of the in-round
+    attribution a TPU deployment sees."""
+    import random
+
+    from automerge_tpu.core.change import Change, Op
+    from automerge_tpu.core.ids import ROOT_ID
+    from automerge_tpu.engine import dispatchledger
+    from automerge_tpu.perf import dispatchplane
+    from automerge_tpu.perf.history import DISPATCH_LEDGER_BUDGET_PCT
+    from automerge_tpu.sync.service import EngineDocSet
+
+    assert dispatchledger.enabled(), (
+        "config 17 needs the dispatch ledger on (unset "
+        "AMTPU_DISPATCHLEDGER)")
+
+    def storm(svc):
+        """The identical zipf dirty storm (own rng: both runs replay the
+        same traffic); returns (per-doc hash map, changes ingested)."""
+        rng = random.Random(17)
+        pick = _zipf_picker(n_docs, zipf_s, rng)
+        seqs: dict = {}
+        for r in range(rounds):
+            dirty = sorted({pick() for _ in range(dirty_per_round)})
+            with svc.batch():
+                for d in dirty:
+                    doc = f"doc{d:04d}"
+                    seqs[doc] = seqs.get(doc, 0) + 1
+                    svc.apply_changes(doc, [Change(
+                        actor="storm", seq=seqs[doc], deps={},
+                        ops=[Op("set", ROOT_ID, key=f"f{r % 4}",
+                                value=r)])])
+        return svc.hashes(), sum(seqs.values())
+
+    def eager_service():
+        svc = EngineDocSet(backend="rows")
+        svc._lazy_resolved = True
+        svc._resident.lazy_dispatch = False
+        return svc
+
+    led = dispatchledger.ledger()
+    base = led.section() or {}
+    base_rounds = int(base.get("rounds_total") or 0)
+    base_self = led.self_seconds()
+    svc = eager_service()
+    try:
+        with _quiet_traceback_dumps():
+            t0 = time.perf_counter()
+            hashes_on, total_ops = storm(svc)
+            traffic_wall = time.perf_counter() - t0
+    finally:
+        svc.close()
+
+    sec = led.section()
+    assert sec, "dirty storm left no dispatch-ledger section"
+    rounds_ledgered = int(sec.get("rounds_total") or 0) - base_rounds
+    assert rounds_ledgered >= rounds, (
+        f"expected >= {rounds} ledgered round(s), got {rounds_ledgered}")
+    w = sec.get("window") or {}
+    amp = w.get("amplification")
+    waste = w.get("pad_waste_pct")
+    assert isinstance(amp, (int, float)) and amp > 0, (
+        f"window amplification not positive: {amp!r}")
+    self_s = led.self_seconds() - base_self
+    duty_pct = round(100.0 * self_s / max(traffic_wall, 1e-9), 3)
+    assert duty_pct < DISPATCH_LEDGER_BUDGET_PCT, (
+        f"dispatch-ledger duty cycle {duty_pct}% breaches the "
+        f"{DISPATCH_LEDGER_BUDGET_PCT}% budget")
+    mb_rows = dispatchplane.megabatch_rows(w)
+    mb_current = sum(r["calls"] for r in mb_rows)
+    mb_saved = sum(r["dispatches_saved"] for r in mb_rows)
+
+    # disabled-parity subrun: same storm, ledger off — byte-identical
+    # hashes, zero new rounds (the one cached check is the whole cost)
+    rounds_before_off = int(led.section().get("rounds_total") or 0)
+    os.environ["AMTPU_DISPATCHLEDGER"] = "0"
+    dispatchledger._reload_for_tests()
+    try:
+        assert not dispatchledger.enabled()
+        svc2 = eager_service()
+        try:
+            with _quiet_traceback_dumps():
+                hashes_off, _ = storm(svc2)
+        finally:
+            svc2.close()
+    finally:
+        os.environ.pop("AMTPU_DISPATCHLEDGER", None)
+        dispatchledger._reload_for_tests()
+    assert hashes_off == hashes_on, (
+        "ledger-disabled storm diverged: per-doc hashes differ "
+        f"({sum(1 for d in hashes_on if hashes_on[d] != hashes_off.get(d))}"
+        " docs)")
+    rounds_off = (int(led.section().get("rounds_total") or 0)
+                  - rounds_before_off)
+    assert rounds_off == 0, (
+        f"disabled ledger still recorded {rounds_off} round(s)")
+
+    return {
+        "config": 17,
+        "name": CONFIGS[17][0],
+        "docs": n_docs,
+        "ops": total_ops,
+        "storm_rounds": rounds,
+        "zipf_s": zipf_s,
+        "dirty_per_round_drawn": dirty_per_round,
+        "dispatch_amplification": amp,
+        "dispatch_pad_waste_pct": waste,
+        "dispatches_per_round": w.get("dispatches_per_round"),
+        "dispatch_rounds_ledgered": rounds_ledgered,
+        "dispatch_jits": int(sec.get("jits_total") or 0),
+        "dispatch_retraces": int(sec.get("retraces_total") or 0),
+        "dispatch_ambient": int(sec.get("ambient_total") or 0),
+        "dispatch_ledger_overhead_pct": duty_pct,
+        "dispatch_ledger_self_s": round(self_s, 5),
+        "dispatch_disabled_parity": 1,
+        "megabatch_dispatches_current": mb_current,
+        "megabatch_dispatches_projected": mb_current - mb_saved,
+        "megabatch_savings_pct": (
+            round(100.0 * mb_saved / mb_current, 1) if mb_current else 0.0),
+        "megabatch_worst_bucket": (mb_rows[0]["bucket"] if mb_rows
+                                   else None),
+        "protocol": (
+            f"{rounds} coalesced flush rounds over {n_docs} docs, "
+            f"zipf({zipf_s}) dirty sets of <= {dirty_per_round} docs, "
+            "eager (TPU-posture) dispatch pinned; ledger window rollup "
+            "asserted live (amplification > 0, duty cycle < "
+            f"{DISPATCH_LEDGER_BUDGET_PCT}%); identical storm re-run "
+            "under AMTPU_DISPATCHLEDGER=0 asserted byte-equal hashes + "
+            "zero rounds recorded"),
+        "traffic_wall_s": round(traffic_wall, 3),
+        "engine_s": round(traffic_wall, 3),
+        "oracle_s": None,
+        "speedup": None,
+        "parity": True,
+    }
+
+
 CONFIGS = {
     1: ("single-doc LWW storm (2 actors x 1000 sets)", gen_lww_storm),
     2: ("nested JSON card board (8 actors)", gen_trellis),
@@ -3642,6 +3798,9 @@ CONFIGS = {
     16: ("concurrent subtree moves across a fleet: move-as-atom vs "
          "delete+reinsert, batched cycle resolution vs per-op walk",
          None),
+    17: ("dispatch-efficiency ledger: 1K-doc zipf dirty storm, baseline "
+         "amplification + padding waste + megabatch projection, duty "
+         "cycle < 2%, disabled-path parity", None),
 }
 
 
@@ -4280,6 +4439,8 @@ def run_config(cfg: int, n_docs: int | None = None, oracle_cap_docs=12000):
         return run_bootstrap_config()
     if cfg == 16:
         return run_move_config()
+    if cfg == 17:
+        return run_dispatch_config()
     name, gen = CONFIGS[cfg]
     kwargs = {}
     if cfg == 5 and n_docs:
@@ -4589,6 +4750,24 @@ def _final_record(results_by_cfg: dict, backend: str | None, attempts: list):
                 "move_storm_converged": r["move_storm_converged"],
                 "protocol": r["protocol"]}
                if r.get("config") == 16 else {}),
+            **({"dispatch_amplification": r["dispatch_amplification"],
+                "dispatch_pad_waste_pct": r["dispatch_pad_waste_pct"],
+                "dispatches_per_round": r["dispatches_per_round"],
+                "dispatch_rounds_ledgered": r["dispatch_rounds_ledgered"],
+                "dispatch_jits": r["dispatch_jits"],
+                "dispatch_retraces": r["dispatch_retraces"],
+                "dispatch_ambient": r["dispatch_ambient"],
+                "dispatch_ledger_overhead_pct":
+                    r["dispatch_ledger_overhead_pct"],
+                "dispatch_disabled_parity": r["dispatch_disabled_parity"],
+                "megabatch_dispatches_current":
+                    r["megabatch_dispatches_current"],
+                "megabatch_dispatches_projected":
+                    r["megabatch_dispatches_projected"],
+                "megabatch_savings_pct": r["megabatch_savings_pct"],
+                "megabatch_worst_bucket": r["megabatch_worst_bucket"],
+                "protocol": r["protocol"]}
+               if r.get("config") == 17 else {}),
             **({"mttr_max_s": r["mttr_max_s"],
                 "mttr_mean_s": r["mttr_mean_s"],
                 "mttr_budget_s": r["mttr_budget_s"],

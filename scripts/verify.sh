@@ -32,7 +32,12 @@
 #          services in both delivery orders, convergence + cycle-drop +
 #          host/XLA/pallas resolution parity asserted (docs/INTERNALS.md
 #          "The move plane"; the fleet-scale gate is bench config 16
-#          under `make perfcheck`). Never fails verify — a CPU-only
+#          under `make perfcheck`), and the dispatch smoke: a short
+#          eager-pinned traffic round proves the dispatch-efficiency
+#          ledger accounts every routed call (amplification, padding
+#          waste, megabatch projection — docs/OBSERVABILITY.md
+#          "Dispatch-efficiency ledger"; the fleet-scale gate is bench
+#          config 17 under `make perfcheck`). Never fails verify — a CPU-only
 #          image or a missing/empty history must not block the build
 #          (TUNNEL_DIAGNOSIS.md: TPU absence is an environment fact, not
 #          a code defect). Run `make perfcheck` for the enforcing gate.
@@ -62,6 +67,8 @@ JAX_PLATFORMS=cpu python -m automerge_tpu.perf bootstrap --smoke \
     || echo "bootstrap smoke FAILED (informational here; enforced by tests + perf check)"
 JAX_PLATFORMS=cpu python -m automerge_tpu.perf move --smoke \
     || echo "move smoke FAILED (informational here; enforced by tests + perf check)"
+JAX_PLATFORMS=cpu python -m automerge_tpu.perf dispatch --smoke \
+    || echo "dispatch smoke FAILED (informational here; enforced by tests + perf check)"
 
 echo "== stage 3/3: tier-1 suite (ROADMAP.md) =="
 set -o pipefail

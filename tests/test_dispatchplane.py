@@ -1,0 +1,142 @@
+"""`perf dispatch` (perf/dispatchplane.py): megabatch-opportunity math,
+section merging, report rendering, the post-mortem modes, and the CI
+smoke round."""
+
+import json
+
+import pytest
+
+from automerge_tpu.perf import dispatchplane
+from automerge_tpu.utils import metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+def _window(buckets):
+    return {"rounds": 2, "dispatches": sum(b["calls"]
+                                           for b in buckets.values()),
+            "ambient": 0, "dirty_docs": 4, "amplification": 2.0,
+            "pad_waste_pct": 75.0, "dispatches_per_round": 4.0,
+            "wall_s": 0.02, "kernels": {}, "buckets": buckets}
+
+
+def _section(label="n", buckets=None):
+    b = buckets if buckets is not None else {
+        "apply:128x64": {"calls": 4, "docs": 12, "docs_cap": 512,
+                         "logical": 48, "padded": 32768, "wall_s": 0.01}}
+    return {"label": label, "rounds_total": 2, "dirty_docs_total": 4,
+            "dispatches_total": 4, "ambient_total": 0, "jits_total": 1,
+            "retraces_total": 0, "window": _window(b), "ring": []}
+
+
+# -- megabatch projection ----------------------------------------------------
+
+
+def test_megabatch_rows_projection_math():
+    # 4 calls, 12 docs, mean cap 128 docs/dispatch -> 1 projected call
+    (r,) = dispatchplane.megabatch_rows(_window({
+        "apply:128x64": {"calls": 4, "docs": 12, "docs_cap": 512,
+                         "logical": 48, "padded": 32768,
+                         "wall_s": 0.01}}))
+    assert r["bucket"] == "apply:128x64"
+    assert r["docs_cap_mean"] == 128.0
+    assert r["projected_calls"] == 1
+    assert r["dispatches_saved"] == 3
+    assert r["occupancy_pct"] == pytest.approx(100 * 12 / 512, abs=0.01)
+    assert r["projected_occupancy_pct"] == pytest.approx(100 * 12 / 128,
+                                                         abs=0.01)
+    assert r["pad_waste_pct"] == pytest.approx(100 * (1 - 48 / 32768),
+                                               abs=0.01)
+
+
+def test_megabatch_rows_rank_and_skip_uncapped():
+    rows = dispatchplane.megabatch_rows(_window({
+        "small": {"calls": 2, "docs": 2, "docs_cap": 4,
+                  "logical": 2, "padded": 8, "wall_s": 0.001},
+        "big": {"calls": 8, "docs": 8, "docs_cap": 256,
+                "logical": 8, "padded": 1024, "wall_s": 0.01},
+        "nocap": {"calls": 3, "docs": 3, "docs_cap": 0,
+                  "logical": 3, "padded": 8, "wall_s": 0.002}}))
+    assert [r["bucket"] for r in rows] == ["big", "small"]
+    assert rows[0]["dispatches_saved"] == 7
+
+
+# -- section plumbing --------------------------------------------------------
+
+
+def test_sections_from_snapshot_and_merge_collisions():
+    snap = {"dispatchledger": {"nodes": {"local": _section("local")}}}
+    a = dispatchplane.sections_from_snapshot(snap)
+    assert list(a) == ["local"]
+    assert dispatchplane.sections_from_snapshot({}) == {}
+    merged = dispatchplane.merge_sections([a, a, a])
+    assert sorted(merged) == ["local", "local#2", "local#3"]
+
+
+# -- report rendering --------------------------------------------------------
+
+
+def test_report_lines_carry_rollup_and_projection():
+    sec = _section("nodeA")
+    sec["window"]["kernels"] = {
+        "apply": {"calls": 4, "host": 1, "device": 3, "wall_s": 0.01,
+                  "jits": 1, "retraces": 0, "logical": 48,
+                  "padded": 32768}}
+    text = "\n".join(dispatchplane.report_lines("nodeA", sec))
+    assert "# perf dispatch — nodeA" in text
+    assert "amplification 2.00x" in text
+    assert "pad waste 75.0%" in text
+    assert "apply" in text
+    assert "megabatch opportunity" in text
+    assert "4 disp ->    1" in text
+    assert "projected: 4 -> 1 dispatch(es) (75.0% fewer)" in text
+
+
+def test_report_lines_empty_window_notes_ambient_only():
+    sec = _section("n", buckets={})
+    text = "\n".join(dispatchplane.report_lines("n", sec))
+    assert "no routed calls in the window" in text
+
+
+# -- CLI modes ---------------------------------------------------------------
+
+
+def test_main_post_mortem_snapshot_and_json(tmp_path, capsys):
+    snap = {"dispatchledger": {"nodes": {"pm": _section("pm")}}}
+    p = tmp_path / "snap.json"
+    p.write_text(json.dumps(snap))
+    assert dispatchplane.main(["--post-mortem", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "# perf dispatch — pm" in out
+    assert dispatchplane.main(["--post-mortem", str(p), "--json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["pm"]["megabatch"][0]["projected_calls"] == 1
+
+
+def test_main_post_mortem_detail_keys_by_config(tmp_path, capsys):
+    detail = {"configs": {"17": {"metrics": {
+        "dispatchledger": {"nodes": {"b0": _section("b0")}}}}}}
+    p = tmp_path / "BENCH_DETAIL.json"
+    p.write_text(json.dumps(detail))
+    assert dispatchplane.main(["--post-mortem", str(p)]) == 0
+    assert "config 17 @ b0" in capsys.readouterr().out
+
+
+def test_main_missing_path_is_friendly(tmp_path, capsys):
+    missing = tmp_path / "nope.json"
+    assert dispatchplane.main(["--post-mortem", str(missing)]) == 0
+    assert "nothing to report" in capsys.readouterr().out
+
+
+def test_main_local_without_data_reports_none(capsys):
+    assert dispatchplane.main(["--local"]) == 0
+    assert "no dispatch-ledger data" in capsys.readouterr().out
+
+
+def test_smoke_run_asserts_ledger_account():
+    assert dispatchplane.smoke_run(n_docs=6, rounds=2, verbose=False) == 0

@@ -249,3 +249,95 @@ def test_hash_only_path_unaffected():
     r = ResidentDocSet(["d"])
     h = r.apply_and_reconcile({"d": base._doc.opset.get_missing_changes({})})
     assert isinstance(h, np.ndarray) and h.shape == (1,)
+
+
+# ---------------------------------------------------------------------------
+# move-plane diffs (r17 satellite: the diff stream used to FILTER move loc
+# fields — now it emits location updates, and the docs-major materialize
+# renders the single-location view the mirror converges to)
+
+
+@pytest.mark.parametrize("native", [None, False])
+def test_map_move_diffs_relocate_child(native):
+    """A map move arrives as ordinary vocabulary — `remove` at the old
+    parent key plus `set {link: True}` at the destination — and a chained
+    move in a later round re-homes the child again."""
+    base = am.change(am.init("A"), lambda d: am.assign(
+        d, {"src": {"child": {"x": 1}}, "dst": {}}))
+    tr = _Tracker(["d"], native=native)
+    tr.round({"d": base._doc.opset.get_missing_changes({})})
+    tr.check("d")
+
+    new = am.change(base, lambda d: d["src"].move("child", d["dst"], "kid"))
+    _, diffs = tr.round({"d": _delta(base, new)})
+    acts = [(r["action"], r.get("key")) for r in diffs["d"]]
+    assert ("remove", "child") in acts and ("set", "kid") in acts
+    setrec = next(r for r in diffs["d"] if r["action"] == "set")
+    assert setrec["link"] is True
+    tr.check("d")
+    assert tr.mirrors["d"].snapshot(ROOT_ID)["data"] == {
+        "src": {}, "dst": {"kid": {"x": 1}}}
+
+    # chained move: dst.kid -> root.home
+    prev, new = new, am.change(new, lambda d: d["dst"].move("kid", d, "home"))
+    _, diffs = tr.round({"d": _delta(prev, new)})
+    acts = [(r["action"], r.get("key")) for r in diffs["d"]]
+    assert ("remove", "kid") in acts and ("set", "home") in acts
+    tr.check("d")
+    assert tr.mirrors["d"].snapshot(ROOT_ID)["data"] == {
+        "src": {}, "dst": {}, "home": {"x": 1}}
+
+
+def test_same_round_create_and_move():
+    """When the creating link and the move land in one round, the stale
+    base link is suppressed (single-location rule) instead of paired with
+    a remove — the mirror never sees the child at two homes."""
+    base = am.change(am.init("A"), lambda d: am.assign(
+        d, {"src": {"child": {"x": 1}}, "dst": {}}))
+    new = am.change(base, lambda d: d["src"].move("child", d["dst"], "kid"))
+    tr = _Tracker(["d"])
+    _, diffs = tr.round({"d": new._doc.opset.get_missing_changes({})})
+    tr.check("d")
+    snap = tr.mirrors["d"].snapshot(ROOT_ID)["data"]
+    assert snap == {"src": {}, "dst": {"kid": {"x": 1}}}
+    # no remove was needed: the base link never surfaced
+    assert not any(r["action"] == "remove" for r in diffs["d"])
+
+
+def test_concurrent_map_moves_match_oracle():
+    """Two replicas move the same child from the same context: the engine's
+    diff stream, its materialize, and the interpretive oracle all pick the
+    same single winner destination."""
+    from automerge_tpu import api
+
+    base = am.change(am.init("A"), lambda d: am.assign(
+        d, {"src": {"child": {"x": 1}}, "p": {}, "q": {}}))
+    forkB = am.merge(am.init("B"), base)
+    a2 = am.change(base, lambda d: d["src"].move("child", d["p"], "ka"))
+    b2 = am.change(forkB, lambda d: d["src"].move("child", d["q"], "kb"))
+    merged = am.merge(a2, b2)
+
+    tr = _Tracker(["d"])
+    tr.round({"d": base._doc.opset.get_missing_changes({})})
+    tr.round({"d": merged._doc.opset.get_missing_changes(
+        base._doc.opset.clock)})
+    tr.check("d")
+    assert tr.mirrors["d"].snapshot(ROOT_ID)["data"] == api.inspect(merged)
+
+
+def test_list_move_emits_explicit_record():
+    """List moves ship an explicit `move` record (engine element ranks are
+    move-agnostic by design); the mirror deliberately ignores it and stays
+    in lockstep with the engine's materialize."""
+    base = am.change(am.init("A"), lambda d: am.assign(d, {"xs": [10, 20, 30]}))
+    tr = _Tracker(["d"])
+    tr.round({"d": base._doc.opset.get_missing_changes({})})
+    new = am.change(base, lambda d: d["xs"].move(0, 2))
+    _, diffs = tr.round({"d": _delta(base, new)})
+    movs = [r for r in diffs["d"] if r["action"] == "move"]
+    assert len(movs) == 1
+    rec = movs[0]
+    assert rec["type"] == "list"
+    assert rec["elem"].startswith("A:") and rec["anchor"].startswith("A:")
+    assert isinstance(rec["counter"], int)
+    tr.check("d")   # mirror == engine materialize (both move-agnostic)

@@ -10,7 +10,8 @@ import pytest
 
 from automerge_tpu.perf import slo
 from automerge_tpu.perf.fleet import FleetCollector
-from automerge_tpu.perf.top import hot_doc_lines, render, spark
+from automerge_tpu.perf.top import (dispatch_lines, hot_doc_lines, render,
+                                    spark)
 from automerge_tpu.utils import flightrec, metrics
 
 
@@ -24,7 +25,7 @@ def _clean_metrics():
 
 
 def _snap(ops=0, flush_s=0.0, flush_n=0, lockw=0.0, drops=0, conv=None,
-          docledger=None):
+          docledger=None, dispatchledger=None):
     out = {
         "sync_ops_ingested": ops,
         "sync_frames_dropped": drops,
@@ -40,6 +41,8 @@ def _snap(ops=0, flush_s=0.0, flush_n=0, lockw=0.0, drops=0, conv=None,
                          "p99_s": conv, "max_s": conv}}}
     if docledger is not None:
         out["docledger"] = docledger
+    if dispatchledger is not None:
+        out["dispatchledger"] = dispatchledger
     return out
 
 
@@ -62,7 +65,26 @@ def _ledger_section(doc, lag_changes, lag_s, behind="w", buffered=0,
             "behind_since": None, "behind_peer": behind, "peers": {}}}}}}
 
 
-def _three_node_collector(straggler_conv=2.0, docledger=None):
+def _dispatch_section(label="y", amp=6.5, waste=88.2, dispatches=13,
+                      ambient=0, rounds=2, bucket="rows_apply:128x128",
+                      padded=16384):
+    return {"nodes": {label: {
+        "label": label, "rounds_total": rounds,
+        "dispatches_total": dispatches, "ambient_total": ambient,
+        "window": {
+            "rounds": rounds, "dispatches": dispatches,
+            "ambient": ambient, "dirty_docs": 2,
+            "amplification": amp, "pad_waste_pct": waste,
+            "dispatches_per_round": (dispatches / rounds if rounds
+                                     else None),
+            "buckets": {bucket: {"calls": dispatches, "docs": 2,
+                                 "docs_cap": 128, "logical": 2,
+                                 "padded": padded, "wall_s": 0.01}},
+        }, "ring": []}}}
+
+
+def _three_node_collector(straggler_conv=2.0, docledger=None,
+                          dispatchledger=None):
     c = FleetCollector(interval_s=0.02, min_nodes=3)
     c.add_local("a", _scripted(_snap(), _snap(ops=60, flush_s=0.06,
                                               flush_n=30, conv=0.01)),
@@ -73,7 +95,8 @@ def _three_node_collector(straggler_conv=2.0, docledger=None):
     c.add_local("x", _scripted(_snap(), _snap(ops=10, flush_s=4.0,
                                               flush_n=10,
                                               conv=straggler_conv,
-                                              docledger=docledger)),
+                                              docledger=docledger,
+                                              dispatchledger=dispatchledger)),
                 role="peer")
     c.scrape_once()
     time.sleep(0.02)
@@ -194,6 +217,51 @@ def test_hot_doc_panel_ranks_and_caps():
     assert len(lines) == 1 + 3
     # worst lag first
     assert "doc7" in lines[1] and "doc6" in lines[2] and "doc5" in lines[3]
+
+
+# -- dispatch-waste band (the dispatchledger panel, r17) ---------------------
+
+
+def test_dispatch_band_renders_ledger_rows():
+    sec = _dispatch_section(label="y", amp=6.5, waste=88.2,
+                            dispatches=13, rounds=2,
+                            bucket="rows_apply:128x128")
+    c = _three_node_collector(dispatchledger=sec)
+    lines = render(c)
+    text = "\n".join(lines)
+    assert "dispatch waste (amplification; `perf dispatch`):" in text
+    row = next(line for line in lines if "rows_apply:128x128" in line)
+    assert "amp" in row and "6.50x" in row
+    assert "waste" in row and "88.2%" in row
+    assert "13 disp/2 rnd" in row
+    assert "worst rows_apply:128x128" in row
+
+
+def test_dispatch_band_absent_without_ledger():
+    c = _three_node_collector()
+    assert dispatch_lines(c) == []
+    assert not any("dispatch waste" in line for line in render(c))
+    # a ledger section with an empty window disappears the same way
+    empty = _dispatch_section(dispatches=0, ambient=0)
+    c2 = _three_node_collector(dispatchledger=empty)
+    assert dispatch_lines(c2) == []
+
+
+def test_dispatch_band_ranks_and_caps():
+    nodes = {}
+    for k in range(8):
+        nodes[f"n{k}"] = _dispatch_section(
+            label=f"n{k}", amp=float(k), dispatches=k + 1,
+            bucket=f"fam:{k}")["nodes"][f"n{k}"]
+    sec = {"nodes": nodes}
+    c = FleetCollector(interval_s=0.01, min_nodes=3)
+    c.add_local("hub", _scripted(_snap(dispatchledger=sec)))
+    c.scrape_once()
+    lines = dispatch_lines(c, limit=3)
+    assert len(lines) == 1 + 3 + 1       # header + rows + overflow note
+    # worst amplification first
+    assert "n7" in lines[1] and "n6" in lines[2] and "n5" in lines[3]
+    assert "+5 more ledger node(s)" in lines[4]
 
 
 def test_render_width_clamp():
