@@ -137,15 +137,19 @@ class _Round:
     """One open flush round: thread-local call accumulator."""
 
     __slots__ = ("label", "dirty_docs", "calls", "dropped", "ambient",
-                 "self_s")
+                 "self_s", "tenants")
 
-    def __init__(self, dirty_docs, label):
+    def __init__(self, dirty_docs, label, tenants=None):
         self.label = label
         self.dirty_docs = int(dirty_docs)
         self.calls: list[_Call] = []
         self.dropped = 0        # calls past CALL_CAP (counted, undetailed)
         self.ambient = 0        # jit dispatches with no call scope open
         self.self_s = 0.0
+        # per-tenant dirty-doc counts (sync/tenantledger.round_tenants);
+        # None when the tenant plane is disabled — the folded round then
+        # stays byte-identical with pre-tenancy exports
+        self.tenants = tenants
 
 
 class _Tls(threading.local):
@@ -401,7 +405,8 @@ class _RoundScope:
 
     __slots__ = ("_rd", "_nested")
 
-    def __init__(self, dirty_docs: int, label: str | None = None):
+    def __init__(self, dirty_docs: int, label: str | None = None,
+                 tenants: dict | None = None):
         self._rd = None
         self._nested = False
         if not enabled():
@@ -410,7 +415,7 @@ class _RoundScope:
         if _tls.round is not None:
             self._nested = True
             return
-        self._rd = _tls.round = _Round(dirty_docs, label)
+        self._rd = _tls.round = _Round(dirty_docs, label, tenants)
         self._rd.self_s += time.perf_counter() - t0
 
     def __enter__(self):
@@ -431,10 +436,24 @@ class _RoundScope:
             folded["dirty_docs"] = rd.dirty_docs
             if rd.label:
                 folded["label"] = rd.label
+            if rd.tenants:
+                folded["tenants"] = dict(rd.tenants)
             amp = ((folded["dispatches"] + folded["ambient"])
                    / rd.dirty_docs if rd.dirty_docs else None)
             led._fold_round_locked(folded)
             led._self_s += (rd.self_s + time.perf_counter() - t0)
+        if rd.tenants:
+            # the tenant attribution plane's dispatch/padding-share feed
+            # (sync/tenantledger.py note_round): this round's folded cost
+            # is divided by who dirtied the batch. Lazy import — the
+            # tenant ledger lives in the sync layer and only ever reaches
+            # back here through this optional hand-off.
+            try:
+                from ..sync import tenantledger
+                tenantledger.note_round(rd.tenants, folded,
+                                        label=rd.label)
+            except Exception:
+                pass
         try:
             from ..utils import flightrec
             flightrec.record("dispatch_round", round=seq,
@@ -446,8 +465,9 @@ class _RoundScope:
         return False
 
 
-def round_scope(dirty_docs: int, label: str | None = None) -> _RoundScope:
-    return _RoundScope(dirty_docs, label)
+def round_scope(dirty_docs: int, label: str | None = None,
+                tenants: dict | None = None) -> _RoundScope:
+    return _RoundScope(dirty_docs, label, tenants=tenants)
 
 
 class _CallScope:

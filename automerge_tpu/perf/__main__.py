@@ -1,8 +1,9 @@
 """CLI for the performance plane: `python -m automerge_tpu.perf
-{report,check,contention,doctor,explain,top,dispatch,remediate,roofline,
-resident}` (docs/OBSERVABILITY.md "Performance plane" / "Contention &
-convergence lag" / "Fleet health" / "Per-doc ledger & perf explain" /
-"Remediation plane" / "Dispatch-efficiency ledger").
+{report,check,contention,doctor,explain,top,dispatch,tenant,remediate,
+roofline,resident}` (docs/OBSERVABILITY.md "Performance plane" /
+"Contention & convergence lag" / "Fleet health" / "Per-doc ledger &
+perf explain" / "Remediation plane" / "Dispatch-efficiency ledger" /
+"Tenant attribution plane").
 
 - `doctor`  — ranked root-cause report: live against a fleet
   (--connect), or post-mortem against a BENCH_DETAIL.json / flight-
@@ -19,6 +20,10 @@ convergence lag" / "Fleet health" / "Per-doc ledger & perf explain" /
   ledger (engine/dispatchledger.py): amplification, padding waste,
   per-kernel attribution, and the megabatch-opportunity projection.
   Same three modes as the doctor, plus `--smoke` (verify.sh stage 2).
+- `tenant`  — per-tenant cost/latency/isolation report over the tenant
+  attribution plane (sync/tenantledger.py): ingress/dispatch/wire
+  shares, governor shed splits, converge-lag rings, and the
+  attribution-sum check. Same modes as `dispatch`, plus `--smoke`.
 - `remediate` — the chaos-recovery smoke (verify.sh stage 2): injects
   one conn_kill into a supervised TCP link and asserts the fleet
   self-heals (perf/remediate.py).
@@ -188,6 +193,9 @@ def main(argv=None) -> int:
     if cmd == "dispatch":
         from . import dispatchplane
         return dispatchplane.main(rest)
+    if cmd == "tenant":
+        from . import tenantplane
+        return tenantplane.main(rest)
     if cmd == "remediate":
         # the chaos-recovery smoke (verify.sh stage 2): one injected
         # fault, assert the supervised link self-heals
@@ -213,7 +221,7 @@ def main(argv=None) -> int:
         return 0
     print(f"unknown command {cmd!r}; expected one of "
           "report, check, contention, doctor, explain, top, dispatch, "
-          "remediate, move, bootstrap, roofline, resident",
+          "tenant, remediate, move, bootstrap, roofline, resident",
           file=sys.stderr)
     return 2
 

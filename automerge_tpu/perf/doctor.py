@@ -44,6 +44,13 @@ Cause classes (stable identifiers — the bench asserts on them):
                      padding-waste evidence — the regime ROADMAP #2's
                      megabatching collapses; `perf dispatch` prints the
                      opportunity report (r17 dispatch ledger)
+    tenant_hot       one tenant dominates the fleet's ingress/dispatch
+                     shares while OTHER tenants' converge-p99 degrades
+                     (the tenantledger section) — the noisy-neighbor
+                     regime ROADMAP #5's per-tenant QoS ladder divides;
+                     the evidence names the hot tenant's shares and the
+                     degraded victims, and `perf tenant` prints the full
+                     attribution report (r18 tenant plane)
 
 CLI: `python -m automerge_tpu.perf doctor [--post-mortem PATH]
 [--config N] [--json] [--connect host:port,... --ticks N]`. With no
@@ -297,6 +304,44 @@ def diagnose_snapshot(snapshot: dict, label: str = "snapshot",
                   "report")
         _cause(causes, "dispatch_amplification", None,
                float(w.get("wall_s") or amp), ev)
+
+    # tenant-isolation join (sync/tenantledger.py): one tenant owning
+    # most of the ingress/dispatch shares while OTHER tenants' converge
+    # p99 degrades is the noisy-neighbor regime — the evidence names the
+    # perpetrator AND the victims, which is what makes it actionable
+    for sec in ((snapshot.get("tenantledger") or {}).get("nodes")
+                or {}).values():
+        tenants = (sec or {}).get("tenants") or {}
+        if len(tenants) < 2:
+            continue
+        ranked = sorted(tenants.items(),
+                        key=lambda kv: -(kv[1].get("ingress_share_pct")
+                                         or 0.0))
+        hot_id, hot = ranked[0]
+        share = hot.get("ingress_share_pct") or 0.0
+        # "dominates" = more than twice the even split of this tenant
+        # population (and at least half the fleet's ingress)
+        if share < max(50.0, 200.0 / len(tenants)):
+            continue
+        victims = [(tid, (t.get("lag") or {}).get("p99_s"))
+                   for tid, t in ranked[1:]
+                   if isinstance((t.get("lag") or {}).get("p99_s"),
+                                 (int, float))
+                   and (t.get("lag") or {}).get("p99_s") > 0.05]
+        if not victims:
+            continue
+        ev = [f"tenant {hot_id!r} holds {share:.1f}% of fleet ingress "
+              f"({hot.get('admitted')} change(s)), dispatch share "
+              f"{hot.get('dispatch_share')}"]
+        ev.extend(f"tenant {tid!r} converge p99 {p99:.3f}s under the "
+                  "hot neighbor" for tid, p99 in victims[:3])
+        inj = snapshot.get("obs_chaos_injected{fault=tenant_storm}", 0)
+        if inj:
+            ev.append(f"{int(inj)} injected tenant_storm fault(s) "
+                      "disclosed — chaos run, not an organic hot tenant")
+        ev.append("run `perf tenant` for the full attribution report")
+        _cause(causes, "tenant_hot", None,
+               share / 100.0 + sum(p99 for _, p99 in victims), ev)
 
     retraced = sum(v for k, v in snapshot.items()
                    if isinstance(v, (int, float))

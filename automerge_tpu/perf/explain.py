@@ -282,14 +282,21 @@ def explain_doc(doc_id: str, views: dict, now: float | None = None) -> dict:
 
 
 def hot_docs(views: dict, limit: int = 8,
-             now: float | None = None) -> list[dict]:
+             now: float | None = None,
+             tenant: str | None = None) -> list[dict]:
     """The worst-lagging (doc, node) rows across every view — the
     no-argument CLI listing, the doctor's per-doc join, and perf top's
-    panel feed. Converged docs are excluded."""
+    panel feed. Converged docs are excluded; `tenant` restricts the list
+    to docs resolving to that tenant id (the `--tenant` CLI filter,
+    sync/tenantledger.py derivation)."""
     now = views_asof(views) if now is None else now
     rows = []
     for label, view in views.items():
         for d, e in (view.get("docs") or {}).items():
+            if tenant is not None:
+                from ..sync.tenantledger import tenant_of
+                if tenant_of(d) != tenant:
+                    continue
             deficit = int(e.get("lag_changes") or 0)
             buffered = int(e.get("buffered") or 0)
             if deficit <= 0 and not buffered:
@@ -321,7 +328,13 @@ def hot_docs(views: dict, limit: int = 8,
 
 
 def report_lines(report: dict) -> list[str]:
-    lines = [f"# perf explain — doc {report['doc']!r}"]
+    # resolved tenant in the header (sync/tenantledger.py prefix rule):
+    # pure derivation from the doc id, so it names the account even for
+    # docs no ledger has seen
+    from ..sync import tenantledger
+    tenant = (f" [tenant {tenantledger.tenant_of(report['doc'])}]"
+              if tenantledger.enabled() else "")
+    lines = [f"# perf explain — doc {report['doc']!r}{tenant}"]
     if not report["seen"]:
         lines.append("  doc not present in any visible ledger (idle, "
                      "evicted to the aggregate bucket, or the node "
@@ -349,11 +362,15 @@ def report_lines(report: dict) -> list[str]:
     return lines
 
 
-def hot_lines(views: dict, limit: int = 8) -> list[str]:
-    rows = hot_docs(views, limit=limit)
+def hot_lines(views: dict, limit: int = 8,
+              tenant: str | None = None) -> list[str]:
+    rows = hot_docs(views, limit=limit, tenant=tenant)
+    scope = f" [tenant {tenant}]" if tenant is not None else ""
     if not rows:
-        return ["# perf explain — no lagging docs in any visible ledger"]
-    lines = ["# perf explain — hot docs (worst converge lag first)"]
+        return ["# perf explain — no lagging docs in any visible "
+                f"ledger{scope}"]
+    lines = ["# perf explain — hot docs (worst converge lag first)"
+             + scope]
     for r in rows:
         lines.append(
             f"  {r['doc']!r} @ {r['node']}: {r['lag_changes']} change(s)"
@@ -434,6 +451,10 @@ def main(argv=None) -> int:
                          "the ledger's export_k, which honors "
                          "AMTPU_DOCLEDGER_K); also raises the hot-list "
                          "row limit")
+    ap.add_argument("--tenant", default=None, metavar="ID",
+                    help="restrict the hot list to docs resolving to "
+                         "this tenant id (sync/tenantledger.py prefix "
+                         "rule; no-doc mode only)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
     if args.k is not None:
@@ -465,9 +486,11 @@ def main(argv=None) -> int:
             if args.json:
                 out_json.append({"set": label,
                                  "hot": hot_docs(views,
-                                                 limit=args.limit)})
+                                                 limit=args.limit,
+                                                 tenant=args.tenant)})
             else:
-                lines = hot_lines(views, limit=args.limit)
+                lines = hot_lines(views, limit=args.limit,
+                                  tenant=args.tenant)
                 if label and len(view_sets) > 1:
                     lines[0] += f" [{label}]"
                 print("\n".join(lines))

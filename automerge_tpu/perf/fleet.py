@@ -152,6 +152,20 @@ def extract_features(snapshot: dict) -> dict:
             v = w.get(src)
             if isinstance(v, (int, float)):
                 out[key] = max(float(v), out.get(key, 0.0))
+    # tenant attribution rollups (sync/tenantledger.py): worst per-tenant
+    # converge p99 and hottest ingress share this node sees — the
+    # tenant_converge_p99 SLO feed and the noisy-neighbor headline
+    for sec in ((snapshot.get("tenantledger") or {}).get("nodes")
+                or {}).values():
+        for t in ((sec or {}).get("tenants") or {}).values():
+            p99 = (t.get("lag") or {}).get("p99_s")
+            if isinstance(p99, (int, float)):
+                out["tenant_converge_p99_s"] = max(
+                    float(p99), out.get("tenant_converge_p99_s", 0.0))
+            share = t.get("ingress_share_pct")
+            if isinstance(share, (int, float)):
+                out["tenant_hot_share_pct"] = max(
+                    float(share), out.get("tenant_hot_share_pct", 0.0))
     return out
 
 
@@ -525,7 +539,13 @@ class FleetCollector:
                                            "max"),
             "dispatch_pad_waste_pct": _agg("dispatch_pad_waste_pct",
                                            "max"),
+            "tenant_converge_p99_s": _agg("tenant_converge_p99_s",
+                                          "max"),
+            "tenant_hot_share_pct": _agg("tenant_hot_share_pct", "max"),
         }
+        tenants = self._tenant_rollup()
+        if tenants:
+            rollup["tenants"] = tenants
         self._last_state = {
             "at": now,
             "rollup": rollup,
@@ -545,6 +565,48 @@ class FleetCollector:
             "scrape": self.scrape_stats(),
         }
         return self._last_state
+
+    def _tenant_rollup(self) -> dict:
+        """Fleet-wide per-tenant merge over every scraped node's
+        `"tenantledger"` section (sync/tenantledger.py): cost counters
+        SUM across nodes (each node accounts its own traffic exactly
+        once), converge p99 takes the worst node, and the ingress share
+        is recomputed from the merged totals — so one hot tenant on one
+        shard still reads hot fleet-wide. Empty when no node ships the
+        section."""
+        merged: dict[str, dict] = {}
+        total = 0
+        for st in self.nodes.values():
+            snap = st.last_snapshot
+            if not isinstance(snap, dict):
+                continue
+            for sec in ((snap.get("tenantledger") or {}).get("nodes")
+                        or {}).values():
+                for tid, t in ((sec or {}).get("tenants") or {}).items():
+                    m = merged.setdefault(tid, {
+                        "admitted": 0, "bytes_sent": 0,
+                        "bytes_received": 0, "dispatch_share": 0.0,
+                        "shed": 0, "converge_p99_s": None})
+                    m["admitted"] += int(t.get("admitted") or 0)
+                    m["bytes_sent"] += int(t.get("bytes_sent") or 0)
+                    m["bytes_received"] += int(t.get("bytes_received")
+                                               or 0)
+                    m["dispatch_share"] += float(t.get("dispatch_share")
+                                                 or 0.0)
+                    m["shed"] += (int(t.get("shed_dropped") or 0)
+                                  + int(t.get("shed_delayed") or 0))
+                    p99 = (t.get("lag") or {}).get("p99_s")
+                    if isinstance(p99, (int, float)):
+                        cur = m["converge_p99_s"]
+                        m["converge_p99_s"] = (float(p99) if cur is None
+                                               else max(cur, float(p99)))
+                    total += int(t.get("admitted") or 0)
+        for m in merged.values():
+            m["dispatch_share"] = round(m["dispatch_share"], 4)
+            m["ingress_share_pct"] = (
+                round(100.0 * m["admitted"] / total, 3) if total
+                else None)
+        return merged
 
     # -- read surface ---------------------------------------------------------
 

@@ -120,6 +120,16 @@ LEDGER_BUDGET_PCT = 2.0
 #: flush path it measures is the workload, not observability.
 DISPATCH_LEDGER_BUDGET_PCT = 2.0
 
+#: tenant-attribution-plane gates (r18, config 18). Both ABSOLUTE —
+#: properties of the tenantledger code, not of the traffic mix:
+#: the tenant ledger's duty cycle (hook self time / traffic wall) must
+#: stay under the same 2% bound every other ledger honors,
+TENANT_LEDGER_BUDGET_PCT = 2.0
+#: and the per-tenant shares must sum back to the fleet totals within
+#: this percentage — attribution that leaks cost is worse than none,
+#: because it assigns blame that does not add up.
+TENANT_ATTRIBUTION_ERR_MAX_PCT = 1.0
+
 #: partial-replication gates (r12, config 13). All ABSOLUTE — each is a
 #: property of the subscription/relay code, not of the host:
 #: relay-tree total fan-out bytes must grow sublinearly in subscriber
@@ -353,7 +363,21 @@ def _norm_configs(raw) -> dict:
                                        "megabatch_dispatches_current",
                                        "megabatch_dispatches_projected",
                                        "megabatch_savings_pct",
-                                       "megabatch_worst_bucket")
+                                       "megabatch_worst_bucket",
+                                       # the tenant attribution plane
+                                       # (r18, config 18): hot-tenant
+                                       # shares, quiet-tenant p99
+                                       # degradation, attribution sum,
+                                       # ledger duty cycle, disabled-
+                                       # path parity
+                                       "hot_tenant",
+                                       "hot_ingress_share_pct",
+                                       "quiet_p99_base_s",
+                                       "quiet_p99_hot_s",
+                                       "quiet_p99_degradation_x",
+                                       "tenant_attribution_err_pct",
+                                       "tenant_ledger_overhead_pct",
+                                       "tenant_disabled_parity")
                      if isinstance(v.get(k), (int, float, str))}
         elif isinstance(v, (int, float)):
             entry = {"speedup": v}
@@ -1000,6 +1024,55 @@ def check(path: str | None = None, record: dict | None = None,
                          "dispatches")
         lines.append("  dispatch baseline (ROADMAP #2 divides these): "
                      + "; ".join(extra))
+
+    # tenant-plane gates (r18, config 18): the tenant ledger's own duty
+    # cycle must stay under its ABSOLUTE budget (TENANT_LEDGER_BUDGET_PCT
+    # — a property of the hook code, like the doc/dispatch ledgers'
+    # bounds), the per-tenant shares must sum back to the fleet totals
+    # within TENANT_ATTRIBUTION_ERR_MAX_PCT, and the disabled path must
+    # have proved behavior parity in-run. The quiet-tenant p99
+    # degradation is reported alongside — it is the BASELINE isolation
+    # number ROADMAP #5's per-tenant work exists to shrink, so it
+    # informs rather than gates. Skip-clean: runs without config 18
+    # never fail.
+    def _tn(r: dict):
+        return ((r.get("configs") or {}).get("18") or {})
+
+    cur_tp = _tn(current).get("tenant_ledger_overhead_pct")
+    if isinstance(cur_tp, (int, float)):
+        verdict = ("OK" if cur_tp <= TENANT_LEDGER_BUDGET_PCT
+                   else "TENANT LEDGER OVER BUDGET")
+        lines.append(
+            f"  tenant-ledger duty cycle (config 18): {cur_tp:.3f}% "
+            f"(budget <= {TENANT_LEDGER_BUDGET_PCT}%) -> {verdict}")
+        if cur_tp > TENANT_LEDGER_BUDGET_PCT:
+            rc = 1
+    terr = _tn(current).get("tenant_attribution_err_pct")
+    if isinstance(terr, (int, float)):
+        verdict = ("OK" if terr <= TENANT_ATTRIBUTION_ERR_MAX_PCT
+                   else "ATTRIBUTION DOES NOT SUM TO FLEET TOTALS")
+        lines.append(
+            f"  tenant attribution error (config 18): {terr:.3f}% "
+            f"(bound <= {TENANT_ATTRIBUTION_ERR_MAX_PCT}%) -> {verdict}")
+        if terr > TENANT_ATTRIBUTION_ERR_MAX_PCT:
+            rc = 1
+    tpar = _tn(current).get("tenant_disabled_parity")
+    if tpar is not None:
+        lines.append("  tenant-ledger disabled-path parity: "
+                     + ("OK (byte-equal hashes, zero tenants recorded)"
+                        if tpar else "DIVERGED"))
+        if not tpar:
+            rc = 1
+    qd = _tn(current).get("quiet_p99_degradation_x")
+    if isinstance(qd, (int, float)):
+        hot_t = _tn(current).get("hot_tenant")
+        hot_sh = _tn(current).get("hot_ingress_share_pct")
+        extra = [f"quiet-tenant p99 degradation x{qd}"]
+        if isinstance(hot_sh, (int, float)):
+            extra.append(f"hot tenant '{hot_t}' at "
+                         f"{hot_sh:.1f}% ingress share")
+        lines.append("  tenant isolation baseline (ROADMAP #5 shrinks "
+                     "this): " + "; ".join(extra))
 
     # keystroke-flatness gate (r8, config 7): latency at 4x document
     # length over 1x must stay under the ceiling. A RATIO is

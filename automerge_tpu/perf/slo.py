@@ -36,7 +36,14 @@ The five defaults mirror the plane's acceptance bar:
   dispatch ledger's window rollup) stays under bound — the number
   ROADMAP #2's megabatching must divide, judged here so a regression
   into dispatch-per-doc behavior breaches before it becomes a latency
-  incident.
+  incident;
+- `tenant_converge_p99`: the WORST per-tenant converge p99 across the
+  fleet (sync/tenantledger.py lag rings) stays under bound — the
+  isolation objective: one tenant's storm must not ride another
+  tenant's latency budget. `tenant_slos()` expands the same objective
+  into one named SLO per tenant (signal `tenant:<id>:converge_p99_s`,
+  read from the rollup's per-tenant merge) for fleets that pin
+  specific tenants to specific bounds.
 
 A signal the fleet has not produced yet (no oplag samples, empty
 history) evaluates to verdict None — "no data" is neither ok nor breach,
@@ -68,6 +75,11 @@ RETRACE_ABS_SLACK = 2
 #: means the engine is dispatching per doc, exactly the regime ROADMAP
 #: #2's megabatching exists to collapse
 DEFAULT_DISPATCH_AMPLIFICATION = 8.0
+#: default bound on the worst per-tenant converge p99 (seconds) — the
+#: isolation objective: the same latency bar as the fleet-wide
+#: converge_p99, held PER TENANT so a quiet tenant's breach under a hot
+#: neighbor is visible even while the fleet aggregate stays green
+DEFAULT_TENANT_CONVERGE_P99_S = 2.0
 
 
 class Slo:
@@ -115,7 +127,9 @@ def default_slos(converge_p99_s: float = DEFAULT_CONVERGE_P99_S,
                  scrape_p50_s: float = DEFAULT_SCRAPE_P50_S,
                  retrace_budget: float | None = None,
                  dispatch_amplification: float =
-                 DEFAULT_DISPATCH_AMPLIFICATION) -> list[Slo]:
+                 DEFAULT_DISPATCH_AMPLIFICATION,
+                 tenant_converge_p99_s: float =
+                 DEFAULT_TENANT_CONVERGE_P99_S) -> list[Slo]:
     return [
         Slo("converge_p99", "converge_p99_s", converge_p99_s,
             description="fleet max converge-stage p99 under bound"),
@@ -130,7 +144,29 @@ def default_slos(converge_p99_s: float = DEFAULT_CONVERGE_P99_S,
             dispatch_amplification,
             description="fleet max dispatches per dirty doc under "
                         "bound (engine/dispatchledger.py window)"),
+        Slo("tenant_converge_p99", "tenant_converge_p99_s",
+            tenant_converge_p99_s,
+            description="worst per-tenant converge p99 under bound "
+                        "(sync/tenantledger.py — the isolation "
+                        "objective)"),
     ]
+
+
+def tenant_slos(tenants, bound: float = DEFAULT_TENANT_CONVERGE_P99_S,
+                ) -> list[Slo]:
+    """The per-tenant SLO spec family: one `tenant_converge_p99:<id>`
+    objective per named tenant, each judged against that tenant's own
+    merged converge p99 (the rollup's `tenants` map, perf/fleet.py
+    `_tenant_rollup`). Compose with default_slos():
+
+        SloEngine(slos=default_slos() + tenant_slos(["acme", "globex"]))
+    """
+    return [
+        Slo(f"tenant_converge_p99:{t}", f"tenant:{t}:converge_p99_s",
+            bound,
+            description=f"tenant {t!r} converge p99 under bound "
+                        "(per-tenant isolation)")
+        for t in tenants]
 
 
 class SloEngine:
@@ -165,6 +201,12 @@ class SloEngine:
     def _value(self, slo: Slo, state: dict) -> float | None:
         if slo.signal in ("scrape_p50_s", "scrape_p99_s"):
             v = (state.get("scrape") or {}).get(slo.signal)
+        elif slo.signal.startswith("tenant:"):
+            # per-tenant family (tenant_slos): "tenant:<id>:<field>"
+            # reads from the rollup's merged per-tenant map
+            _, tid, field = slo.signal.split(":", 2)
+            v = (((state.get("rollup") or {}).get("tenants") or {})
+                 .get(tid) or {}).get(field)
         else:
             v = (state.get("rollup") or {}).get(slo.signal)
         if not isinstance(v, (int, float)):

@@ -19,7 +19,11 @@ One screen, three bands (docs/OBSERVABILITY.md "Fleet health"):
   `"dispatchledger"` section (engine/dispatchledger.py): window
   amplification (dispatches per dirty doc), padding-waste %, and the
   biggest padded bucket, with the `perf dispatch` handle for the full
-  megabatch-opportunity report.
+  megabatch-opportunity report;
+- the **tenant band** — per (node, tenant) from the `"tenantledger"`
+  section (sync/tenantledger.py): ingress share, attributed dispatch
+  share, converge-lag p99, and shed counts, hottest share first, with
+  the `perf tenant` handle for the full attribution report.
 
 Keys (tty only): `q` quit · `p` pause/resume scraping ·
 `d` dump a `perf doctor` live report to a file and show the path.
@@ -116,6 +120,7 @@ def render(collector, slo_engine=None, width: int = 100) -> list[str]:
                              f"{_fmt(series[-1], nd=4)}")
     lines.extend(hot_doc_lines(collector))
     lines.extend(dispatch_lines(collector))
+    lines.extend(tenant_lines(collector))
     return [line[:width] for line in lines]
 
 
@@ -195,6 +200,49 @@ def dispatch_lines(collector, limit: int = 5) -> list[str]:
     if len(rows) > limit:
         lines.append(f"  (+{len(rows) - limit} more ledger node(s) — "
                      "run `perf dispatch` for the full report)")
+    return lines
+
+
+def tenant_lines(collector, limit: int = 5) -> list[str]:
+    """The tenant band: one row per (node, tenant) from the
+    `"tenantledger"` snapshot section (sync/tenantledger.py), hottest
+    ingress share first — the at-a-glance noisy-neighbor check. Empty
+    when no scraped node ships the section — the band simply disappears
+    (same contract as the hot-doc and dispatch panels)."""
+    rows = []
+    for st in collector.nodes.values():
+        snap = st.last_snapshot
+        if not isinstance(snap, dict):
+            continue
+        for label, sec in ((snap.get("tenantledger") or {})
+                           .get("nodes") or {}).items():
+            for tid, t in ((sec or {}).get("tenants") or {}).items():
+                lag = t.get("lag") or {}
+                rows.append({
+                    "node": label,
+                    "tenant": tid,
+                    "share": t.get("ingress_share_pct"),
+                    "disp": t.get("dispatch_share"),
+                    "p99": lag.get("p99_s"),
+                    "shed": ((t.get("shed_dropped") or 0)
+                             + (t.get("shed_delayed") or 0)),
+                })
+    if not rows:
+        return []
+    rows.sort(key=lambda r: -(r["share"]
+                              if isinstance(r["share"], (int, float))
+                              else -1.0))
+    lines = ["tenants (ingress share; `perf tenant`):"]
+    for r in rows[:limit]:
+        lines.append(
+            f"  {str(r['tenant'])[:14]:<14} @ {str(r['node'])[:10]:<10} "
+            f"share {_fmt(r['share'], '%', 1):>7} "
+            f"disp {_fmt(r['disp'], nd=1):>8} "
+            f"p99 {_fmt(r['p99'], 's', 4):>9}"
+            + (f"  [{r['shed']} shed]" if r["shed"] else ""))
+    if len(rows) > limit:
+        lines.append(f"  (+{len(rows) - limit} more tenant row(s) — "
+                     "run `perf tenant` for the full report)")
     return lines
 
 

@@ -64,6 +64,7 @@ import time
 import weakref
 
 from ..utils import metrics
+from . import tenantledger
 
 #: exactly-tracked docs per ledger (AMTPU_DOCLEDGER_K)
 DEFAULT_TOP_K = 128
@@ -405,7 +406,11 @@ class DocLedger:
             pv.advert_total = sum(pv.advert_clock.values())
             pv.last_advert_at = now
             self._restamp_lag_locked(e, local, now)
+            lag = e.lag_s
             self._self_s += time.perf_counter() - t0
+        # tenant lane: the freshly restamped converge lag feeds the
+        # per-tenant p99 ring (outside our lock — tenantledger is a leaf)
+        tenantledger.note_lag(doc_id, lag)
 
     def record_send(self, doc_id: str, conn, n_changes: int,
                     nbytes: int | None = None) -> None:
@@ -423,6 +428,8 @@ class DocLedger:
             if nbytes:
                 pv.bytes_sent += int(nbytes)
             self._self_s += time.perf_counter() - t0
+        tenantledger.note_wire(doc_id, sent=int(n_changes or 0),
+                               bytes_sent=int(nbytes or 0))
 
     def record_receive(self, doc_id: str, conn, useful: int, dup: int,
                        nbytes: int | None = None) -> None:
@@ -444,6 +451,8 @@ class DocLedger:
             self._useful += int(useful)
             self._duplicate += int(dup)
             self._self_s += time.perf_counter() - t0
+        tenantledger.note_wire(doc_id, useful=int(useful), dup=int(dup),
+                               bytes_recv=int(nbytes or 0))
 
     def record_drop(self, doc_id: str, conn) -> None:
         """An outgoing change-bearing message for this doc was dropped
@@ -457,6 +466,7 @@ class DocLedger:
                 pv = e.peers[lbl] = _PeerView()
             pv.drops += 1
             self._self_s += time.perf_counter() - t0
+        tenantledger.note_wire(doc_id, drops=1)
 
     def record_sub(self, doc_id: str, conn, subscribed: bool) -> None:
         """This side subscribed (True) or unsubscribed (False) the doc
@@ -633,6 +643,12 @@ class DocLedger:
                 "behind_peer": e.behind_peer,
                 "peers": peers,
             }
+            # tenant label on the lane (r18): derivation only — the
+            # per-tenant aggregates live in the tenantledger section.
+            # Absent when the tenant plane is disabled, so pinned
+            # pre-tenancy exports stay byte-identical.
+            if tenantledger.enabled():
+                docs_out[d]["tenant"] = tenantledger.tenant_of(d)
         pct = self.lag_percentiles()
         return {
             "label": self.label or metrics.node_name() or "local",

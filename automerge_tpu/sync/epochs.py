@@ -53,6 +53,7 @@ import time
 import zlib
 
 from ..utils import flightrec, metrics
+from . import tenantledger
 
 #: stripes per buffer (power of two; bounds stripe-lock contention for
 #: concurrent writers of different docs)
@@ -358,10 +359,12 @@ class IngressGovernor:
             return 0.0
         if self.mode == "shed":
             metrics.bump("sync_shed_dropped")
+            tenantledger.note_shed(doc_id, delayed=False)
             raise IngressShedError(
                 f"ingress for {doc_id!r} shed under sustained "
                 f"converge-p99 breach (bound {self.bound_s}s)")
         metrics.bump("sync_shed_delayed")
+        tenantledger.note_shed(doc_id, delayed=True, delay_s=self.delay_s)
         return self.delay_s
 
 

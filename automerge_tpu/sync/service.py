@@ -34,7 +34,7 @@ from ..engine import dispatchledger
 from ..engine.resident import ResidentDocSet
 from ..engine.resident_rows import CompactionAnchorError, DeviceDispatchError
 from ..utils import chaos, flightrec, lockprof, metrics, oplag, perfscope
-from . import docledger, epochs
+from . import docledger, epochs, tenantledger
 
 
 class _HandleOpSet:
@@ -775,6 +775,7 @@ class EngineDocSet:
                 self._bump_read_vers_locked((doc_id,))
                 if self.doc_ledger is not None:
                     self.doc_ledger.note_admit(doc_id, len(admitted))
+                tenantledger.note_ingress(doc_id, len(admitted))
             records = (diffs or {}).get(doc_id, [])
             if records:
                 from ..engine.diffs import MirrorDoc
@@ -936,8 +937,17 @@ class EngineDocSet:
             d = gov.admit(doc_id)
             if d:
                 _time.sleep(d)
+        # chaos tenant-storm (utils/chaos.py): multiply ONE tenant's
+        # ingress rate by re-appending this batch's columns as extra
+        # un-waited epoch entries — duplicate changes dedup at admission
+        # (actor, seq), so the storm costs real flush/dispatch work
+        # without corrupting state. Inert (one cached check) unless
+        # AMTPU_CHAOS_TENANT_STORM is set.
+        extra = chaos.tenant_storm(self._chaos_node, doc_id)
         tok = oplag.admit(doc_id)
         ticket = self._epoch.append(doc_id, cols, tok, claimed=claimed)
+        for _ in range(extra):
+            self._epoch.append(doc_id, cols, None)
         self._kick_or_flush()
         return ticket
 
@@ -1126,7 +1136,8 @@ class EngineDocSet:
                 dispatchledger.round_scope(
                     len(self._pending),
                     label=(f"shard{self._shard}"
-                           if self._shard is not None else None)):
+                           if self._shard is not None else None),
+                    tenants=tenantledger.round_tenants(self._pending)):
             self._flush_pending_locked()
         if round_docs is not None:
             deltas = None
@@ -1282,6 +1293,9 @@ class EngineDocSet:
             for d in admitted:
                 self.doc_ledger.note_admit(
                     d, sum(int(p.n_changes) for p in pending[d]))
+        for d in admitted:
+            tenantledger.note_ingress(
+                d, sum(int(p.n_changes) for p in pending[d]))
         if self.handlers:
             # no registered handlers -> no notifications to queue: the
             # post-flush drain then needs no service-lock reacquisition

@@ -46,6 +46,16 @@ hooks matching the three failure classes the doctor distinguishes:
   supervisor (sync/tcp.SupervisedTcpClient) brings the link back and
   `resubscribe()` backfills what the dead window missed — the
   remediation plane's acceptance input (bench config 14).
+- **tenant-storm** (`AMTPU_CHAOS_TENANT_STORM=<tenant_id>`, multiplier
+  `AMTPU_CHAOS_TENANT_STORM_X`, default 8): ONE tenant's epoch-path
+  ingress rate is multiplied — every governed append whose doc resolves
+  to the victim tenant (sync/tenantledger.py derivation) is re-appended
+  x-1 extra times as un-waited entries (sync/service.py
+  `_epoch_append`). Duplicate changes dedup at (actor, seq) admission,
+  so document STATE stays byte-identical while the flush/dispatch planes
+  pay the storm for real — the noisy-neighbor fault class the tenant
+  attribution plane (`tenant_hot` doctor cause, bench config 18) must
+  localize without the quiet tenants' telemetry degrading.
 - **peer-hang** (`AMTPU_CHAOS_PEER_HANG_S=<seconds>`, onset
   `AMTPU_CHAOS_PEER_HANG_AFTER=<n>`, default 1): an accepted but
   UNRESPONSIVE peer — for that many seconds from the n-th eligible
@@ -104,7 +114,8 @@ class _Config:
     __slots__ = ("slow_apply_s", "lock_hold_s", "lock_hold_every_s",
                  "drop_frames", "stall_doc_id", "sub_flap_doc_id",
                  "sub_flap_every", "conn_kill_after", "peer_hang_s",
-                 "peer_hang_after", "disk_stall_s", "node", "any")
+                 "peer_hang_after", "disk_stall_s", "tenant_storm_id",
+                 "tenant_storm_x", "node", "any")
 
     def __init__(self):
         def _f(name, default=0.0):
@@ -127,11 +138,16 @@ class _Config:
         self.peer_hang_after = max(1, int(_f("AMTPU_CHAOS_PEER_HANG_AFTER",
                                              1)))
         self.disk_stall_s = max(0.0, _f("AMTPU_CHAOS_DISK_STALL_S"))
+        self.tenant_storm_id = (os.environ.get("AMTPU_CHAOS_TENANT_STORM")
+                                or None)
+        self.tenant_storm_x = max(2, int(_f("AMTPU_CHAOS_TENANT_STORM_X",
+                                            8)))
         self.node = os.environ.get("AMTPU_CHAOS_NODE") or None
         self.any = bool(self.slow_apply_s or self.lock_hold_s
                         or self.drop_frames or self.stall_doc_id
                         or self.sub_flap_doc_id or self.conn_kill_after
-                        or self.peer_hang_s or self.disk_stall_s)
+                        or self.peer_hang_s or self.disk_stall_s
+                        or self.tenant_storm_id)
 
 
 _config: _Config | None = None
@@ -322,6 +338,26 @@ def peer_hang(node: str | None = None) -> bool:
         return False            # window expired: responsive again
     _disclose("peer_hang", node, s=c.peer_hang_s)
     return True
+
+
+def tenant_storm(node: str | None, doc_id: str) -> int:
+    """Extra ingress copies this epoch append should enqueue (0 = no
+    storm): `AMTPU_CHAOS_TENANT_STORM=<tenant_id>` multiplies exactly
+    that tenant's epoch-path ingress by `AMTPU_CHAOS_TENANT_STORM_X`
+    (default 8, min 2) — the caller (sync/service.py `_epoch_append`)
+    appends the batch x-1 additional times as un-waited entries.
+    Duplicate changes dedup at (actor, seq) admission, so the storm
+    costs real flush/dispatch/wire work without corrupting state. Inert
+    (one cached check) unset; every fire is disclosed."""
+    c = _cfg()
+    if c.tenant_storm_id is None or not _match(c, node):
+        return 0
+    from ..sync.tenantledger import tenant_of
+    tid = tenant_of(doc_id)
+    if tid != c.tenant_storm_id:
+        return 0
+    _disclose("tenant_storm", node, tenant=tid, x=c.tenant_storm_x)
+    return c.tenant_storm_x - 1
 
 
 class LockHolder:

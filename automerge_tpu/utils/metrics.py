@@ -314,6 +314,18 @@ COUNTERS: dict[str, str] = {
         "admission-governor state transitions (open <-> shedding) "
         "(sync/epochs.IngressGovernor; each also a shed_transition "
         "flight-recorder event)",
+    # tenant attribution plane (sync/tenantledger.py — r18): the
+    # governor's shed/delay decisions split per tenant {tenant=...}
+    # (bounded: the ledger tracks at most MAX_TENANTS identities)
+    "sync_tenant_shed_delayed":
+        "governor-delayed low-priority ingresses per tenant "
+        "{tenant=...} (sync/tenantledger.py note_shed)",
+    "sync_tenant_shed_dropped":
+        "governor-shed (IngressShedError) ingresses per tenant "
+        "{tenant=...} (sync/tenantledger.py note_shed)",
+    "sync_tenant_overflow":
+        "distinct tenant identities folded into the _overflow bucket "
+        "past MAX_TENANTS (sync/tenantledger.py; disclosed truncation)",
     # per-doc convergence ledger (sync/docledger.py)
     "obs_doc_evictions":
         "tracked docs evicted from the ledger's top-K table into the "
@@ -325,8 +337,8 @@ COUNTERS: dict[str, str] = {
     # fleet health plane (perf/fleet.py, perf/slo.py, utils/chaos.py)
     "obs_chaos_injected":
         "chaos fault injections fired {fault=slow_apply|lock_hold|"
-        "frame_drop|doc_stall|sub_flap|conn_kill|peer_hang|disk_stall} "
-        "(utils/chaos.py; inert unless AMTPU_CHAOS_* set)",
+        "frame_drop|doc_stall|sub_flap|conn_kill|peer_hang|disk_stall|"
+        "tenant_storm} (utils/chaos.py; inert unless AMTPU_CHAOS_* set)",
     "obs_fleet_stragglers_flagged":
         "straggler flags raised by the fleet collector {node=...} "
         "(perf/fleet.py; counted on the transition into flagged)",
@@ -457,6 +469,19 @@ GAUGES: dict[str, str] = {
     "obs_dispatch_rounds_tracked":
         "flush rounds currently held in the dispatch ledger's bounded "
         "ring (engine/dispatchledger.py)",
+    # tenant attribution plane (sync/tenantledger.py — r18): refreshed
+    # on the ledger's mutation path every GAUGE_REFRESH records; tenant
+    # labels are bounded by the ledger's MAX_TENANTS table
+    "obs_tenant_tracked":
+        "tenant identities tracked by the attribution ledger "
+        "(sync/tenantledger.py; bounded at MAX_TENANTS)",
+    "obs_tenant_ingress_share_pct":
+        "tenant's share of all admitted changes {tenant=...} "
+        "(sync/tenantledger.py; the tenant_hot doctor evidence)",
+    "obs_tenant_converge_lag_p99_s":
+        "p99 converge-lag restamp over the tenant's recent sample ring "
+        "{tenant=...} (sync/tenantledger.py; the tenant_converge_p99 "
+        "SLO family's per-node feed)",
     # remediation plane (perf/remediate.py — r13)
     "obs_remed_quarantined":
         "nodes currently quarantined by the remediation engine "
@@ -497,6 +522,10 @@ HISTOGRAMS: dict[str, str] = {
         "dispatch-ledger self-time flushed per gauge refresh "
         "(engine/dispatchledger.py; sum/elapsed = the duty-cycle bound "
         "the config-17 perf-check gate holds under 2%)",
+    "obs_tenant_ledger_s":
+        "tenant-ledger self-time flushed per gauge refresh "
+        "(sync/tenantledger.py; sum/elapsed = the duty-cycle bound the "
+        "config-18 perf-check gate holds under 2%)",
     "obs_remed_tick_s":
         "remediation-engine per-tick wall cost (perf/remediate.py; "
         "p50/interval = the steady-state duty cycle bench config 14 "

@@ -3772,6 +3772,276 @@ def run_dispatch_config(n_docs=1024, rounds=24, dirty_per_round=96,
     }
 
 
+def run_tenant_config(n_docs_per_tenant=48, rounds=16, writes_per_round=4,
+                      zipf_s=1.1, n_shards=2, storm_x=6, hot_boost=3,
+                      round_sleep_s=0.002):
+    """Config 18: tenant attribution plane on a sharded serving node.
+    Three zipf tenants (``tenant/<id>/doc...``) write through a 2-shard
+    hub that gossips to one subscriber; halfway through, tenant
+    ``alpha`` goes hot (chaos ``tenant_storm`` ingest amplification,
+    node-targeted at the hub, PLUS a real write-rate boost). Claims,
+    each asserted in-run:
+
+    1. the tenant ledger attributes the storm: all three tenants
+       tracked, the hot tenant's ingress share exceeds every quiet
+       tenant's, per-tenant wire-byte and dispatch shares are nonzero,
+       and the per-tenant shares sum back to the fleet totals within 1%
+       (perf/history.TENANT_ATTRIBUTION_ERR_MAX_PCT) — re-gated in
+       `perf check`;
+    2. isolation cost is RECORDED, not guessed: the quiet tenants'
+       p99 admission-to-durable latency (group-commit park time on the
+       shared hub) is measured before and during the storm — the
+       degradation is the number ROADMAP #5's per-tenant isolation
+       work exists to shrink;
+    3. the ledger's own duty cycle (hook self time / traffic wall)
+       stays under 2% (TENANT_LEDGER_BUDGET_PCT) — re-gated in
+       `perf check`;
+    4. the disabled path is behavior-identical: the same storm re-run
+       under AMTPU_TENANTLEDGER=0 produces byte-equal per-doc hashes
+       on a fresh hub and records ZERO new ledger state.
+
+    The hub pins the eager (TPU-posture) dispatch path so flush rounds
+    carry in-round dispatches for the share attribution (config-17
+    precedent)."""
+    import random
+
+    from automerge_tpu.core.change import Change, Op
+    from automerge_tpu.core.ids import ROOT_ID
+    from automerge_tpu.perf.history import (TENANT_ATTRIBUTION_ERR_MAX_PCT,
+                                            TENANT_LEDGER_BUDGET_PCT)
+    from automerge_tpu.perf.tenantplane import attribution_check
+    from automerge_tpu.sync import docledger as docledger_mod
+    from automerge_tpu.sync import tenantledger
+    from automerge_tpu.sync.connection import Connection
+    from automerge_tpu.sync.service import EngineDocSet
+    from automerge_tpu.sync.sharded_service import ShardedEngineDocSet
+    from automerge_tpu.utils import chaos as chaos_mod
+    from automerge_tpu.utils import metrics as metrics_mod
+
+    assert tenantledger.enabled(), (
+        "config 18 needs the tenant ledger on (unset AMTPU_TENANTLEDGER)")
+    tenants = ("alpha", "beta", "gamma")
+    hot = "alpha"
+    half = rounds // 2
+
+    def build_pair():
+        hub = ShardedEngineDocSet(n_shards=n_shards)
+        for s in hub.shards:
+            s._chaos_node = "hub"
+            s._lazy_resolved = True
+            s._resident.lazy_dispatch = False
+        sub = EngineDocSet(backend="rows")
+        sub._chaos_node = "sub"
+        for svc, lbl in ((hub, "hub"), (sub, "sub")):
+            led = docledger_mod.of(svc)
+            if led is not None:
+                led.label = lbl
+        links = _MeshLinks(2, lambda i, j: 1)
+        svcs = [hub, sub]
+        conns = {}
+        for i in range(2):
+            for j in range(2):
+                if i == j:
+                    continue
+                conn = Connection(svcs[i],
+                                  (lambda m, i=i, j=j: links.send(i, j, m)),
+                                  wire="columnar")
+                conn.peer_label = "sub" if j else "hub"
+                conns[(i, j)] = conn
+        for c in conns.values():
+            c.open()
+        return hub, sub, conns, links
+
+    def storm(hub, sub, conns, links):
+        """The identical two-phase tenant storm (own rng: both runs
+        replay the same traffic, storm schedule included). Returns
+        (hub hashes, ops, quiet-tenant latency samples base/hot)."""
+
+        def receive(i, j, msg):
+            conns[(j, i)].receive_msg(msg)
+
+        rng = random.Random(18)
+        picks = {t: _zipf_picker(n_docs_per_tenant, zipf_s, rng)
+                 for t in tenants}
+        seqs: dict = {}
+        quiet_base: list = []
+        quiet_hot: list = []
+        total_ops = 0
+        os.environ["AMTPU_CHAOS_NODE"] = "hub"
+        try:
+            for r in range(rounds):
+                links.round = r
+                if r == half:
+                    # the mid-run heel turn: alpha's ingest amplified
+                    # x storm_x at the hub (duplicates dedup at
+                    # admission — pure extra flush/dispatch work)
+                    os.environ["AMTPU_CHAOS_TENANT_STORM"] = hot
+                    os.environ["AMTPU_CHAOS_TENANT_STORM_X"] = str(storm_x)
+                    chaos_mod.reload()
+                for t in tenants:
+                    n = writes_per_round
+                    if t == hot and r >= half:
+                        n *= hot_boost
+                    for _ in range(n):
+                        doc = f"tenant/{t}/doc{picks[t]():03d}"
+                        seqs[doc] = seqs.get(doc, 0) + 1
+                        ch = Change(actor=f"W{t}", seq=seqs[doc], deps={},
+                                    ops=[Op("set", ROOT_ID, key=f"f{r % 4}",
+                                            value=r)])
+                        t0 = time.perf_counter()
+                        hub.apply_changes(doc, [ch])
+                        lat = time.perf_counter() - t0
+                        total_ops += 1
+                        # rounds 0-1 are dispatch-compile warmup: their
+                        # first-flush latencies would swamp the base p99
+                        if t != hot and r >= 2:
+                            (quiet_hot if r >= half
+                             else quiet_base).append(lat)
+                links.deliver_due(receive)
+                time.sleep(round_sleep_s)
+            # drain to convergence; the subscriber must agree
+            for _ in range(50):
+                links.round += 100
+                links.drain_all(receive)
+                hub.flush()
+                sub.flush()
+                if not any(q for q in links.q.values()):
+                    break
+            h_hub, h_sub = hub.hashes(), sub.hashes()
+            assert h_sub == h_hub, (
+                "hub/subscriber diverged: per-doc hashes differ "
+                f"({sum(1 for d in h_hub if h_hub[d] != h_sub.get(d))}"
+                " docs)")
+            return h_hub, total_ops, quiet_base, quiet_hot
+        finally:
+            for var in ("AMTPU_CHAOS_TENANT_STORM",
+                        "AMTPU_CHAOS_TENANT_STORM_X", "AMTPU_CHAOS_NODE"):
+                os.environ.pop(var, None)
+            chaos_mod.reload()
+
+    def teardown(hub, sub, conns):
+        for c in conns.values():
+            try:
+                c.close()
+            except Exception:
+                pass
+        hub.close()
+        sub.close()
+
+    def p99(vals):
+        v = sorted(vals)
+        return round(v[min(len(v) - 1, int(0.99 * (len(v) - 1)))], 5)
+
+    led = tenantledger.ledger()
+    base_self = led.self_seconds()
+    hub, sub, conns, links = build_pair()
+    try:
+        with _quiet_traceback_dumps():
+            t0 = time.perf_counter()
+            hashes_on, total_ops, quiet_base, quiet_hot = storm(
+                hub, sub, conns, links)
+            traffic_wall = time.perf_counter() - t0
+    finally:
+        teardown(hub, sub, conns)
+
+    sec = led.section()
+    assert sec, "tenant storm left no tenant-ledger section"
+    tl = sec["tenants"]
+    assert set(tl) >= set(tenants), (
+        f"expected tenants {tenants}, ledger tracked {sorted(tl)}")
+    hot_share = tl[hot]["ingress_share_pct"]
+    for t in tenants:
+        if t != hot:
+            assert hot_share > tl[t]["ingress_share_pct"], (
+                f"hot tenant {hot} ({hot_share}%) does not dominate "
+                f"{t} ({tl[t]['ingress_share_pct']}%)")
+    assert sum(tl[t]["bytes_sent"] for t in tenants) > 0, (
+        "no per-tenant wire bytes attributed (gossip lane broken)")
+    assert sum(tl[t]["dispatch_share"] for t in tenants) > 0, (
+        "no per-tenant dispatch shares attributed (round fold broken)")
+    snap = metrics_mod.snapshot()
+    assert snap.get("obs_chaos_injected{fault=tenant_storm}", 0) > 0, (
+        "tenant_storm chaos fault never fired at the hub")
+    chk = attribution_check(sec)
+    assert chk["complete"] and \
+        chk["err_pct"] <= TENANT_ATTRIBUTION_ERR_MAX_PCT, (
+            f"attribution does not sum to fleet totals: {chk}")
+    self_s = led.self_seconds() - base_self
+    duty_pct = round(100.0 * self_s / max(traffic_wall, 1e-9), 3)
+    assert duty_pct < TENANT_LEDGER_BUDGET_PCT, (
+        f"tenant-ledger duty cycle {duty_pct}% breaches the "
+        f"{TENANT_LEDGER_BUDGET_PCT}% budget")
+
+    # disabled-parity subrun: same storm on a fresh pair, ledger off —
+    # byte-equal hashes, zero new ledger state (the one cached check is
+    # the whole cost)
+    adm_before_off = int(led.section().get("admitted_total") or 0)
+    os.environ["AMTPU_TENANTLEDGER"] = "0"
+    tenantledger._reload_for_tests()
+    try:
+        assert not tenantledger.enabled()
+        hub2, sub2, conns2, links2 = build_pair()
+        try:
+            with _quiet_traceback_dumps():
+                hashes_off, _, _, _ = storm(hub2, sub2, conns2, links2)
+        finally:
+            teardown(hub2, sub2, conns2)
+    finally:
+        os.environ.pop("AMTPU_TENANTLEDGER", None)
+        tenantledger._reload_for_tests()
+    assert hashes_off == hashes_on, (
+        "ledger-disabled storm diverged: per-doc hashes differ "
+        f"({sum(1 for d in hashes_on if hashes_on[d] != hashes_off.get(d))}"
+        " docs)")
+    adm_off = (int(led.section().get("admitted_total") or 0)
+               - adm_before_off)
+    assert adm_off == 0, (
+        f"disabled ledger still admitted {adm_off} change(s)")
+
+    qb, qh = p99(quiet_base), p99(quiet_hot)
+    return {
+        "config": 18,
+        "name": CONFIGS[18][0],
+        "docs": n_docs_per_tenant * len(tenants),
+        "ops": total_ops,
+        "tenants": len(tenants),
+        "hot_tenant": hot,
+        "storm_x": storm_x,
+        "hot_write_boost": hot_boost,
+        "storm_rounds": rounds,
+        "zipf_s": zipf_s,
+        "shards": n_shards,
+        "hot_ingress_share_pct": hot_share,
+        "tenant_shares": {
+            t: {"ingress_share_pct": tl[t]["ingress_share_pct"],
+                "dispatch_share": tl[t]["dispatch_share"],
+                "bytes_sent": tl[t]["bytes_sent"],
+                "lag_p99_s": tl[t]["lag"]["p99_s"]}
+            for t in tenants},
+        "quiet_p99_base_s": qb,
+        "quiet_p99_hot_s": qh,
+        "quiet_p99_degradation_x": (round(qh / qb, 2) if qb else None),
+        "tenant_attribution_err_pct": chk["err_pct"],
+        "tenant_ledger_overhead_pct": duty_pct,
+        "tenant_ledger_self_s": round(self_s, 5),
+        "tenant_disabled_parity": 1,
+        "protocol": (
+            f"{rounds} traffic rounds, 3 zipf({zipf_s}) tenants x "
+            f"{n_docs_per_tenant} docs through a {n_shards}-shard hub "
+            "gossiping to one subscriber; tenant_storm chaos "
+            f"(x{storm_x}, hub-targeted) + x{hot_boost} write boost on "
+            f"'{hot}' from round {half}; quiet-tenant p99 "
+            "admission-to-durable latency recorded base vs hot; "
+            "attribution sum, duty cycle and AMTPU_TENANTLEDGER=0 "
+            "parity asserted in-run"),
+        "traffic_wall_s": round(traffic_wall, 3),
+        "engine_s": round(traffic_wall, 3),
+        "oracle_s": None,
+        "speedup": None,
+        "parity": True,
+    }
+
+
 CONFIGS = {
     1: ("single-doc LWW storm (2 actors x 1000 sets)", gen_lww_storm),
     2: ("nested JSON card board (8 actors)", gen_trellis),
@@ -3801,6 +4071,10 @@ CONFIGS = {
     17: ("dispatch-efficiency ledger: 1K-doc zipf dirty storm, baseline "
          "amplification + padding waste + megabatch projection, duty "
          "cycle < 2%, disabled-path parity", None),
+    18: ("tenant attribution plane: 3 zipf tenants on a sharded fleet, "
+         "hot-tenant storm mid-run, per-tenant cost shares + "
+         "quiet-tenant p99 degradation, duty cycle < 2%, disabled-path "
+         "parity", None),
 }
 
 
@@ -4441,6 +4715,8 @@ def run_config(cfg: int, n_docs: int | None = None, oracle_cap_docs=12000):
         return run_move_config()
     if cfg == 17:
         return run_dispatch_config()
+    if cfg == 18:
+        return run_tenant_config()
     name, gen = CONFIGS[cfg]
     kwargs = {}
     if cfg == 5 and n_docs:
@@ -4768,6 +5044,24 @@ def _final_record(results_by_cfg: dict, backend: str | None, attempts: list):
                 "megabatch_worst_bucket": r["megabatch_worst_bucket"],
                 "protocol": r["protocol"]}
                if r.get("config") == 17 else {}),
+            **({"tenants": r["tenants"],
+                "hot_tenant": r["hot_tenant"],
+                "storm_x": r["storm_x"],
+                "hot_write_boost": r["hot_write_boost"],
+                "shards": r["shards"],
+                "hot_ingress_share_pct": r["hot_ingress_share_pct"],
+                "tenant_shares": r["tenant_shares"],
+                "quiet_p99_base_s": r["quiet_p99_base_s"],
+                "quiet_p99_hot_s": r["quiet_p99_hot_s"],
+                "quiet_p99_degradation_x": r["quiet_p99_degradation_x"],
+                "tenant_attribution_err_pct":
+                    r["tenant_attribution_err_pct"],
+                "tenant_ledger_overhead_pct":
+                    r["tenant_ledger_overhead_pct"],
+                "tenant_ledger_self_s": r["tenant_ledger_self_s"],
+                "tenant_disabled_parity": r["tenant_disabled_parity"],
+                "protocol": r["protocol"]}
+               if r.get("config") == 18 else {}),
             **({"mttr_max_s": r["mttr_max_s"],
                 "mttr_mean_s": r["mttr_mean_s"],
                 "mttr_budget_s": r["mttr_budget_s"],

@@ -37,7 +37,13 @@
 #          ledger accounts every routed call (amplification, padding
 #          waste, megabatch projection — docs/OBSERVABILITY.md
 #          "Dispatch-efficiency ledger"; the fleet-scale gate is bench
-#          config 17 under `make perfcheck`). Never fails verify — a CPU-only
+#          config 17 under `make perfcheck`), and the tenant smoke: a
+#          three-tenant namespaced traffic round proves the tenant
+#          attribution plane tracks every tenant's ingress/dispatch
+#          shares with the shares summing back to the fleet totals
+#          (docs/OBSERVABILITY.md "Tenant attribution plane"; the
+#          fleet-scale gate is bench config 18 under `make
+#          perfcheck`). Never fails verify — a CPU-only
 #          image or a missing/empty history must not block the build
 #          (TUNNEL_DIAGNOSIS.md: TPU absence is an environment fact, not
 #          a code defect). Run `make perfcheck` for the enforcing gate.
@@ -69,6 +75,8 @@ JAX_PLATFORMS=cpu python -m automerge_tpu.perf move --smoke \
     || echo "move smoke FAILED (informational here; enforced by tests + perf check)"
 JAX_PLATFORMS=cpu python -m automerge_tpu.perf dispatch --smoke \
     || echo "dispatch smoke FAILED (informational here; enforced by tests + perf check)"
+JAX_PLATFORMS=cpu python -m automerge_tpu.perf tenant --smoke \
+    || echo "tenant smoke FAILED (informational here; enforced by tests + perf check)"
 
 echo "== stage 3/3: tier-1 suite (ROADMAP.md) =="
 set -o pipefail

@@ -222,9 +222,14 @@ def _capture_dropping_node(monkeypatch, n_ops=10):
     client = TcpSyncClient(ds_client, server.host, server.port).start()
     try:
         def burst(base):
+            # the drops happen on the tcp writer thread — wait for them
+            # to land before snapshotting, or the mid/end delta races to 0
+            before = metrics.snapshot().get("sync_frames_dropped", 0)
             for k in range(n_ops):
                 ds_client.set_doc(f"doc{base + k}", am.change(
                     am.init(), lambda d, k=k: d.__setitem__("n", k)))
+            assert wait_until(lambda: metrics.snapshot().get(
+                "sync_frames_dropped", 0) >= before + n_ops)
         burst(0)
         mid = metrics.snapshot()
         burst(n_ops)

@@ -24,6 +24,7 @@ def _capture(monkeypatch, module, attr, rc=0):
     ("explain", "explain", "main"),
     ("top", "top", "main"),
     ("dispatch", "dispatchplane", "main"),
+    ("tenant", "tenantplane", "main"),
     ("remediate", "remediate", "smoke_main"),
     ("move", "moveplane", "smoke_main"),
     ("bootstrap", "bootstrap", "smoke_main"),
@@ -63,8 +64,8 @@ def test_unknown_command_exits_nonzero_with_usage(capsys):
     err = capsys.readouterr().err
     assert "unknown command 'frobnicate'" in err
     for cmd in ("report", "check", "contention", "doctor", "explain",
-                "top", "dispatch", "remediate", "move", "bootstrap",
-                "roofline", "resident"):
+                "top", "dispatch", "tenant", "remediate", "move",
+                "bootstrap", "roofline", "resident"):
         assert cmd in err
 
 

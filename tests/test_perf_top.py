@@ -11,7 +11,7 @@ import pytest
 from automerge_tpu.perf import slo
 from automerge_tpu.perf.fleet import FleetCollector
 from automerge_tpu.perf.top import (dispatch_lines, hot_doc_lines, render,
-                                    spark)
+                                    spark, tenant_lines)
 from automerge_tpu.utils import flightrec, metrics
 
 
@@ -25,7 +25,7 @@ def _clean_metrics():
 
 
 def _snap(ops=0, flush_s=0.0, flush_n=0, lockw=0.0, drops=0, conv=None,
-          docledger=None, dispatchledger=None):
+          docledger=None, dispatchledger=None, tenantledger=None):
     out = {
         "sync_ops_ingested": ops,
         "sync_frames_dropped": drops,
@@ -43,6 +43,8 @@ def _snap(ops=0, flush_s=0.0, flush_n=0, lockw=0.0, drops=0, conv=None,
         out["docledger"] = docledger
     if dispatchledger is not None:
         out["dispatchledger"] = dispatchledger
+    if tenantledger is not None:
+        out["tenantledger"] = tenantledger
     return out
 
 
@@ -83,8 +85,30 @@ def _dispatch_section(label="y", amp=6.5, waste=88.2, dispatches=13,
         }, "ring": []}}}
 
 
+def _tenant_section(label="y", tenants=None):
+    """A minimal `"tenantledger"` snapshot section: tenants maps
+    tenant-id -> (ingress_share_pct, dispatch_share, p99_s, shed)."""
+    body = {}
+    for tid, (share, disp, p99, shed) in (tenants or {}).items():
+        body[tid] = {
+            "admitted": 10, "sent_changes": 0, "bytes_sent": 0,
+            "recv_useful": 0, "recv_duplicate": 0, "bytes_received": 0,
+            "drops": 0, "shed_dropped": shed, "shed_delayed": 0,
+            "delayed_s": 0.0, "rounds": 1, "dirty_docs": 1,
+            "dispatch_share": disp, "padded_share": 0.0,
+            "logical_share": 0.0, "wall_share_s": 0.0,
+            "ingress_share_pct": share,
+            "lag": {"p50_s": p99 / 2, "p99_s": p99, "max_s": p99},
+        }
+    return {"nodes": {label: {
+        "label": label, "prefix": "tenant/", "tracked": len(body),
+        "truncated": 0, "overflow_tenants": 0,
+        "admitted_total": 10 * len(body), "rounds_total": 1,
+        "self_s": 0.0, "tenants": body}}}
+
+
 def _three_node_collector(straggler_conv=2.0, docledger=None,
-                          dispatchledger=None):
+                          dispatchledger=None, tenantledger=None):
     c = FleetCollector(interval_s=0.02, min_nodes=3)
     c.add_local("a", _scripted(_snap(), _snap(ops=60, flush_s=0.06,
                                               flush_n=30, conv=0.01)),
@@ -96,7 +120,8 @@ def _three_node_collector(straggler_conv=2.0, docledger=None,
                                               flush_n=10,
                                               conv=straggler_conv,
                                               docledger=docledger,
-                                              dispatchledger=dispatchledger)),
+                                              dispatchledger=dispatchledger,
+                                              tenantledger=tenantledger)),
                 role="peer")
     c.scrape_once()
     time.sleep(0.02)
@@ -262,6 +287,54 @@ def test_dispatch_band_ranks_and_caps():
     # worst amplification first
     assert "n7" in lines[1] and "n6" in lines[2] and "n5" in lines[3]
     assert "+5 more ledger node(s)" in lines[4]
+
+
+# -- tenant band (the tenantledger panel, r18) -------------------------------
+
+
+def test_tenant_band_renders_ledger_rows():
+    sec = _tenant_section(label="y", tenants={
+        "acme": (62.5, 4.0, 3.25, 7),
+        "_default": (37.5, 1.0, 0.01, 0),
+    })
+    c = _three_node_collector(tenantledger=sec)
+    lines = render(c)
+    text = "\n".join(lines)
+    assert "tenants (ingress share; `perf tenant`):" in text
+    row = next(line for line in lines if "acme" in line)
+    assert "@ y" in row
+    assert "share" in row and "62.5%" in row
+    assert "disp" in row and "4.0" in row
+    assert "p99" in row and "3.2500s" in row
+    assert "[7 shed]" in row
+    quiet = next(line for line in lines if "_default" in line)
+    assert "shed" not in quiet      # zero shed suppresses the tag
+    # hottest share ranks first
+    assert lines.index(row) < lines.index(quiet)
+
+
+def test_tenant_band_absent_without_ledger():
+    c = _three_node_collector()
+    assert tenant_lines(c) == []
+    assert not any("tenants (" in line for line in render(c))
+    # a section with no tenants disappears the same way
+    empty = _tenant_section(label="y", tenants={})
+    c2 = _three_node_collector(tenantledger=empty)
+    assert tenant_lines(c2) == []
+
+
+def test_tenant_band_ranks_and_caps():
+    tenants = {f"t{k}": (float(k * 10), float(k), 0.1 * k, 0)
+               for k in range(8)}
+    sec = _tenant_section(label="hub", tenants=tenants)
+    c = FleetCollector(interval_s=0.01, min_nodes=3)
+    c.add_local("hub", _scripted(_snap(tenantledger=sec)))
+    c.scrape_once()
+    lines = tenant_lines(c, limit=3)
+    assert len(lines) == 1 + 3 + 1       # header + rows + overflow note
+    # highest ingress share first
+    assert "t7" in lines[1] and "t6" in lines[2] and "t5" in lines[3]
+    assert "+5 more tenant row(s)" in lines[4]
 
 
 def test_render_width_clamp():

@@ -99,6 +99,75 @@ def test_poisoned_shard_is_isolated():
         e.hashes()
 
 
+def test_tenant_namespace_routing_is_stable_and_total():
+    """The r18 tenant prefix rule (`tenant/<id>/...`) is pure labeling:
+    routing still keys on the FULL doc id via crc32, so namespaced ids
+    place deterministically, restarts agree, and one tenant's docs
+    spread across shards rather than pinning to one."""
+    import zlib
+
+    from automerge_tpu.sync import tenantledger
+
+    ids = [f"tenant/{t}/doc{i}" for t in ("acme", "beta", "ops")
+           for i in range(10)]
+    e = ShardedEngineDocSet(n_shards=3)
+    for did in ids:
+        e.add_doc(did)
+    assert sorted(e.doc_ids) == sorted(ids)
+    for did in ids:
+        # stable: repeat reads agree, and match the documented hash
+        assert e.shard_of(did) is e.shard_of(did)
+        assert e.shard_of(did) is e.shards[
+            zlib.crc32(did.encode()) % e.n_shards]
+    # a restart (fresh instance) routes identically — archives stay put
+    e2 = ShardedEngineDocSet(n_shards=3)
+    for did in ids:
+        assert e.shards.index(e.shard_of(did)) == \
+            e2.shards.index(e2.shard_of(did))
+    # the namespace does not collapse a tenant onto one shard
+    for t in ("acme", "beta", "ops"):
+        shards = {e.shards.index(e.shard_of(d))
+                  for d in ids if tenantledger.tenant_of(d) == t}
+        assert len(shards) == e.n_shards, (t, shards)
+    per = [len(s.doc_ids) for s in e.shards]
+    assert sum(per) == len(ids) and all(p > 0 for p in per), per
+
+
+def test_mixed_tenant_batch_coalesces_and_attributes_per_shard():
+    """A mixed-tenant burst through batch() still coalesces to at most
+    one dispatch per shard (tenancy never adds rounds), and the tenant
+    ledger's per-shard flush rounds account every tenant's dirty docs."""
+    am.metrics.reset()
+    from automerge_tpu.sync import tenantledger
+
+    e = ShardedEngineDocSet(n_shards=2)
+    ids = [f"tenant/{t}/doc{i}" for t in ("acme", "beta", "ops")
+           for i in range(4)]
+    hashes_want = {}
+    with e.batch():
+        for i, did in enumerate(ids):
+            chs = _mk(i)
+            e.apply_changes(did, chs)
+            hashes_want[did] = oracle_hash(chs)
+    snap = am.metrics.snapshot()
+    rounds = (snap.get("rows_rounds_batched", 0)
+              + snap.get("rows_rounds_fallback", 0))
+    assert 1 <= rounds <= e.n_shards, snap
+    h = e.hashes()
+    for did, want in hashes_want.items():
+        assert np.uint32(h[did]) == want, did
+    sec = tenantledger.ledger().section()
+    assert sec is not None
+    assert set(sec["tenants"]) >= {"acme", "beta", "ops"}
+    # every doc in the burst lands in exactly one tenant's round account
+    assert sum(t["dirty_docs"] for t in sec["tenants"].values()) == len(ids)
+    assert sec["rounds_total"] >= 1
+    from automerge_tpu.perf.tenantplane import attribution_check
+    chk = attribution_check(sec)
+    assert chk["err_pct"] <= 1.0, chk
+    am.metrics.reset()
+
+
 def test_shards_bind_to_distinct_devices():
     """The module's multi-chip claim, exercised on the virtual 8-device
     CPU mesh: shards pinned round-robin over jax.devices() keep their row

@@ -1,0 +1,217 @@
+"""The tenant attribution plane (sync/tenantledger.py): the doc-id
+namespace derivation rule, the house ledger contract (bounded tenant
+table with disclosed overflow, pure-state export, env-var disable as one
+cached check), proportional round attribution, the tenantplane
+attribution check, and the `tenant_storm` chaos fault.
+"""
+
+import pytest
+
+from automerge_tpu.perf import tenantplane
+from automerge_tpu.sync import tenantledger
+from automerge_tpu.utils import chaos, flightrec, metrics
+
+TENANT_VARS = ("AMTPU_TENANTLEDGER", "AMTPU_TENANT_PREFIX")
+STORM_VARS = ("AMTPU_CHAOS_TENANT_STORM", "AMTPU_CHAOS_TENANT_STORM_X",
+              "AMTPU_CHAOS_NODE")
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane(monkeypatch):
+    """Every test starts and ends with a pristine tenant/chaos config
+    and an empty ledger."""
+    for var in TENANT_VARS + STORM_VARS:
+        monkeypatch.delenv(var, raising=False)
+    tenantledger._reload_for_tests()
+    chaos.reload()
+    metrics.reset()          # runs the registered reset hook too
+    flightrec.reset()
+    yield
+    for var in TENANT_VARS + STORM_VARS:
+        monkeypatch.delenv(var, raising=False)
+    tenantledger._reload_for_tests()
+    chaos.reload()
+    metrics.reset()
+    flightrec.reset()
+
+
+# ---------------------------------------------------------------------------
+# derivation rule
+
+
+def test_tenant_of_prefix_rule():
+    assert tenantledger.tenant_of("tenant/acme/orders-1") == "acme"
+    assert tenantledger.tenant_of("tenant/acme") == "acme"
+    assert tenantledger.tenant_of("tenant/a/b/c") == "a"
+    assert tenantledger.tenant_of("orders-1") == "_default"
+    # a bare prefix with no id falls back rather than minting ""
+    assert tenantledger.tenant_of("tenant/") == "_default"
+    assert tenantledger.tenant_of("") == "_default"
+
+
+def test_tenant_of_prefix_override(monkeypatch):
+    monkeypatch.setenv("AMTPU_TENANT_PREFIX", "org:")
+    tenantledger._reload_for_tests()
+    assert tenantledger.tenant_of("org:acme/doc") == "acme"
+    assert tenantledger.tenant_of("tenant/acme/doc") == "_default"
+
+
+# ---------------------------------------------------------------------------
+# disable contract
+
+
+def test_disabled_hooks_record_nothing(monkeypatch):
+    monkeypatch.setenv("AMTPU_TENANTLEDGER", "0")
+    tenantledger._reload_for_tests()
+    tenantledger.note_ingress("tenant/a/d", 5)
+    tenantledger.note_wire("tenant/a/d", sent=3, bytes_sent=100)
+    tenantledger.note_lag("tenant/a/d", 0.5)
+    tenantledger.note_shed("tenant/a/d", delayed=False)
+    tenantledger.note_round({"a": 1}, {"dispatches": 4})
+    assert tenantledger.round_tenants(["tenant/a/d"]) is None
+    assert tenantledger.ledger().section() is None
+    assert tenantledger.snapshot_section() is None
+    snap = metrics.snapshot()
+    assert "tenantledger" not in snap
+    assert not any(k.startswith("sync_tenant_") for k in snap)
+
+
+# ---------------------------------------------------------------------------
+# accounting + export
+
+
+def _feed_basic():
+    tenantledger.note_ingress("tenant/a/d1", 6)
+    tenantledger.note_ingress("tenant/b/d1", 2)
+    tenantledger.note_ingress("plain-doc", 2)
+    tenantledger.note_wire("tenant/a/d1", sent=4, bytes_sent=400,
+                           useful=3, dup=1, bytes_recv=300, drops=1)
+    tenantledger.note_lag("tenant/a/d1", 0.25)
+    tenantledger.note_shed("tenant/b/d1", delayed=True, delay_s=0.01)
+    tenantledger.note_shed("tenant/b/d1", delayed=False)
+
+
+def test_section_accounts_and_shares():
+    _feed_basic()
+    sec = tenantledger.ledger().section()
+    assert sec["admitted_total"] == 10
+    assert sec["tracked"] == 3 and sec["truncated"] == 0
+    a = sec["tenants"]["a"]
+    assert a["admitted"] == 6
+    assert a["ingress_share_pct"] == 60.0
+    assert a["sent"] == 4 and a["bytes_sent"] == 400
+    assert a["recv_useful"] == 3 and a["recv_duplicate"] == 1
+    assert a["drops"] == 1
+    assert a["lag"]["p99_s"] == 0.25 and a["lag"]["max_s"] == 0.25
+    b = sec["tenants"]["b"]
+    assert b["shed_delayed"] == 1 and b["shed_dropped"] == 1
+    assert sec["tenants"]["_default"]["admitted"] == 2
+    # hottest-ingress ranks first
+    assert list(sec["tenants"])[0] == "a"
+
+
+def test_idle_snapshots_byte_equal():
+    _feed_basic()
+    tenantledger.note_round({"a": 3, "b": 1}, {"dispatches": 8,
+                                               "wall_s": 0.02})
+    s1 = tenantledger.snapshot_section()
+    s2 = tenantledger.snapshot_section()
+    assert s1 == s2                      # pure export: no clock reads
+
+
+def test_round_attribution_is_proportional():
+    folded = {"dispatches": 6, "ambient": 2, "padded": 400,
+              "logical": 100, "wall_s": 0.08}
+    tenantledger.note_round({"a": 3, "b": 1}, folded)
+    sec = tenantledger.ledger().section()
+    a, b = sec["tenants"]["a"], sec["tenants"]["b"]
+    assert a["dispatch_share"] == 6.0 and b["dispatch_share"] == 2.0
+    assert a["padded_share"] == 300.0 and b["padded_share"] == 100.0
+    assert a["logical_share"] == 75.0 and b["logical_share"] == 25.0
+    assert a["wall_share_s"] == pytest.approx(0.06)
+    assert a["dirty_docs"] == 3 and a["rounds"] == 1
+    assert sec["rounds_total"] == 1
+
+
+def test_overflow_folds_with_disclosure():
+    for k in range(tenantledger.MAX_TENANTS + 5):
+        tenantledger.note_ingress(f"tenant/t{k}/d", 1)
+    sec = tenantledger.ledger().section()
+    assert sec["tracked"] == tenantledger.MAX_TENANTS + 1  # + _overflow
+    assert sec["overflow_tenants"] == 5
+    assert sec["admitted_total"] == tenantledger.MAX_TENANTS + 5
+    # identity folds but the counts survive
+    snap = metrics.snapshot()
+    assert snap.get("sync_tenant_overflow") == 5
+    assert sum(t.admitted for t in
+               tenantledger.ledger()._tenants.values()) == \
+        tenantledger.MAX_TENANTS + 5
+
+
+def test_round_tenants_groups_pending_docs():
+    got = tenantledger.round_tenants(
+        ["tenant/a/1", "tenant/a/2", "tenant/b/1", "plain"])
+    assert got == {"a": 2, "b": 1, "_default": 1}
+
+
+def test_snapshot_section_rides_metrics_snapshot_and_reset():
+    _feed_basic()
+    snap = metrics.snapshot()
+    nodes = (snap.get("tenantledger") or {}).get("nodes")
+    assert nodes and any("a" in sec["tenants"]
+                         for sec in nodes.values())
+    metrics.reset()          # registered reset hook clears the ledger
+    assert tenantledger.ledger().section() is None
+
+
+def test_attribution_check_sums_to_totals():
+    _feed_basic()
+    tenantledger.note_round({"a": 1}, {"dispatches": 2})
+    sec = tenantledger.ledger().section()
+    chk = tenantplane.attribution_check(sec)
+    assert chk["admitted_sum"] == chk["admitted_total"] == 10
+    assert chk["err_pct"] == 0.0
+    assert chk["complete"] is True
+
+
+def test_self_time_accumulates():
+    _feed_basic()
+    assert tenantledger.ledger().self_seconds() > 0.0
+
+
+# ---------------------------------------------------------------------------
+# tenant_storm chaos fault
+
+
+def test_tenant_storm_inert_when_unset():
+    assert chaos.tenant_storm("n0", "tenant/a/d") == 0
+    assert metrics.snapshot().get(
+        "obs_chaos_injected{fault=tenant_storm}") is None
+
+
+def test_tenant_storm_fires_for_target_tenant_only(monkeypatch):
+    monkeypatch.setenv("AMTPU_CHAOS_TENANT_STORM", "hot")
+    monkeypatch.setenv("AMTPU_CHAOS_TENANT_STORM_X", "4")
+    chaos.reload()
+    assert chaos.tenant_storm("n0", "tenant/hot/d") == 3   # x - 1 extras
+    assert chaos.tenant_storm("n0", "tenant/quiet/d") == 0
+    assert chaos.tenant_storm("n0", "plain") == 0
+    snap = metrics.snapshot()
+    assert snap.get("obs_chaos_injected{fault=tenant_storm}") == 1
+
+
+def test_tenant_storm_respects_node_targeting(monkeypatch):
+    monkeypatch.setenv("AMTPU_CHAOS_TENANT_STORM", "hot")
+    monkeypatch.setenv("AMTPU_CHAOS_NODE", "victim")
+    chaos.reload()
+    assert chaos.tenant_storm("bystander", "tenant/hot/d") == 0
+    assert chaos.tenant_storm("victim", "tenant/hot/d") > 0
+
+
+def test_tenant_storm_reload_clears(monkeypatch):
+    monkeypatch.setenv("AMTPU_CHAOS_TENANT_STORM", "hot")
+    chaos.reload()
+    assert chaos.tenant_storm("n0", "tenant/hot/d") > 0
+    monkeypatch.delenv("AMTPU_CHAOS_TENANT_STORM")
+    chaos.reload()
+    assert chaos.tenant_storm("n0", "tenant/hot/d") == 0
