@@ -16,6 +16,13 @@ Options:
                           keys survive), then exit 0. Review the diff —
                           every new entry needs a justification.
     --list                print every finding (including grandfathered)
+    --write-locks-manifest
+                          regenerate locks_manifest.json and
+                          docs/LOCK_HIERARCHY.md from the code's current
+                          lock-order edges (lockfree declarations are
+                          carried over — they are human-authored), then
+                          exit 0. Review the diff: every new edge is a
+                          hierarchy change.
 """
 
 from __future__ import annotations
@@ -36,6 +43,31 @@ def _default_root() -> pathlib.Path:
     return pathlib.Path.cwd()
 
 
+def _write_locks_manifest(root: pathlib.Path) -> int:
+    from .core import load_project
+    from .flow import (MANIFEST_NAME, LocksManifest, build_manifest,
+                       find_cycle)
+    from .hierarchy_doc import render_hierarchy
+    project = load_project(root)
+    path = root / MANIFEST_NAME
+    prior = LocksManifest.load(path)
+    manifest = build_manifest(project, prior)
+    cycle = find_cycle(manifest.order_edges())
+    manifest.save(path)
+    doc = root / "docs" / "LOCK_HIERARCHY.md"
+    doc.parent.mkdir(parents=True, exist_ok=True)
+    doc.write_text(render_hierarchy(manifest))
+    print(f"locks manifest written: {path} "
+          f"({len(manifest.order)} edge(s), {len(manifest.locks)} "
+          f"lock(s), {len(manifest.lockfree)} lockfree declaration(s))")
+    print(f"hierarchy doc written: {doc}")
+    if cycle:
+        print("WARNING: the derived order contains a cycle: "
+              + " -> ".join(cycle))
+        return 1
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m automerge_tpu.analysis",
@@ -45,11 +77,15 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--baseline", default=None)
     ap.add_argument("--no-baseline", action="store_true")
     ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument("--write-locks-manifest", action="store_true")
     ap.add_argument("--list", action="store_true", dest="list_all")
     args = ap.parse_args(argv)
 
     root = pathlib.Path(args.root).resolve() if args.root \
         else _default_root()
+
+    if args.write_locks_manifest:
+        return _write_locks_manifest(root)
     baseline_path = pathlib.Path(args.baseline) if args.baseline \
         else (root / BASELINE_NAME
               if (root / BASELINE_NAME).exists() else None)

@@ -282,9 +282,10 @@ def default_passes() -> list:
     top-level) so `core` stays importable from the pass modules."""
     from .jit_hygiene import JitHygienePass
     from .lock_discipline import LockDisciplinePass
+    from .races import RacePass
     from .registry import RegistryConformancePass
     return [RegistryConformancePass(), JitHygienePass(),
-            LockDisciplinePass()]
+            LockDisciplinePass(), RacePass()]
 
 
 def run_passes(project: Project, passes: list | None = None

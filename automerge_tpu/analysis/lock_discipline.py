@@ -1,17 +1,13 @@
-"""lock-discipline pass: ordering, blocking-under-lock, thread hygiene.
+"""lock-discipline pass: ordering, blocking-under-lock, thread hygiene,
+and the committed lock-hierarchy manifest.
 
 Scope: `sync/` and `utils/` — the layers where socket reader threads, the
 watchdog checker, the audit loop, and application threads all meet the
 same locks (Connection, the tcp read/accept loops, the service lock, the
-flight-recorder ring, the metrics store).
-
-The pass builds a lock-acquisition graph: every `with <lock>:` block is
-an acquisition site; locks are identified by their owning class and
-attribute (`EngineDocSet._lock`, `_Metrics.lock`, `*._sync_lock` when the
-owner cannot be pinned). Call edges (self-methods, super() methods, and
-module functions resolvable through imports) extend each block's
-footprint transitively, so a `with` body that calls a method which takes
-another lock still contributes an ordering edge.
+flight-recorder ring, the metrics store). The shared call-graph and
+lock-footprint machinery lives in `analysis/flow.py` (it also feeds
+`threadmap.py` / `races.py` and the lock-hierarchy manifest); this
+module keeps only the rules.
 
 Rules:
 
@@ -35,6 +31,20 @@ Rules:
   unreadable.
 - **thread-join** (error): a `daemon=False` thread whose module never
   joins it — non-daemon threads need an owner that joins them.
+- **lock-manifest-drift** (error): a lock-ordering edge observed in the
+  code (over the full race scope, `sync/`+`utils/`+`perf/`) that is not
+  in the committed `locks_manifest.json` — new lock nesting must be an
+  explicit, reviewed manifest change.
+- **lock-manifest-stale** (warning): a manifest edge the code no longer
+  exhibits — prune it on the next regeneration.
+- **lock-order-cycle** (error): the union of committed and observed
+  edges contains a cycle — the hierarchy must stay a DAG or the
+  acquisition-order invariant (and the runtime sanitizer that enforces
+  it) is meaningless.
+
+The manifest rules only run when `locks_manifest.json` exists at the
+project root (fixture projects in tests don't carry one). Regenerate
+with `python -m automerge_tpu.analysis --write-locks-manifest`.
 
 Known limits (docs/ANALYSIS.md): `.acquire()`/`.release()` pairs are not
 tracked (the codebase uses `with` exclusively), duck-typed calls across
@@ -47,196 +57,11 @@ e.g. the watchdog that covers them).
 from __future__ import annotations
 
 import ast
-from dataclasses import dataclass, field
 
 from .core import Finding, Project, SourceUnit, dotted_name
-from .jit_hygiene import _Func, _ModuleIndex, _module_index
-
-DEFAULT_SCOPE = ("automerge_tpu/sync/", "automerge_tpu/utils/")
-
-_LOCK_FACTORIES = {
-    "threading.Lock", "threading.RLock", "threading.Condition",
-    "threading.Semaphore", "threading.BoundedSemaphore",
-    # the lockprof wrappers (utils/lockprof.py) are drop-in lock
-    # factories: an instrumented lock must keep its class-qualified
-    # identity (EngineDocSet._lock) and keep participating in ABBA /
-    # blocking-call analysis — profiling a lock must never exempt it
-    # from the discipline the profile exists to inform
-    "automerge_tpu.utils.lockprof.InstrumentedLock",
-    "automerge_tpu.utils.lockprof.InstrumentedRLock",
-    "automerge_tpu.utils.lockprof.InstrumentedCondition",
-    "lockprof.InstrumentedLock", "lockprof.InstrumentedRLock",
-    "lockprof.InstrumentedCondition",
-}
-_THREAD_FACTORY = "threading.Thread"
-
-# attribute names that read as lock objects even without a visible
-# factory assignment (the tcp sync lock is created behind a helper)
-_LOCKISH_HINTS = ("lock", "mutex")
-_CV_NAMES = {"_cv", "cv", "cond", "_cond", "condition"}
-
-# direct blocking attribute calls, by hazard class
-_BLOCKING_ATTRS = {
-    "recv": "socket", "recv_into": "socket", "recvfrom": "socket",
-    "accept": "socket", "sendall": "socket", "connect": "socket",
-    "getaddrinfo": "socket",
-    "sleep": "sleep",
-    "block_until_ready": "device-readback", "device_get": "device-readback",
-}
-# duck-typed engine reads: a readback barrier whoever the receiver is
-# (audit_state/audit_shard_state compute full hash fan-outs — serving an
-# audit pull on a transport reader thread is the documented caveat in
-# sync/audit.py's "Thread-cost note")
-_ENGINE_READ_ATTRS = {"hashes": "device-readback",
-                      "hashes_for": "device-readback",
-                      "hashes_snapshot": "device-readback",
-                      "materialize": "device-readback",
-                      "audit_state": "device-readback",
-                      "audit_shard_state": "device-readback"}
-_BLOCKING_NAME_CALLS = {"send_frame": "socket", "recv_frame": "socket"}
-
-
-@dataclass
-class _FuncSummary:
-    func: _Func
-    acquires: set[str] = field(default_factory=set)     # direct lock ids
-    blocks: set[str] = field(default_factory=set)       # direct hazard descs
-    calls: set[tuple] = field(default_factory=set)      # callee func keys
-
-
-class _ClassMap:
-    """Class-level lookups for one module: declared locks, base classes,
-    and method resolution (incl. single-level inheritance + super())."""
-
-    def __init__(self, unit: SourceUnit, idx: _ModuleIndex):
-        self.unit = unit
-        self.idx = idx
-        self.class_lock_attrs: dict[str, set[str]] = {}   # class -> attrs
-        self.attr_owners: dict[str, set[str]] = {}        # attr -> classes
-        self.bases: dict[str, list[str]] = {}             # class -> dotted
-        self.thread_targets: set[str] = set()             # names/attrs
-        self._collect()
-
-    def _collect(self) -> None:
-        for node in ast.walk(self.unit.tree):
-            if isinstance(node, ast.ClassDef):
-                self.bases[node.name] = [
-                    dotted_name(b) for b in node.bases if dotted_name(b)]
-        stack: list[tuple[str | None, ast.AST]] = [(None, self.unit.tree)]
-        while stack:
-            cls, node = stack.pop()
-            for child in ast.iter_child_nodes(node):
-                stack.append((child.name if isinstance(child, ast.ClassDef)
-                              else cls, child))
-            if not isinstance(node, ast.Assign) or \
-                    not isinstance(node.value, ast.Call):
-                continue
-            callee = dotted_name(node.value.func)
-            resolved = self.idx.resolve_dotted(callee) if callee else None
-            is_lock = resolved in _LOCK_FACTORIES
-            is_thread = resolved == _THREAD_FACTORY
-            if not (is_lock or is_thread):
-                continue
-            for tgt in node.targets:
-                attr = None
-                owner = None
-                if isinstance(tgt, ast.Attribute):
-                    attr = tgt.attr
-                    if isinstance(tgt.value, ast.Name) \
-                            and tgt.value.id == "self":
-                        owner = cls
-                elif isinstance(tgt, ast.Name):
-                    attr = tgt.id
-                if attr is None:
-                    continue
-                if is_thread:
-                    self.thread_targets.add(attr)
-                    continue
-                self.attr_owners.setdefault(attr, set())
-                if owner:
-                    self.attr_owners[attr].add(owner)
-                    self.class_lock_attrs.setdefault(owner, set()).add(attr)
-
-    def enclosing_class(self, qualname: str) -> str | None:
-        """Nearest enclosing segment that names a class — handles methods
-        ("C.m") and functions nested in methods ("C.m._cm")."""
-        parts = qualname.split(".")
-        for i in range(len(parts) - 2, -1, -1):
-            if parts[i] in self.bases:
-                return parts[i]
-        return None
-
-    def lock_id(self, expr: ast.AST, qualname: str) -> str | None:
-        """The lock identity of a with-item expression, or None if the
-        expression does not read as a lock."""
-        name = dotted_name(expr)
-        if name is None:
-            return None
-        attr = name.rsplit(".", 1)[-1]
-        lockish = (any(h in attr.lower() for h in _LOCKISH_HINTS)
-                   or attr in _CV_NAMES or attr in self.attr_owners)
-        if not lockish:
-            return None
-        cls = self.enclosing_class(qualname)
-        if name.startswith("self.") and name.count(".") == 1:
-            if cls:
-                # walk the MRO the pass can see: the class itself, then
-                # its (project-resolvable) bases
-                for c in [cls] + self._base_names(cls):
-                    if attr in self.class_lock_attrs.get(c, set()):
-                        return f"{c}.{attr}"
-            owners = self.attr_owners.get(attr, set())
-            if len(owners) == 1:
-                return f"{next(iter(owners))}.{attr}"
-            return f"*.{attr}"
-        owners = self.attr_owners.get(attr, set())
-        if len(owners) == 1 and "." in name:
-            return f"{next(iter(owners))}.{attr}"
-        if "." not in name:           # module-global lock
-            return f"{self.unit.modname.rsplit('.', 1)[-1]}.{attr}"
-        return f"*.{attr}"
-
-    def _base_names(self, cls: str) -> list[str]:
-        out = []
-        for b in self.bases.get(cls, []):
-            out.append(b.rsplit(".", 1)[-1])
-        return out
-
-    def resolve_method(self, cls: str, meth: str) -> _Func | None:
-        """C.meth in this module, else in a base class (single level,
-        project-resolvable bases only)."""
-        f = self.idx.all_funcs.get(f"{cls}.{meth}")
-        if f is not None:
-            return f
-        return self.resolve_in_bases(cls, meth)
-
-    def resolve_in_bases(self, cls: str, meth: str) -> _Func | None:
-        """`meth` looked up on cls's base classes ONLY — the super()
-        path, where the subclass's own override must be skipped."""
-        for b in self.bases.get(cls, []):
-            resolved = self.idx.resolve_dotted(b)
-            if "." in resolved:
-                modname, bcls = resolved.rsplit(".", 1)
-                u = self.idx.project.by_modname(modname)
-                if u is not None:
-                    bidx = _module_index(self.idx.project, u)
-                    f = bidx.all_funcs.get(f"{bcls}.{meth}")
-                    if f is not None:
-                        return f
-            f = self.idx.all_funcs.get(f"{resolved.rsplit('.', 1)[-1]}"
-                                       f".{meth}")
-            if f is not None:
-                return f
-        return None
-
-
-def _is_str_receiver(expr: ast.AST) -> bool:
-    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
-        return True
-    if isinstance(expr, ast.JoinedStr):
-        return True
-    name = dotted_name(expr)
-    return name in {"os.path", "posixpath", "ntpath", "str", "string"}
+from .flow import (DEFAULT_SCOPE, MANIFEST_NAME, RACE_SCOPE, THREAD_FACTORY,
+                   LocksManifest, find_cycle, flow_index, lock_graph)
+from .jit_hygiene import _ModuleIndex
 
 
 class LockDisciplinePass:
@@ -246,205 +71,51 @@ class LockDisciplinePass:
         self.scope = scope
 
     def run(self, project: Project) -> list[Finding]:
-        units = project.under(*self.scope)
         findings: set[Finding] = set()
-        summaries: dict[tuple, _FuncSummary] = {}
-        classmaps: dict[str, _ClassMap] = {}
+        fi = flow_index(project, self.scope)
 
-        for unit in units:
-            idx = _module_index(project, unit)
-            classmaps[unit.rel] = _ClassMap(unit, idx)
-        for unit in units:
-            idx = _module_index(project, unit)
-            cmap = classmaps[unit.rel]
-            for f in idx.all_funcs.values():
-                summaries[f.key()] = self._summarize(f, idx, cmap)
-            self._check_threads(unit, idx, findings)
+        for unit in fi.units:
+            self._check_threads(unit, fi.index(unit), findings)
 
-        trans_acq, trans_blk = self._fixpoint(summaries)
+        edges: dict[tuple[str, str], list[tuple[str, int, str]]] = {}
 
-        edges: dict[tuple[str, str], list[tuple[str, int]]] = {}
-        for unit in units:
-            idx = _module_index(project, unit)
-            cmap = classmaps[unit.rel]
-            for f in idx.all_funcs.values():
-                self._walk_holds(f, idx, cmap, summaries, trans_acq,
-                                 trans_blk, edges, findings)
+        def on_edge(a, b, label, line, rel):
+            edges.setdefault((a, b), []).append((label, line, rel))
+
+        for unit in fi.units:
+            for f in fi.index(unit).all_funcs.values():
+
+                def on_block(node, hid, desc, callee, _f=f):
+                    self._flag_block(_f, node, hid, desc, callee, findings)
+
+                fi.walk_holds(f, on_edge=on_edge, on_block=on_block)
 
         self._check_order(edges, findings)
+        self._check_manifest(project, findings)
         return sorted(findings,
                       key=lambda f: (f.path, f.line, f.col, f.rule))
 
-    # -- per-function summaries ---------------------------------------------
-
-    def _resolve_call(self, node: ast.Call, f: _Func, idx: _ModuleIndex,
-                      cmap: _ClassMap) -> _Func | None:
-        # self.m() / super().m() before the generic resolver
-        if isinstance(node.func, ast.Attribute):
-            v = node.func.value
-            cls = cmap.enclosing_class(f.qualname)
-            if isinstance(v, ast.Name) and v.id == "self" and cls:
-                return cmap.resolve_method(cls, node.func.attr)
-            if isinstance(v, ast.Call) and isinstance(v.func, ast.Name) \
-                    and v.func.id == "super" and cls:
-                # NOT resolve_method: that returns the subclass's own
-                # override, which is exactly what super() skips
-                return cmap.resolve_in_bases(cls, node.func.attr)
-        return idx.resolve_func(node.func)
-
-    def _blocking_desc(self, node: ast.Call, cmap: _ClassMap,
-                       held_exprs: list[str]) -> str | None:
-        if isinstance(node.func, ast.Name):
-            hz = _BLOCKING_NAME_CALLS.get(node.func.id)
-            return f"{hz}:{node.func.id}()" if hz else None
-        if not isinstance(node.func, ast.Attribute):
-            return None
-        attr = node.func.attr
-        recv = node.func.value
-        if attr == "join":
-            if _is_str_receiver(recv):
-                return None
-            rname = dotted_name(recv) or ""
-            tail = rname.rsplit(".", 1)[-1]
-            if tail in cmap.thread_targets or "thread" in tail.lower() \
-                    or tail == "t":
-                return f"thread-join:{rname or 'thread'}.join()"
-            return None
-        if attr == "wait":
-            rname = dotted_name(recv)
-            if rname is not None and rname in held_exprs:
-                return None     # cv.wait releases the held condition
-            return f"wait:{rname or '?'}.wait()"
-        hz = _BLOCKING_ATTRS.get(attr) or _ENGINE_READ_ATTRS.get(attr)
-        if hz:
-            rname = dotted_name(recv)
-            return f"{hz}:{(rname + '.') if rname else ''}{attr}()"
-        return None
-
-    def _summarize(self, f: _Func, idx: _ModuleIndex,
-                   cmap: _ClassMap) -> _FuncSummary:
-        """Direct acquisitions/blocks/calls of ONE function. Nested defs
-        are excluded — they have their own summaries, and their bodies may
-        run on another thread entirely (a closure spawned as a Thread
-        target must not make its spawner look blocking)."""
-        s = _FuncSummary(f)
-
-        def visit(node):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                 ast.Lambda)):
-                return              # summarized separately
-            if isinstance(node, ast.With):
-                for item in node.items:
-                    lid = cmap.lock_id(item.context_expr, f.qualname)
-                    if lid:
-                        s.acquires.add(lid)
-            elif isinstance(node, ast.Call):
-                callee = self._resolve_call(node, f, idx, cmap)
-                if callee is not None and callee.key() != f.key():
-                    s.calls.add(callee.key())
-                else:
-                    desc = self._blocking_desc(node, cmap, [])
-                    if desc:
-                        s.blocks.add(desc)
-            for child in ast.iter_child_nodes(node):
-                visit(child)
-
-        body = f.node.body if isinstance(f.node.body, list) else [f.node.body]
-        for stmt in body:
-            visit(stmt)
-        return s
+    # -- blocking under a held lock -------------------------------------------
 
     @staticmethod
-    def _fixpoint(summaries: dict) -> tuple[dict, dict]:
-        trans_acq = {k: set(s.acquires) for k, s in summaries.items()}
-        trans_blk = {k: set(s.blocks) for k, s in summaries.items()}
-        changed = True
-        rounds = 0
-        while changed and rounds < 50:
-            changed = False
-            rounds += 1
-            for k, s in summaries.items():
-                for c in s.calls:
-                    if c in trans_acq:
-                        if not trans_acq[c] <= trans_acq[k]:
-                            trans_acq[k] |= trans_acq[c]
-                            changed = True
-                        if not trans_blk[c] <= trans_blk[k]:
-                            trans_blk[k] |= trans_blk[c]
-                            changed = True
-        return trans_acq, trans_blk
-
-    # -- with-block walking ---------------------------------------------------
-
-    def _walk_holds(self, f: _Func, idx: _ModuleIndex, cmap: _ClassMap,
-                    summaries, trans_acq, trans_blk, edges,
-                    findings: set) -> None:
-        held: list[tuple[str, str]] = []   # (lock id, dotted expr)
-        label = f"{f.unit.modname.rsplit('.', 1)[-1]}.{f.qualname}"
-
-        def flag(node, message):
-            findings.add(Finding(
-                rule="block-under-lock", path=f.unit.rel,
-                line=node.lineno, col=node.col_offset,
-                severity="error", message=message))
-
-        def visit(node):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                 ast.Lambda)) and node is not f.node:
-                return
-            if isinstance(node, ast.With):
-                entered = 0
-                for item in node.items:
-                    lid = cmap.lock_id(item.context_expr, f.qualname)
-                    if lid:
-                        for hid, _ in held:
-                            if hid != lid:
-                                edges.setdefault((hid, lid), []).append(
-                                    (label, item.context_expr.lineno,
-                                     f.unit.rel))
-                        held.append(
-                            (lid, dotted_name(item.context_expr) or lid))
-                        entered += 1
-                for child in node.body:
-                    visit(child)
-                for item in node.items:   # re-visit exprs for call checks
-                    visit(item.context_expr)
-                del held[len(held) - entered:len(held)]
-                return
-            if isinstance(node, ast.Call) and held:
-                hid, _ = held[-1]
-                callee = self._resolve_call(node, f, idx, cmap)
-                if callee is not None and callee.key() != f.key():
-                    ck = callee.key()
-                    for inner in trans_acq.get(ck, ()):  # transitive edges
-                        if inner != hid:
-                            edges.setdefault((hid, inner), []).append(
-                                (label, node.lineno, f.unit.rel))
-                    blk = trans_blk.get(ck, ())
-                    if blk:
-                        desc = sorted(blk)[0]
-                        flag(node,
-                             f"call to {callee.qualname}() while holding "
-                             f"{hid} reaches a blocking "
-                             f"{desc.split(':', 1)[0]} call "
-                             f"({desc.split(':', 1)[1]}) — the r5 stall "
-                             "class (every thread needing the lock queues "
-                             "behind it)")
-                else:
-                    desc = self._blocking_desc(
-                        node, cmap, [e for _, e in held])
-                    if desc:
-                        hz, what = desc.split(":", 1)
-                        flag(node,
-                             f"blocking {hz} call {what} while holding "
-                             f"{hid} — the r5 stall class (every thread "
-                             "needing the lock queues behind it)")
-            for child in ast.iter_child_nodes(node):
-                visit(child)
-
-        body = f.node.body if isinstance(f.node.body, list) else [f.node.body]
-        for stmt in body:
-            visit(stmt)
+    def _flag_block(f, node, hid, desc, callee, findings: set) -> None:
+        if callee is not None:
+            hz, what = desc.split(":", 1)
+            message = (f"call to {callee.qualname}() while holding "
+                       f"{hid} reaches a blocking "
+                       f"{hz} call "
+                       f"({what}) — the r5 stall "
+                       "class (every thread needing the lock queues "
+                       "behind it)")
+        else:
+            hz, what = desc.split(":", 1)
+            message = (f"blocking {hz} call {what} while holding "
+                       f"{hid} — the r5 stall class (every thread "
+                       "needing the lock queues behind it)")
+        findings.add(Finding(
+            rule="block-under-lock", path=f.unit.rel,
+            line=node.lineno, col=node.col_offset,
+            severity="error", message=message))
 
     # -- orderings ------------------------------------------------------------
 
@@ -463,6 +134,45 @@ class LockDisciplinePass:
                              f"{fn_ba}() — ABBA deadlock when the two "
                              "paths race")))
 
+    # -- the committed manifest ------------------------------------------------
+
+    @staticmethod
+    def _check_manifest(project: Project, findings: set) -> None:
+        manifest = LocksManifest.load(project.root / MANIFEST_NAME)
+        if manifest is None:
+            return
+        observed = lock_graph(project, RACE_SCOPE)
+        committed = manifest.order_edges()
+        for (a, b), sites in sorted(observed.items()):
+            if (a, b) in committed:
+                continue
+            label, line, rel = sites[0]
+            findings.add(Finding(
+                rule="lock-manifest-drift", path=rel, line=line, col=0,
+                severity="error",
+                message=(f"lock-order edge {a} -> {b} (in {label}()) is "
+                         f"not in {MANIFEST_NAME} — new lock nesting must "
+                         "be an explicit, reviewed manifest change "
+                         "(regenerate with python -m "
+                         "automerge_tpu.analysis --write-locks-manifest)")))
+        for (a, b) in sorted(committed - set(observed)):
+            findings.add(Finding(
+                rule="lock-manifest-stale", path=MANIFEST_NAME,
+                line=1, col=0, severity="warning",
+                message=(f"manifest edge {a} -> {b} no longer observed in "
+                         "the code — prune it on the next "
+                         "--write-locks-manifest regeneration")))
+        cycle = find_cycle(committed | set(observed))
+        if cycle:
+            findings.add(Finding(
+                rule="lock-order-cycle", path=MANIFEST_NAME,
+                line=1, col=0, severity="error",
+                message=("lock hierarchy contains a cycle: "
+                         + " -> ".join(cycle)
+                         + " — the acquisition order must stay a DAG or "
+                         "the ABBA invariant (and utils/locksan.py) is "
+                         "meaningless")))
+
     # -- thread hygiene --------------------------------------------------------
 
     def _check_threads(self, unit: SourceUnit, idx: _ModuleIndex,
@@ -479,7 +189,7 @@ class LockDisciplinePass:
             if not isinstance(node, ast.Call):
                 continue
             callee = dotted_name(node.func)
-            if not callee or idx.resolve_dotted(callee) != _THREAD_FACTORY:
+            if not callee or idx.resolve_dotted(callee) != THREAD_FACTORY:
                 continue
             kwargs = {kw.arg for kw in node.keywords}
             daemon_kw = next((kw for kw in node.keywords

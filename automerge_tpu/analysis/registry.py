@@ -35,6 +35,12 @@ Rules:
   discipline for `flightrec.record` event kinds.
 - **metric-scheme** (error): a REGISTRY entry violating the naming scheme
   itself, or an alias pointing at an unregistered canonical name.
+- **env-knob-undocumented** (error): an `AMTPU_*` environment knob read
+  (`os.environ.get` / `os.getenv` / `os.environ[...]`, literal name)
+  that the docs/OBSERVABILITY.md "Environment knobs" table never
+  mentions. A knob nobody can discover is configuration rot — the same
+  failure mode as an unregistered metric, one layer up. Skipped when
+  the doc is absent (fixture projects).
 
 Scope: the whole package + bench.py (same as the old lint).
 """
@@ -296,6 +302,74 @@ def _classify_call(node: ast.Call, aliases: dict[str, str],
     return None
 
 
+ENV_KNOB_PREFIX = "AMTPU_"
+_KNOB_DOC_REL = "docs/OBSERVABILITY.md"
+_KNOB_SECTION_RE = re.compile(
+    r"^##\s+Environment knobs\s*$(.*?)(?=^##\s|\Z)",
+    re.MULTILINE | re.DOTALL)
+_KNOB_TOKEN_RE = re.compile(r"\bAMTPU_[A-Z0-9_]+\b")
+
+
+def documented_knobs(project: Project) -> set[str] | None:
+    """AMTPU_* names the OBSERVABILITY.md knob table documents, or None
+    when the doc is absent (fixture projects: the rule disarms). Scans
+    the "Environment knobs" section when present, the whole file
+    otherwise — a knob documented anywhere beats a finding."""
+    doc = project.root / "docs" / "OBSERVABILITY.md"
+    try:
+        text = doc.read_text()
+    except OSError:
+        return None
+    m = _KNOB_SECTION_RE.search(text)
+    scope = m.group(1) if m else text
+    return set(_KNOB_TOKEN_RE.findall(scope))
+
+
+def extract_env_reads(project: Project
+                      ) -> list[tuple[str, int, int, str]]:
+    """Every literal AMTPU_* environment read: (rel, line, col, name).
+    Recognized forms: `os.environ.get(K, ...)`, `os.getenv(K, ...)`,
+    `os.environ[K]`, and the `from os import environ/getenv` spellings.
+    Dynamic names are ignored (there are none today; a computed knob
+    name would defeat the table anyway)."""
+    out: list[tuple[str, int, int, str]] = []
+    for unit in project.units:
+        if unit.rel.startswith("automerge_tpu/analysis/"):
+            continue            # the lint's own sources talk ABOUT names
+        if ENV_KNOB_PREFIX not in unit.text:
+            continue
+        aliases = _import_aliases(unit)
+
+        def _is_environ(node: ast.AST) -> bool:
+            d = dotted_name(node)
+            if d == "os.environ":
+                return True
+            return d is not None and aliases.get(d) == "os.environ"
+
+        for node in ast.walk(unit.tree):
+            name_node = None
+            if isinstance(node, ast.Call):
+                fn = node.func
+                if isinstance(fn, ast.Attribute):
+                    if fn.attr == "get" and _is_environ(fn.value):
+                        name_node = node.args[0] if node.args else None
+                    elif fn.attr == "getenv" and \
+                            dotted_name(fn.value) == "os":
+                        name_node = node.args[0] if node.args else None
+                elif isinstance(fn, ast.Name) and \
+                        aliases.get(fn.id) == "os.getenv":
+                    name_node = node.args[0] if node.args else None
+            elif isinstance(node, ast.Subscript) and \
+                    _is_environ(node.value):
+                name_node = node.slice
+            if isinstance(name_node, ast.Constant) and \
+                    isinstance(name_node.value, str) and \
+                    name_node.value.startswith(ENV_KNOB_PREFIX):
+                out.append((unit.rel, node.lineno, node.col_offset,
+                            name_node.value))
+    return out
+
+
 def registry_scheme_problems() -> list[str]:
     """Violations inside the registry itself (names off-scheme, aliases
     dangling). Used by the pass and by tests/test_metrics_lint.py."""
@@ -394,4 +468,20 @@ class RegistryConformancePass:
             findings.append(Finding(
                 rule="metric-scheme", path=metrics_rel, line=1, col=0,
                 severity="error", message=problem))
+
+        knobs = documented_knobs(project)
+        if knobs is not None:
+            flagged: set[tuple[str, str]] = set()
+            for rel, line, col, knob in extract_env_reads(project):
+                if knob in knobs or (rel, knob) in flagged:
+                    continue
+                flagged.add((rel, knob))    # one finding per (file, knob)
+                findings.append(Finding(
+                    rule="env-knob-undocumented", path=rel,
+                    line=line, col=col, severity="error",
+                    message=(f"environment knob {knob!r} is read here "
+                             f"but missing from the {_KNOB_DOC_REL} "
+                             "'Environment knobs' table — an "
+                             "undiscoverable knob is configuration rot; "
+                             "document it (name, default, effect)")))
         return findings

@@ -211,6 +211,12 @@ def main(argv=None) -> int:
         # doc -> snapshot -> cold-boot a fresh replica, byte-equal hashes
         from . import bootstrap
         return bootstrap.smoke_main(rest)
+    if cmd == "race":
+        # the race-plane smoke (verify.sh stage 2): a threaded sync
+        # storm under AMTPU_LOCKSAN=1 — zero sanitizer violations,
+        # sanitizer overhead < 5%
+        from . import raceplane
+        return raceplane.smoke_main(rest)
     if cmd == "roofline":
         from . import roofline
         roofline.main(rest)
@@ -221,7 +227,7 @@ def main(argv=None) -> int:
         return 0
     print(f"unknown command {cmd!r}; expected one of "
           "report, check, contention, doctor, explain, top, dispatch, "
-          "tenant, remediate, move, bootstrap, roofline, resident",
+          "tenant, remediate, move, bootstrap, race, roofline, resident",
           file=sys.stderr)
     return 2
 

@@ -47,7 +47,7 @@ import threading
 import time
 from collections import deque
 
-from ..utils import flightrec, metrics
+from ..utils import flightrec, locksan, metrics
 
 log = logging.getLogger("automerge_tpu.fleet")
 
@@ -285,6 +285,14 @@ class FleetCollector:
         self.nodes: dict[str, NodeState] = {}
         self._locals: list[tuple[str, object]] = []   # (name, snapshot_fn)
         self._wires: list[dict] = []                  # peer records
+        # guards the source registries (nodes/_locals/_wires): callers
+        # register sources from their own threads while the collector
+        # thread iterates them every tick — an unguarded registration
+        # mid-scrape is a "dict changed size during iteration" away
+        # from killing the loop (found by graftlint shared-mutate-
+        # aliased; regression-pinned in tests/test_race_regressions.py).
+        # Leaf-ish: never held across _inbox_lock or a scrape callback.
+        self._sources_lock = locksan.named_lock("fleet_sources")
         self._inbox_lock = threading.Lock()
         self._scrape_costs: deque = deque(maxlen=256)
         self.ticks = 0
@@ -299,7 +307,8 @@ class FleetCollector:
         global `metrics.snapshot`) runs on the collector thread each
         tick."""
         fn = snapshot_fn or metrics.snapshot
-        self._locals.append((name, fn))
+        with self._sources_lock:
+            self._locals.append((name, fn))
         return self._node(name, role)
 
     def add_peer(self, connection, name: str | None = None,
@@ -308,8 +317,7 @@ class FleetCollector:
         The node is named by the peer's self-reported label when its
         first answer arrives (Connection.peer_node), falling back to
         `name`/`peer<k>`. Issues the first pull immediately."""
-        rec = {"conn": connection,
-               "name": name or f"peer{len(self._wires)}",
+        rec = {"conn": connection, "name": name,
                "role": role, "inbox": []}
 
         def _arrived(snapshot, rec=rec):
@@ -317,7 +325,10 @@ class FleetCollector:
                 rec["inbox"].append((time.time(), snapshot))
 
         connection.on_peer_metrics = _arrived
-        self._wires.append(rec)
+        with self._sources_lock:
+            if rec["name"] is None:
+                rec["name"] = f"peer{len(self._wires)}"
+            self._wires.append(rec)
         try:
             connection.request_metrics()
         except Exception:
@@ -331,11 +342,14 @@ class FleetCollector:
         tick) — and the label is no longer 'taken' by a dead record,
         which is what would otherwise strand the replacement on a
         positional name."""
-        for rec in list(self._wires):
-            if rec["conn"] is connection:
+        with self._sources_lock:
+            victims = [rec for rec in self._wires
+                       if rec["conn"] is connection]
+            for rec in victims:
                 self._wires.remove(rec)
-                if getattr(connection, "on_peer_metrics", None) is not None:
-                    connection.on_peer_metrics = None
+        for rec in victims:
+            if getattr(connection, "on_peer_metrics", None) is not None:
+                connection.on_peer_metrics = None
 
     # -- quarantine (perf/remediate.py's isolation primitive) -----------------
 
@@ -345,30 +359,34 @@ class FleetCollector:
         unquarantined. Sticky across reconnects — a quarantined peer
         that redials is still quarantined. The node stays in the table
         with its marker: quarantine is disclosure, not amnesia."""
-        st = self._node(name, "node") if name not in self.nodes \
-            else self.nodes[name]
+        st = self._node(name, "node")
         st.quarantined = True
         self._refresh_quarantine_gauge()
 
     def unquarantine(self, name: str) -> None:
-        st = self.nodes.get(name)
+        with self._sources_lock:
+            st = self.nodes.get(name)
         if st is not None:
             st.quarantined = False
         self._refresh_quarantine_gauge()
 
     def quarantined(self) -> list[str]:
-        return sorted(n for n, st in self.nodes.items() if st.quarantined)
+        with self._sources_lock:
+            items = list(self.nodes.items())
+        return sorted(n for n, st in items if st.quarantined)
 
     def _refresh_quarantine_gauge(self) -> None:
+        with self._sources_lock:
+            states = list(self.nodes.values())
         metrics.gauge("obs_remed_quarantined",
-                      sum(1 for st in self.nodes.values()
-                          if st.quarantined))
+                      sum(1 for st in states if st.quarantined))
 
     def _node(self, name: str, role: str) -> NodeState:
-        st = self.nodes.get(name)
-        if st is None:
-            st = self.nodes[name] = NodeState(name, role=role,
-                                              ring=self.ring)
+        with self._sources_lock:
+            st = self.nodes.get(name)
+            if st is None:
+                st = self.nodes[name] = NodeState(name, role=role,
+                                                  ring=self.ring)
         return st
 
     # -- the tick ------------------------------------------------------------
@@ -380,15 +398,21 @@ class FleetCollector:
         Returns fleet_state()."""
         t0 = time.perf_counter()
         now = time.time()
-        for name, fn in self._locals:
+        # snapshot the registries: sources registered mid-tick are
+        # picked up next tick, and the iteration never races a
+        # registration (the callbacks below must run unlocked)
+        with self._sources_lock:
+            local_srcs = list(self._locals)
+            wires = list(self._wires)
+        for name, fn in local_srcs:
             try:
                 snap = fn()
             except Exception:
                 continue
-            st = self.nodes[name]
+            st = self._node(name, "node")
             if isinstance(snap, dict):
                 st.add_sample(now, snap)
-        for rec in self._wires:
+        for rec in wires:
             with self._inbox_lock:
                 arrivals, rec["inbox"] = rec["inbox"], []
             conn = rec["conn"]
@@ -403,22 +427,23 @@ class FleetCollector:
                 # into one ring (interleaved registries make garbage
                 # rates), so the collision keeps its positional name and
                 # the misconfig is surfaced instead of hidden
-                taken = (any(r is not rec and r["name"] == node_label
-                             for r in self._wires)
-                         or any(n == node_label for n, _ in self._locals))
-                if taken:
-                    if not rec.get("collision_warned"):
-                        rec["collision_warned"] = True
-                        log.warning(
-                            "fleet collector: peer self-reports node "
-                            "label %r already owned by another source; "
-                            "keeping positional name %r (duplicate "
-                            "AMTPU_NODE_NAME?)", node_label, rec["name"])
-                else:
-                    placeholder = self.nodes.get(rec["name"])
-                    if placeholder is None or not placeholder.samples:
-                        self.nodes.pop(rec["name"], None)
-                        rec["name"] = node_label
+                with self._sources_lock:
+                    taken = (any(r is not rec and r["name"] == node_label
+                                 for r in self._wires)
+                             or any(n == node_label
+                                    for n, _ in self._locals))
+                    if not taken:
+                        placeholder = self.nodes.get(rec["name"])
+                        if placeholder is None or not placeholder.samples:
+                            self.nodes.pop(rec["name"], None)
+                            rec["name"] = node_label
+                if taken and not rec.get("collision_warned"):
+                    rec["collision_warned"] = True
+                    log.warning(
+                        "fleet collector: peer self-reports node "
+                        "label %r already owned by another source; "
+                        "keeping positional name %r (duplicate "
+                        "AMTPU_NODE_NAME?)", node_label, rec["name"])
             st = self._node(rec["name"], rec["role"])
             for (at, snap) in arrivals:
                 if isinstance(snap, dict):
@@ -432,7 +457,7 @@ class FleetCollector:
         dt = time.perf_counter() - t0
         self._scrape_costs.append(dt)
         metrics.observe("obs_fleet_scrape_s", dt)
-        flightrec.record("fleet_scrape", nodes=len(self.nodes),
+        flightrec.record("fleet_scrape", nodes=state["rollup"]["nodes"],
                          fresh=state["rollup"]["nodes_fresh"],
                          stragglers=len(state["stragglers"]),
                          s=round(dt, 6))
@@ -459,17 +484,21 @@ class FleetCollector:
         inflating the fleet ops/s) forever; it stays in the table with
         the stale marker and a growing scrape age."""
         stale_after = 3.0 * max(self.interval_s, 0.1)
+        # judge a point-in-time snapshot of the node table: a node
+        # registered mid-judgement is scored next tick
+        with self._sources_lock:
+            nodes = dict(self.nodes)
 
         def _fresh(st: NodeState) -> bool:
             return st.last_at is not None and now - st.last_at <= stale_after
 
         latest = {n: (st.latest()
                       if _fresh(st) and not st.quarantined else None)
-                  for n, st in self.nodes.items()}
+                  for n, st in nodes.items()}
         scores: dict[str, tuple[float, str | None]] = {
-            n: (0.0, None) for n in self.nodes}
+            n: (0.0, None) for n in nodes}
         roles: dict[str, list[str]] = {}
-        for n, st in self.nodes.items():
+        for n, st in nodes.items():
             roles.setdefault(st.role, []).append(n)
         for role, members in roles.items():
             if len(members) < self.min_nodes:
@@ -485,7 +514,7 @@ class FleetCollector:
                     if z > scores[n][0]:
                         scores[n] = (z, signal)
         stragglers = []
-        for n, st in self.nodes.items():
+        for n, st in nodes.items():
             z, signal = scores[n]
             flagged = z >= self.k_sigma
             if flagged:
@@ -510,7 +539,7 @@ class FleetCollector:
             if isinstance(d.get("round_flush_mean_s"), (int, float)):
                 metrics.gauge("obs_fleet_round_flush_s",
                               round(d["round_flush_mean_s"], 6), node=n)
-        fresh = sum(1 for st in self.nodes.values() if _fresh(st))
+        fresh = sum(1 for st in nodes.values() if _fresh(st))
         metrics.gauge("obs_fleet_nodes_scraped", fresh)
 
         def _agg(key, how):
@@ -527,7 +556,7 @@ class FleetCollector:
             return round(vals[len(vals) // 2], 6)
 
         rollup = {
-            "nodes": len(self.nodes),
+            "nodes": len(nodes),
             "nodes_fresh": fresh,
             "ops_per_s": _agg("ops_per_s", "sum"),
             "converge_p99_s": _agg("converge_p99_s", "max"),
@@ -543,7 +572,7 @@ class FleetCollector:
                                           "max"),
             "tenant_hot_share_pct": _agg("tenant_hot_share_pct", "max"),
         }
-        tenants = self._tenant_rollup()
+        tenants = self._tenant_rollup(nodes)
         if tenants:
             rollup["tenants"] = tenants
         self._last_state = {
@@ -561,12 +590,12 @@ class FleetCollector:
                     "straggler_signal": st.straggler_signal,
                     "flagged": n in stragglers,
                     "derived": latest[n],
-                } for n, st in self.nodes.items()},
+                } for n, st in nodes.items()},
             "scrape": self.scrape_stats(),
         }
         return self._last_state
 
-    def _tenant_rollup(self) -> dict:
+    def _tenant_rollup(self, nodes: dict[str, NodeState]) -> dict:
         """Fleet-wide per-tenant merge over every scraped node's
         `"tenantledger"` section (sync/tenantledger.py): cost counters
         SUM across nodes (each node accounts its own traffic exactly
@@ -576,7 +605,7 @@ class FleetCollector:
         section."""
         merged: dict[str, dict] = {}
         total = 0
-        for st in self.nodes.values():
+        for st in nodes.values():
             snap = st.last_snapshot
             if not isinstance(snap, dict):
                 continue

@@ -1,0 +1,171 @@
+"""Race-plane smoke (verify.sh stage 2): the runtime proof that the
+committed lock hierarchy holds under real traffic.
+
+The static half of the race plane (analysis/races.py +
+locks_manifest.json, docs/ANALYSIS.md "races") proves the lock ORDER
+on paper; this smoke proves it on live threads. One storm — concurrent
+writer threads pushing docs through a TCP sync pair while a fleet
+collector scrapes — runs twice:
+
+1. **sanitizer off** (baseline): wall-time the writer loop;
+2. **AMTPU_LOCKSAN=1**: the same storm with every named lock reporting
+   to utils/locksan.py. Assertions:
+   - **zero violations** — no committed-order inversion, no long hold
+     with blocked waiters, anywhere in the storm;
+   - **overhead < 5%** — the sanitized writer loop must cost less than
+     5% over the baseline (best-of-2 per mode; one full retry absorbs a
+     noisy-neighbor timing blip). A sanitizer the fleet can't afford to
+     leave on is a sanitizer nobody runs.
+
+Fresh DocSets/servers/collectors are built AFTER each mode flips, so
+`locksan.named_lock` hands out the mode-correct flavor (plain
+`threading.Lock` when off — the zero-overhead-when-disabled contract).
+
+Exit codes: 0 = clean, 1 = violations or overhead breach. Wired as
+`python -m automerge_tpu.perf race --smoke` in verify.sh stage 2
+(informational there; the assertions are the enforcing content).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..utils import locksan
+
+#: sanitized-vs-baseline writer-loop overhead bound
+OVERHEAD_BOUND = 0.05
+
+
+def _storm(n_threads: int = 3, n_docs: int = 6, ops_per_doc: int = 5,
+           timeout_s: float = 20.0) -> float:
+    """One threaded sync storm; returns the writer-loop wall seconds.
+    Raises on writer errors or non-convergence — the smoke's race
+    assertions are meaningless over a broken storm."""
+    import automerge_tpu as am
+    from ..sync.docset import DocSet
+    from ..sync.tcp import TcpSyncClient, TcpSyncServer, sync_lock
+    from .fleet import FleetCollector
+
+    ds_server, ds_client = DocSet(), DocSet()
+    server = TcpSyncServer(ds_server)
+    server.start()
+    client = TcpSyncClient(ds_client, server.host, server.port).start()
+    collector = FleetCollector(interval_s=3600.0)   # manual ticks only
+    collector.add_local("race-smoke")
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def writer(w: int) -> None:
+        try:
+            for d in range(n_docs):
+                doc = am.init(f"w{w}")
+                for k in range(ops_per_doc):
+                    doc = am.change(
+                        doc, lambda dd, k=k: dd.__setitem__(f"k{k}", k))
+                with sync_lock(ds_client):
+                    ds_client.set_doc(f"race-{w}-{d}", doc)
+        except BaseException as e:          # noqa: BLE001 — re-raised
+            errors.append(e)
+
+    def scraper() -> None:
+        while not stop.is_set():
+            try:
+                collector.scrape_once()
+            except BaseException as e:      # noqa: BLE001 — re-raised
+                errors.append(e)
+                return
+            stop.wait(0.02)
+
+    scr = threading.Thread(target=scraper, name="race-smoke-scraper",
+                           daemon=True)
+    scr.start()
+    try:
+        threads = [threading.Thread(target=writer, args=(w,),
+                                    name=f"race-smoke-writer-{w}",
+                                    daemon=True)
+                   for w in range(n_threads)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        loop_s = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+
+        want = [f"race-{w}-{d}"
+                for w in range(n_threads) for d in range(n_docs)]
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            got = [ds_server.get_doc(i) for i in want]
+            if all(g is not None and g == ds_client.get_doc(i)
+                   for g, i in zip(got, want)):
+                return loop_s
+            time.sleep(0.05)
+        raise RuntimeError(
+            f"race smoke storm did not converge within {timeout_s}s")
+    finally:
+        stop.set()
+        scr.join(timeout=5)
+        client.close()
+        server.close()
+
+
+def _timed_pair() -> float:
+    """Best-of-2 writer-loop time for the CURRENT sanitizer mode (min
+    absorbs one-off scheduler noise better than a mean)."""
+    return min(_storm(), _storm())
+
+
+def smoke_main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="automerge_tpu.perf race")
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the race-plane smoke (default)")
+    ap.add_argument("--overhead-bound", type=float, default=OVERHEAD_BOUND)
+    args = ap.parse_args(argv)
+
+    prev = os.environ.get("AMTPU_LOCKSAN")
+    attempts = []
+    try:
+        for attempt in (1, 2):              # one retry for timing noise
+            os.environ.pop("AMTPU_LOCKSAN", None)
+            locksan._reload_for_tests()
+            base_s = _timed_pair()
+
+            os.environ["AMTPU_LOCKSAN"] = "1"
+            locksan._reload_for_tests()
+            san_s = _timed_pair()
+            vs = locksan.violations()
+            if vs:
+                print("race smoke: FAILED — sanitizer violations under "
+                      f"the storm ({len(vs)}):")
+                for v in vs[:8]:
+                    print(f"  [{v['kind']}] {v['detail']}")
+                return 1
+            overhead = (san_s - base_s) / base_s if base_s > 0 else 0.0
+            attempts.append((base_s, san_s, overhead))
+            if overhead < args.overhead_bound:
+                print(f"race smoke: CLEAN — 0 sanitizer violations; "
+                      f"writer loop {base_s:.3f}s off / {san_s:.3f}s on "
+                      f"({overhead:+.1%} overhead, bound "
+                      f"{args.overhead_bound:.0%}, attempt {attempt})")
+                return 0
+        base_s, san_s, overhead = attempts[-1]
+        print(f"race smoke: FAILED — sanitizer overhead {overhead:+.1%} "
+              f"exceeds {args.overhead_bound:.0%} on both attempts "
+              f"({base_s:.3f}s off / {san_s:.3f}s on)")
+        return 1
+    finally:
+        if prev is None:
+            os.environ.pop("AMTPU_LOCKSAN", None)
+        else:
+            os.environ["AMTPU_LOCKSAN"] = prev
+        locksan._reload_for_tests()
+
+
+if __name__ == "__main__":
+    raise SystemExit(smoke_main())

@@ -196,10 +196,18 @@ class DocLedger:
         if explicit:
             return str(explicit)
         key = id(conn)
-        lbl = self._conn_labels.get(key)
-        if lbl is None:
-            self._conn_seq += 1
-            lbl = self._conn_labels[key] = f"conn{self._conn_seq}"
+        # allocation under the lock: every tcp reader thread lands here
+        # before its record_* call, and an unlocked read-modify-write of
+        # _conn_seq can hand two connections the same positional label
+        # (found by graftlint shared-write-unlocked; regression-pinned
+        # in tests/test_race_regressions.py). conn_label is always
+        # called OUTSIDE the record_* critical sections, so the plain
+        # Lock never re-enters.
+        with self._lock:
+            lbl = self._conn_labels.get(key)
+            if lbl is None:
+                self._conn_seq += 1
+                lbl = self._conn_labels[key] = f"conn{self._conn_seq}"
         return lbl
 
     def forget_conn(self, conn) -> None:

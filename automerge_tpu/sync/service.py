@@ -736,9 +736,15 @@ class EngineDocSet:
         return self._handles[doc_id]
 
     def add_doc(self, doc_id: str) -> DocHandle:
-        if doc_id not in self._resident.doc_index:
-            self._resident.add_docs([doc_id])
-            self._log[doc_id] = {}
+        # registry mutation under the service lock: two threads adding
+        # the same unseen doc (a tcp reader racing the caller) could
+        # both pass the membership check and double-register it in the
+        # resident engine (found by graftlint shared-mutate-aliased;
+        # the RLock makes the engine-roundtrip re-entrancy safe)
+        with self._lock:
+            if doc_id not in self._resident.doc_index:
+                self._resident.add_docs([doc_id])
+                self._log[doc_id] = {}
         return self.get_doc(doc_id)
 
     def register_handler(self, handler: Callable) -> None:

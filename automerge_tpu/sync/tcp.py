@@ -33,7 +33,7 @@ import struct
 import threading
 import time
 
-from ..utils import chaos, lockprof
+from ..utils import chaos, lockprof, locksan
 from .connection import Connection
 from .frames import msg_kind as _msg_kind   # canonical home: frames.py
 
@@ -287,6 +287,12 @@ class TcpSyncServer:
         self._listener = socket.create_server((host, port))
         self.host, self.port = self._listener.getsockname()[:2]
         self.peers: list[_Peer] = []
+        # guards self.peers: the accept thread prunes/appends while
+        # close() (caller thread) snapshots — an unguarded rebind could
+        # leak a peer accepted concurrently with close (found by
+        # graftlint shared-mutate-aliased; regression-pinned in
+        # tests/test_race_regressions.py)
+        self._peers_lock = locksan.named_lock("tcp_peers")
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True,
                                                name=f"amtpu-tcp-accept-"
@@ -307,10 +313,16 @@ class TcpSyncServer:
             # clients (SupervisedTcpClient) redial after every
             # transport death, and an append-only list would grow one
             # dead _Peer per reconnect forever on a long-lived server
-            self.peers = [p for p in self.peers
-                          if not p.closed.is_set()]
             peer = _Peer(self.doc_set, sock, wire=self.wire)
-            self.peers.append(peer)
+            with self._peers_lock:
+                if self._closed.is_set():
+                    # lost the race with close(): close() already swept
+                    # the list, so this peer must not be registered
+                    peer.close()
+                    break
+                self.peers = [p for p in self.peers
+                              if not p.closed.is_set()]
+                self.peers.append(peer)
             peer.start()
 
     def close(self) -> None:
@@ -319,7 +331,9 @@ class TcpSyncServer:
             self._listener.close()
         except OSError:
             pass
-        for peer in self.peers:
+        with self._peers_lock:
+            peers = list(self.peers)
+        for peer in peers:
             peer.close()
 
 

@@ -142,6 +142,12 @@ EVENT_KINDS: dict[str, str] = {
     "remed_recovered": "a remediation episode closed: the fleet returned "
                        "to SLO-green with zero human action "
                        "(perf/remediate.py; mttr_s/actions)",
+    # race plane (utils/locksan.py — r18)
+    "locksan_violation": "the runtime lock-order sanitizer flagged a "
+                         "violation (utils/locksan.py; violation=order|"
+                         "long-hold, lock/held/hold_s — order inversions "
+                         "vs. the committed locks_manifest.json, and "
+                         "over-threshold holds with waiters pending)",
 }
 
 

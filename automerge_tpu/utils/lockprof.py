@@ -50,6 +50,12 @@ wrappers as lock factories (analysis/lock_discipline.py
 identity (`EngineDocSet._lock`) and keeps participating in ABBA /
 blocking-call analysis instead of silently degrading to the merged
 `*._lock` bucket.
+
+Runtime sanitizer: with `AMTPU_LOCKSAN=1` (utils/locksan.py) every
+outermost acquire/release also reports to the lock-order sanitizer,
+which checks live acquisition order against the committed
+`locks_manifest.json`. The disabled path costs one module-attribute
+truth test per acquire.
 """
 
 from __future__ import annotations
@@ -59,7 +65,7 @@ import threading
 import time
 import weakref
 
-from . import metrics
+from . import locksan, metrics
 
 # every live instrumented lock/condition (weak: a dropped service must
 # not pin its locks in the holder table forever)
@@ -115,10 +121,16 @@ class InstrumentedLock:
             metrics.bump("sync_lock_contended_total", lock=self.name)
             if not blocking:
                 return False
+            if locksan.on:
+                locksan.note_wait(self.name)
             t0 = time.perf_counter()
-            acquired = (self._lock.acquire()
-                        if timeout is None or timeout < 0
-                        else self._lock.acquire(timeout=timeout))
+            try:
+                acquired = (self._lock.acquire()
+                            if timeout is None or timeout < 0
+                            else self._lock.acquire(timeout=timeout))
+            finally:
+                if locksan.on:
+                    locksan.note_wait_done(self.name)
             wait_s = time.perf_counter() - t0
             if not acquired:
                 metrics.observe("sync_lock_wait_s", wait_s, lock=self.name)
@@ -129,6 +141,10 @@ class InstrumentedLock:
         self._holder = (threading.current_thread().name, me, fn, ln,
                         time.perf_counter())
         metrics.observe("sync_lock_wait_s", wait_s, lock=self.name)
+        if locksan.on:
+            # strict mode can raise here: the lock IS held at that point
+            # (the sanitizer is a test/storm harness, not production)
+            locksan.note_acquire(self.name)
         return True
 
     def release(self) -> None:
@@ -141,6 +157,8 @@ class InstrumentedLock:
         self._holder = None
         self._owner = None
         self._depth = 0
+        if locksan.on:
+            locksan.note_release(self.name)
         self._lock.release()
         if holder is not None:
             metrics.observe("sync_lock_hold_s",
@@ -168,6 +186,8 @@ class InstrumentedLock:
         self._holder = None
         self._owner = None
         self._depth = 0
+        if locksan.on:
+            locksan.note_release(self.name)
         for _ in range(depth):
             self._lock.release()
         if holder is not None:

@@ -361,6 +361,16 @@ COUNTERS: dict[str, str] = {
         "flight-recorder dumps suppressed by the per-trigger-class "
         "cooldown {reason=...} (utils/flightrec.py; a dump storm is "
         "throttled, never unbounded)",
+    # lock-order sanitizer (utils/locksan.py — r18): runtime checks of
+    # the committed locks_manifest.json hierarchy, AMTPU_LOCKSAN=1
+    "obs_locksan_order_violations_total":
+        "lock acquisitions inverting a committed locks_manifest.json "
+        "order edge {lock=...} (utils/locksan.py; each also a "
+        "locksan_violation event; AMTPU_LOCKSAN=2 additionally raises)",
+    "obs_locksan_long_holds_total":
+        "outermost lock holds exceeding AMTPU_LOCKSAN_HOLD_S released "
+        "while other threads were blocked on the same lock {lock=...} "
+        "(utils/locksan.py; the r5 stall shape caught live)",
     "sync_reconnect_attempts":
         "socket (re)connection attempts by the reconnect supervisor "
         "(sync/tcp.SupervisedTcpClient; includes the refused ones)",

@@ -2,12 +2,15 @@
 # verify.sh — the one command a builder runs before claiming "tier-1 green".
 #
 # Stage 1: static analysis (fast fail): graftlint runs the registry,
-#          jit-hygiene, and lock-discipline passes against the committed
-#          analysis_baseline.json (docs/ANALYSIS.md). A new finding — an
-#          unregistered metric/span/event name, a host sync or retrace
-#          hazard in jit-reachable code, a lock-order inversion or a
-#          blocking call under a lock — fails the build regardless of
-#          what else passes.
+#          jit-hygiene, lock-discipline, and race passes against the
+#          committed analysis_baseline.json (docs/ANALYSIS.md). A new
+#          finding — an unregistered metric/span/event name or
+#          undocumented AMTPU_* knob, a host sync or retrace hazard in
+#          jit-reachable code, a lock-order inversion or a blocking
+#          call under a lock, a cross-thread unlocked write or an
+#          undeclared lock-free read (the race plane, checked against
+#          the committed locks_manifest.json) — fails the build
+#          regardless of what else passes.
 # Stage 2: perf report (INFORMATIONAL): the bench-history trajectory the
 #          regression gate reads, plus the contention & convergence-lag
 #          section (per-lock wait/hold, sampled op-lag stages — the
@@ -43,7 +46,11 @@
 #          shares with the shares summing back to the fleet totals
 #          (docs/OBSERVABILITY.md "Tenant attribution plane"; the
 #          fleet-scale gate is bench config 18 under `make
-#          perfcheck`). Never fails verify — a CPU-only
+#          perfcheck`), and the race smoke: a threaded sync storm run
+#          twice — sanitizer off, then under AMTPU_LOCKSAN=1 — with
+#          zero lock-order/long-hold violations and sanitizer overhead
+#          < 5% asserted (docs/ANALYSIS.md "The runtime lock-order
+#          sanitizer"). Never fails verify — a CPU-only
 #          image or a missing/empty history must not block the build
 #          (TUNNEL_DIAGNOSIS.md: TPU absence is an environment fact, not
 #          a code defect). Run `make perfcheck` for the enforcing gate.
@@ -77,6 +84,8 @@ JAX_PLATFORMS=cpu python -m automerge_tpu.perf dispatch --smoke \
     || echo "dispatch smoke FAILED (informational here; enforced by tests + perf check)"
 JAX_PLATFORMS=cpu python -m automerge_tpu.perf tenant --smoke \
     || echo "tenant smoke FAILED (informational here; enforced by tests + perf check)"
+JAX_PLATFORMS=cpu python -m automerge_tpu.perf race --smoke \
+    || echo "race smoke FAILED (informational here; enforced by tests + the locksan suite)"
 
 echo "== stage 3/3: tier-1 suite (ROADMAP.md) =="
 set -o pipefail

@@ -1,0 +1,316 @@
+"""Race-plane tests: thread-root discovery, the three race rules on
+known-racy / known-safe / declared-lock-free fixtures, the lock-order
+manifest round-trip + cycle detection, the manifest drift rules, the
+env-knob registry rule, and the no-new-findings check on the repo."""
+
+import json
+import pathlib
+import textwrap
+
+from automerge_tpu.analysis import load_project
+from automerge_tpu.analysis.core import run_analysis
+from automerge_tpu.analysis.flow import (MANIFEST_NAME, LocksManifest,
+                                         build_manifest, find_cycle,
+                                         lock_graph)
+from automerge_tpu.analysis.lock_discipline import LockDisciplinePass
+from automerge_tpu.analysis.races import RacePass
+from automerge_tpu.analysis.registry import RegistryConformancePass
+from automerge_tpu.analysis.threadmap import thread_map
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+RACY = '''\
+    import threading
+
+    class Node:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self.items = []
+            self._thread = threading.Thread(target=self._loop)
+
+        def start(self):
+            self._thread.start()
+
+        def _loop(self):
+            while True:
+                self.count += 1
+                self.items.append(1)
+
+        def poke(self):
+            self.count = 0
+            self.items.append(2)
+    '''
+
+SAFE = '''\
+    import threading
+
+    class Node:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self.items = []
+            self._thread = threading.Thread(target=self._loop)
+
+        def start(self):
+            self._thread.start()
+
+        def _loop(self):
+            while True:
+                with self._lock:
+                    self.count += 1
+                    self.items.append(1)
+
+        def poke(self):
+            with self._lock:
+                self.count = 0
+                self.items.append(2)
+    '''
+
+PEEK = '''\
+    import threading
+
+    class Node:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.stamp = 0
+            self._thread = threading.Thread(target=self._loop)
+
+        def start(self):
+            self._thread.start()
+
+        def _loop(self):
+            while True:
+                with self._lock:
+                    self.stamp += 1
+
+        def snapshot(self):
+            return self.stamp
+    '''
+
+
+def _write(tmp_path, source, rel="automerge_tpu/sync/fix.py"):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source))
+
+
+def _races(tmp_path, source, rel="automerge_tpu/sync/fix.py"):
+    _write(tmp_path, source, rel)
+    return RacePass().run(load_project(tmp_path))
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# thread-root discovery
+
+
+def test_threadmap_discovers_thread_roots(tmp_path):
+    _write(tmp_path, RACY)
+    tm = thread_map(load_project(tmp_path),
+                    ("automerge_tpu/sync/",))
+    assert "thread:fix.Node._loop" in tm.roots
+    assert "main" in tm.roots
+
+
+def test_threadmap_sites_carry_roots_and_holds(tmp_path):
+    _write(tmp_path, SAFE)
+    tm = thread_map(load_project(tmp_path),
+                    ("automerge_tpu/sync/",))
+    slot = tm.attr_table()["Node.count"]
+    roots = {r for _s, ctx in slot["write"] for r in ctx}
+    assert "thread:fix.Node._loop" in roots and "main" in roots
+    for _s, ctx in slot["write"]:
+        for held in ctx.values():
+            assert any("_lock" in h for h in held)
+
+
+# ---------------------------------------------------------------------------
+# the race rules
+
+
+def test_unlocked_shared_writes_flagged(tmp_path):
+    findings = _races(tmp_path, RACY)
+    rules = _rules(findings)
+    assert "shared-write-unlocked" in rules      # Node.count
+    assert "shared-mutate-aliased" in rules      # Node.items
+    by_rule = {f.rule: f for f in findings}
+    assert "Node.count" in by_rule["shared-write-unlocked"].message
+    assert "Node.items" in by_rule["shared-mutate-aliased"].message
+    # one finding per attribute, not one per site
+    assert rules.count("shared-write-unlocked") == 1
+
+
+def test_consistently_locked_writes_clean(tmp_path):
+    assert _races(tmp_path, SAFE) == []
+
+
+def test_lockfree_read_needs_declaration(tmp_path):
+    findings = _races(tmp_path, PEEK)
+    assert _rules(findings) == ["lockfree-undeclared"]
+    assert "Node.stamp" in findings[0].message
+
+
+def test_declared_lockfree_suppresses(tmp_path):
+    (tmp_path / MANIFEST_NAME).write_text(json.dumps({
+        "version": 1, "locks": [], "order": [],
+        "lockfree": [{"attr": "Node.stamp",
+                      "justification": "LWW stamp, test fixture"}]}))
+    assert _races(tmp_path, PEEK) == []
+
+
+def test_stale_lockfree_declaration_flagged(tmp_path):
+    (tmp_path / MANIFEST_NAME).write_text(json.dumps({
+        "version": 1, "locks": [], "order": [],
+        "lockfree": [{"attr": "Node.stamp", "justification": "used"},
+                     {"attr": "Node.gone", "justification": "unused"}]}))
+    findings = _races(tmp_path, PEEK)
+    assert _rules(findings) == ["lockfree-stale"]
+    assert "Node.gone" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# manifest round-trip + cycles
+
+
+NESTED = '''\
+    import threading
+
+    class Node:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._log_lock = threading.Lock()
+
+        def a_then_b(self):
+            with self._lock:
+                with self._log_lock:
+                    pass
+    '''
+
+
+def test_manifest_roundtrip(tmp_path):
+    _write(tmp_path, NESTED)
+    project = load_project(tmp_path)
+    manifest = build_manifest(project)
+    path = tmp_path / MANIFEST_NAME
+    manifest.save(path)
+    back = LocksManifest.load(path)
+    assert back is not None
+    assert back.order_edges() == manifest.order_edges()
+    assert ("Node._lock", "Node._log_lock") in back.order_edges()
+
+
+def test_manifest_carries_lockfree_on_rebuild(tmp_path):
+    _write(tmp_path, NESTED)
+    project = load_project(tmp_path)
+    prior = LocksManifest(
+        lockfree=[{"attr": "X.y", "justification": "kept"}])
+    manifest = build_manifest(project, prior)
+    assert manifest.lockfree_attrs() == {"X.y": "kept"}
+
+
+def test_find_cycle():
+    assert find_cycle({("a", "b"), ("b", "c")}) is None
+    cyc = find_cycle({("a", "b"), ("b", "c"), ("c", "a")})
+    assert cyc is not None and len(set(cyc) & {"a", "b", "c"}) == 3
+
+
+def test_manifest_drift_and_stale(tmp_path):
+    _write(tmp_path, NESTED)
+    # manifest missing the observed edge, carrying a phantom one
+    (tmp_path / MANIFEST_NAME).write_text(json.dumps({
+        "version": 1, "locks": [],
+        "order": [{"before": "P._a", "after": "P._b", "site": "x"}],
+        "lockfree": []}))
+    findings = LockDisciplinePass().run(load_project(tmp_path))
+    rules = _rules(findings)
+    assert "lock-manifest-drift" in rules
+    assert "lock-manifest-stale" in rules
+
+
+def test_manifest_cycle_fails(tmp_path):
+    _write(tmp_path, NESTED)
+    (tmp_path / MANIFEST_NAME).write_text(json.dumps({
+        "version": 1, "locks": [],
+        "order": [
+            {"before": "Node._lock", "after": "Node._log_lock",
+             "site": "x"},
+            {"before": "Node._log_lock", "after": "Node._lock",
+             "site": "y"}],
+        "lockfree": []}))
+    findings = LockDisciplinePass().run(load_project(tmp_path))
+    assert "lock-order-cycle" in _rules(findings)
+
+
+def test_no_manifest_no_drift_rules(tmp_path):
+    _write(tmp_path, NESTED)
+    findings = LockDisciplinePass().run(load_project(tmp_path))
+    assert not any(r.startswith("lock-manifest") for r in _rules(findings))
+
+
+# ---------------------------------------------------------------------------
+# env-knob registry rule
+
+
+KNOB_READER = '''\
+    import os
+
+    RATE = os.environ.get("AMTPU_FIXTURE_RATE", "1")
+    MODE = os.getenv("AMTPU_FIXTURE_MODE")
+    '''
+
+
+def _knob_doc(tmp_path, body):
+    doc = tmp_path / "docs" / "OBSERVABILITY.md"
+    doc.parent.mkdir(parents=True, exist_ok=True)
+    doc.write_text(body)
+
+
+def test_undocumented_knob_flagged(tmp_path):
+    _write(tmp_path, KNOB_READER, rel="automerge_tpu/utils/fix.py")
+    _knob_doc(tmp_path, "## Environment knobs\n\n"
+                        "| `AMTPU_FIXTURE_RATE` | 1 | rate |\n")
+    findings = [f for f in
+                RegistryConformancePass().run(load_project(tmp_path))
+                if f.rule == "env-knob-undocumented"]
+    assert len(findings) == 1
+    assert "AMTPU_FIXTURE_MODE" in findings[0].message
+
+
+def test_documented_knobs_clean(tmp_path):
+    _write(tmp_path, KNOB_READER, rel="automerge_tpu/utils/fix.py")
+    _knob_doc(tmp_path, "## Environment knobs\n\n"
+                        "| `AMTPU_FIXTURE_RATE` | 1 | rate |\n"
+                        "| `AMTPU_FIXTURE_MODE` | unset | mode |\n")
+    findings = RegistryConformancePass().run(load_project(tmp_path))
+    assert "env-knob-undocumented" not in _rules(findings)
+
+
+def test_knob_rule_disarmed_without_doc(tmp_path):
+    _write(tmp_path, KNOB_READER, rel="automerge_tpu/utils/fix.py")
+    findings = RegistryConformancePass().run(load_project(tmp_path))
+    assert "env-knob-undocumented" not in _rules(findings)
+
+
+# ---------------------------------------------------------------------------
+# the repo itself
+
+
+def test_repo_race_findings_all_baselined():
+    """The committed manifest + fixes keep the full suite green: any
+    new race finding in the repo fails here first."""
+    report = run_analysis(ROOT, ROOT / "analysis_baseline.json")
+    assert [f.render() for f in report.new] == []
+
+
+def test_repo_lock_graph_matches_committed_manifest():
+    project = load_project(ROOT)
+    observed = set(lock_graph(project))
+    manifest = LocksManifest.load(ROOT / MANIFEST_NAME)
+    assert manifest is not None
+    committed = manifest.order_edges()
+    assert observed == committed
+    assert find_cycle(committed) is None

@@ -1,0 +1,149 @@
+"""Threaded regression pins for the shared-state races graftlint's race
+plane found (analysis/races.py) and this PR fixed.
+
+Each test hammers the exact interleaving the static finding described.
+They are probabilistic by nature (a lost race just passes vacuously),
+but at these iteration counts the pre-fix code failed reliably — and
+the point of the pin is that the LOCKED code can never fail, however
+the scheduler interleaves.
+"""
+
+import threading
+import time
+
+from automerge_tpu import DocSet
+from automerge_tpu.perf.fleet import FleetCollector
+from automerge_tpu.sync import docledger
+from automerge_tpu.sync.service import EngineDocSet
+from automerge_tpu.sync.tcp import TcpSyncClient, TcpSyncServer
+
+
+class _Conn:
+    """Bare connection stand-in: no peer_label/peer_node, so the ledger
+    must allocate a positional conn<k> label."""
+    peer_label = None
+    peer_node = None
+
+
+def _run_threads(n, fn):
+    """Start n threads on fn(i), join, and re-raise the first error."""
+    errors = []
+
+    def wrap(i):
+        try:
+            fn(i)
+        except BaseException as e:     # pragma: no cover - failure path
+            errors.append(e)
+
+    threads = [threading.Thread(target=wrap, args=(i,), name=f"race-{i}")
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+
+
+def test_docledger_conn_labels_unique_under_contention():
+    # pre-fix: conn_label's read-modify-write of _conn_seq ran unlocked,
+    # so two tcp reader threads could both see seq=k and hand their
+    # connections the same "conn<k+1>" label, merging two peers' lanes
+    led = docledger.DocLedger()
+    conns = [_Conn() for _ in range(16)]
+    barrier = threading.Barrier(16)
+
+    labels = [None] * 16
+
+    def worker(i):
+        barrier.wait()
+        for _ in range(200):
+            labels[i] = led.conn_label(conns[i])
+
+    _run_threads(16, worker)
+    assert len(set(labels)) == 16
+    # stable across calls, too
+    assert [led.conn_label(c) for c in conns] == labels
+
+
+def test_engine_doc_set_add_doc_concurrent_single_registration():
+    # pre-fix: two threads adding the same unseen doc could both pass
+    # the membership check and double-register it in the resident engine
+    e = EngineDocSet()
+    barrier = threading.Barrier(8)
+
+    def worker(i):
+        barrier.wait()
+        for k in range(20):
+            e.add_doc(f"doc{k}")
+
+    _run_threads(8, worker)
+    ids = e.doc_ids
+    assert sorted(ids) == sorted(set(ids))
+    assert len(ids) == 20
+
+
+def test_tcp_server_close_races_accept_loop():
+    # pre-fix: the accept thread rebound self.peers (prune + append)
+    # while close() iterated it — a peer accepted concurrently with
+    # close could miss the close sweep and leak its reader thread
+    for _ in range(3):
+        ds = DocSet()
+        server = TcpSyncServer(ds).start()
+        clients = []
+        stop = threading.Event()
+
+        def dial():
+            while not stop.is_set():
+                try:
+                    clients.append(TcpSyncClient(DocSet(), server.host,
+                                                 server.port, timeout=2.0))
+                except OSError:
+                    return
+
+        t = threading.Thread(target=dial, name="race-dialer")
+        t.start()
+        time.sleep(0.05)
+        server.close()
+        stop.set()
+        t.join()
+        deadline = time.time() + 5.0
+        for p in server.peers:
+            assert p.closed.wait(max(0.0, deadline - time.time()))
+        for c in clients:
+            c.close()
+
+
+def test_fleet_registration_races_scrape_loop():
+    # pre-fix: add_local/add_peer appended to the registries the scrape
+    # thread was iterating, and _node grew self.nodes mid-_judge —
+    # "dict changed size during iteration" in the collector loop
+    c = FleetCollector(interval_s=0.01, min_nodes=3)
+    stop = threading.Event()
+    scrape_errors = []
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                c.scrape_once()
+            except BaseException as e:   # pragma: no cover - failure path
+                scrape_errors.append(e)
+                return
+
+    t = threading.Thread(target=scraper, name="race-scraper")
+    t.start()
+
+    def register(i):
+        for k in range(40):
+            c.add_local(f"n{i}-{k}", snapshot_fn=lambda: {"ops_per_s": 1.0})
+            c.quarantine(f"q{i}-{k}")
+            c.unquarantine(f"q{i}-{k}")
+
+    try:
+        _run_threads(4, register)
+    finally:
+        stop.set()
+        t.join()
+    assert not scrape_errors, scrape_errors
+    state = c.scrape_once()
+    assert state["rollup"]["nodes"] >= 160
