@@ -470,6 +470,28 @@ def round_scope(dirty_docs: int, label: str | None = None,
     return _RoundScope(dirty_docs, label, tenants=tenants)
 
 
+def last_round_summary() -> dict | None:
+    """The most recently folded round, reduced to what a cross-plane
+    join needs: its ledger seq plus per-round amplification / pad-waste.
+    The trace plane cites these on a sampled change's dispatch span
+    (utils/tracer.py flush_round) — the fold happens inside the flush,
+    so by the time the deferred stage recording runs the round is in the
+    ring. None when the ledger is off or nothing has folded yet."""
+    led = _ledger
+    with led._lock:
+        if not led._ring:
+            return None
+        r = led._ring[-1]
+    amp = None
+    if r.get("dirty_docs"):
+        amp = round((r["dispatches"] + r["ambient"]) / r["dirty_docs"], 4)
+    waste = None
+    if r.get("padded"):
+        waste = round(100.0 * (1.0 - r["logical"] / r["padded"]), 3)
+    return {"round": r.get("round"), "amp": amp,
+            "pad_waste_pct": waste}
+
+
 class _CallScope:
     """One routed kernel call: `with call_scope("spans", plan=plan,
     docs=n, axes={"docs": (n, d_pad), "spans": (s_max, s_pad)}):` around

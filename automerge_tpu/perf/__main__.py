@@ -1,9 +1,9 @@
 """CLI for the performance plane: `python -m automerge_tpu.perf
-{report,check,contention,doctor,explain,top,dispatch,tenant,remediate,
-roofline,resident}` (docs/OBSERVABILITY.md "Performance plane" /
-"Contention & convergence lag" / "Fleet health" / "Per-doc ledger &
-perf explain" / "Remediation plane" / "Dispatch-efficiency ledger" /
-"Tenant attribution plane").
+{report,check,contention,doctor,explain,top,dispatch,tenant,trace,
+remediate,roofline,resident}` (docs/OBSERVABILITY.md "Performance
+plane" / "Contention & convergence lag" / "Fleet health" / "Per-doc
+ledger & perf explain" / "Remediation plane" / "Dispatch-efficiency
+ledger" / "Tenant attribution plane" / "Trace plane").
 
 - `doctor`  — ranked root-cause report: live against a fleet
   (--connect), or post-mortem against a BENCH_DETAIL.json / flight-
@@ -24,6 +24,11 @@ perf explain" / "Remediation plane" / "Dispatch-efficiency ledger" /
   attribution plane (sync/tenantledger.py): ingress/dispatch/wire
   shares, governor shed splits, converge-lag rings, and the
   attribution-sum check. Same modes as `dispatch`, plus `--smoke`.
+- `trace`   — stage-latency report over the trace plane
+  (utils/tracer.py): per-stage p50/p99, the end-to-end critical-path
+  distribution, and waterfall renderings of the slowest stitched
+  exemplars. Same modes as `dispatch`, plus `--smoke` (a real
+  two-service TCP fleet with one stitched trace asserted).
 - `remediate` — the chaos-recovery smoke (verify.sh stage 2): injects
   one conn_kill into a supervised TCP link and asserts the fleet
   self-heals (perf/remediate.py).
@@ -196,6 +201,9 @@ def main(argv=None) -> int:
     if cmd == "tenant":
         from . import tenantplane
         return tenantplane.main(rest)
+    if cmd == "trace":
+        from . import traceplane
+        return traceplane.main(rest)
     if cmd == "remediate":
         # the chaos-recovery smoke (verify.sh stage 2): one injected
         # fault, assert the supervised link self-heals
@@ -227,7 +235,8 @@ def main(argv=None) -> int:
         return 0
     print(f"unknown command {cmd!r}; expected one of "
           "report, check, contention, doctor, explain, top, dispatch, "
-          "tenant, remediate, move, bootstrap, race, roofline, resident",
+          "tenant, trace, remediate, move, bootstrap, race, roofline, "
+          "resident",
           file=sys.stderr)
     return 2
 

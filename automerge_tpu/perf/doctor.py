@@ -51,6 +51,16 @@ Cause classes (stable identifiers — the bench asserts on them):
                      the evidence names the hot tenant's shares and the
                      degraded victims, and `perf tenant` prints the full
                      attribution report (r18 tenant plane)
+    coalesce_wait_hot / wire_serialize_hot / remote_admission_hot
+                     one lifecycle stage dominates the sampled end-to-
+                     end critical path (the traceplane section's stage
+                     rollup, visibility excluded — that stage is read-
+                     cadence bound by design). Each hot stage has a
+                     distinct owner: coalesce_wait is the flush
+                     governor/round cadence, wire_serialize the frame
+                     encoder, remote_admission the receiver's apply
+                     lock. `perf trace` prints the stage table and the
+                     slowest stitched waterfalls (r19 trace plane)
 
 CLI: `python -m automerge_tpu.perf doctor [--post-mortem PATH]
 [--config N] [--json] [--connect host:port,... --ticks N]`. With no
@@ -342,6 +352,52 @@ def diagnose_snapshot(snapshot: dict, label: str = "snapshot",
         ev.append("run `perf tenant` for the full attribution report")
         _cause(causes, "tenant_hot", None,
                share / 100.0 + sum(p99 for _, p99 in victims), ev)
+
+    # trace-plane join (utils/tracer.py): a lifecycle stage dominating
+    # the sampled end-to-end critical path names WHERE the latency goes
+    # — actionable because each hot stage has a distinct owner. The
+    # visibility stage is excluded from the denominator: it measures
+    # the consumer's hash-read cadence (and first-read JIT), not a
+    # pipeline cost the fleet can tune.
+    _TRACE_HOT = {
+        "coalesce_wait": (
+            "coalesce_wait_hot",
+            "sealed changes are parked waiting for their flush round "
+            "— the flush governor / round cadence owns this"),
+        "wire_serialize": (
+            "wire_serialize_hot",
+            "columnar frame encode dominates the path — the frame "
+            "encoder / batch sizing owns this"),
+        "remote_admission": (
+            "remote_admission_hot",
+            "the receiver's apply lock dominates the path — remote "
+            "admission is the bottleneck, not the sender"),
+    }
+    for sec in ((snapshot.get("traceplane") or {}).get("nodes")
+                or {}).values():
+        stages = (sec or {}).get("stages") or {}
+        done = (sec or {}).get("completed") or 0
+        if done < 4 or not stages:
+            continue
+        total = sum(float(d.get("sum_s") or 0.0)
+                    for st, d in stages.items() if st != "visibility")
+        if total <= 0:
+            continue
+        for st, (cause_name, hint) in _TRACE_HOT.items():
+            d = stages.get(st)
+            if not d:
+                continue
+            sum_s = float(d.get("sum_s") or 0.0)
+            share = 100.0 * sum_s / total
+            if share < 30.0:
+                continue
+            _cause(causes, cause_name, None, sum_s, [
+                f"stage {st} holds {share:.1f}% of the sampled "
+                f"critical path over {int(done)} completed trace(s) "
+                f"(p99 {d.get('p99_s')}s, sum {sum_s:.4f}s)",
+                hint,
+                "run `perf trace` for the stage table + the slowest "
+                "stitched waterfalls"])
 
     retraced = sum(v for k, v in snapshot.items()
                    if isinstance(v, (int, float))

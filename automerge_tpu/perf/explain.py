@@ -380,6 +380,40 @@ def hot_lines(views: dict, limit: int = 8,
     return lines
 
 
+def trace_stage_lines(doc_id: str, tsections: dict,
+                      limit: int = 2) -> list[str]:
+    """The stage-breakdown band for one doc: completed trace-plane
+    exemplars (utils/tracer.py ring) whose lifecycle ran through
+    `doc_id`, each decomposed into its stage durations with the share
+    of that trace's end-to-end critical path. `tsections` is
+    {node_label: traceplane section}. Empty when no section carries a
+    matching exemplar — the band simply disappears (same contract as
+    the hot-doc / dispatch / tenant panels)."""
+    rows = []
+    for label, sec in (tsections or {}).items():
+        for t in (sec or {}).get("exemplars") or []:
+            if t.get("doc") == doc_id and t.get("spans"):
+                rows.append((label, t))
+    if not rows:
+        return []
+    rows.sort(key=lambda r: -(r[1].get("crit_s") or 0.0))
+    lines = ["  stage breakdown (sampled traces; `perf trace`):"]
+    for label, t in rows[:limit]:
+        crit = max(float(t.get("crit_s") or 0.0), 1e-9)
+        role = "stitched across the wire" if t.get("stitched") \
+            else "origin-local"
+        lines.append(f"    trace {t.get('tid')} @ {label} "
+                     f"({role}, e2e {crit:.4f}s):")
+        for st, _rel, dur in t["spans"]:
+            share = 100.0 * float(dur) / crit
+            lines.append(f"      {st:<17} {float(dur):>10.6f}s "
+                         f"{share:>5.1f}%")
+    if len(rows) > limit:
+        lines.append(f"    (+{len(rows) - limit} more sampled trace(s) "
+                     "— run `perf trace` for the waterfalls)")
+    return lines
+
+
 def _post_mortem_view_sets(path: str) -> list[tuple[str, dict]]:
     """(label, views) sets from a post-mortem file. A BENCH_DETAIL.json
     yields ONE SET PER CONFIG — never merged: the node labels inside a
@@ -390,6 +424,7 @@ def _post_mortem_view_sets(path: str) -> list[tuple[str, dict]]:
         data = json.load(f)
     if not isinstance(data, dict):
         raise ValueError(f"{path}: not a JSON object")
+    from .traceplane import sections_from_snapshot as _tsecs
     if "configs" in data and "reason" not in data:
         out = []
         for cfg in sorted(data["configs"] or {}, key=lambda c: (len(c), c)):
@@ -398,18 +433,22 @@ def _post_mortem_view_sets(path: str) -> list[tuple[str, dict]]:
             if isinstance(snap, dict):
                 views = views_from_snapshot(snap)
                 if views:
-                    out.append((f"config {cfg}", views))
+                    out.append((f"config {cfg}", views, _tsecs(snap)))
         return out
     if "reason" in data or "threads" in data or "watchdog_events" in data:
+        snap = data.get("metrics") or {}
         return [(data.get("reason", "dump"),
-                 views_from_snapshot(data.get("metrics") or {}))]
-    return [(os.path.basename(path), views_from_snapshot(data))]
+                 views_from_snapshot(snap), _tsecs(snap))]
+    return [(os.path.basename(path), views_from_snapshot(data),
+             _tsecs(data))]
 
 
 def _views_live(connect: str, ticks: int, interval: float):
     """Pull each fleet node's snapshot over throwaway metrics-pull
     clients; returns (views, now) with now = wall time (live ages)."""
     from .fleet import connect_sources
+
+    from .traceplane import merge_sections, sections_from_snapshot
 
     conns, close = connect_sources([a for a in connect.split(",") if a])
     try:
@@ -421,11 +460,13 @@ def _views_live(connect: str, ticks: int, interval: float):
                     pass
             time.sleep(interval)
         parts = []
+        tparts = []
         for name, conn in conns:
             snap = conn.peer_metrics
             if isinstance(snap, dict):
                 parts.append(views_from_snapshot(snap))
-        return merge_views(parts), time.time()
+                tparts.append(sections_from_snapshot(snap))
+        return merge_views(parts), merge_sections(tparts), time.time()
     finally:
         close()
 
@@ -463,8 +504,9 @@ def main(argv=None) -> int:
 
     now = None
     if args.connect:
-        views, now = _views_live(args.connect, args.ticks, args.interval)
-        view_sets = [(None, views)]
+        views, tsecs, now = _views_live(args.connect, args.ticks,
+                                        args.interval)
+        view_sets = [(None, views, tsecs)]
     else:
         path = args.post_mortem or os.path.join(history.repo_root(),
                                                 "BENCH_DETAIL.json")
@@ -479,9 +521,9 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
         if not view_sets:
-            view_sets = [(None, {})]
+            view_sets = [(None, {}, {})]
     out_json: list = []
-    for label, views in view_sets:
+    for label, views, tsecs in view_sets:
         if args.doc is None:
             if args.json:
                 out_json.append({"set": label,
@@ -502,6 +544,7 @@ def main(argv=None) -> int:
             out_json.append(report)
         else:
             lines = report_lines(report)
+            lines.extend(trace_stage_lines(args.doc, tsecs))
             if label and len(view_sets) > 1:
                 lines[0] += f" [{label}]"
             print("\n".join(lines))

@@ -166,6 +166,16 @@ def extract_features(snapshot: dict) -> dict:
             if isinstance(share, (int, float)):
                 out["tenant_hot_share_pct"] = max(
                     float(share), out.get("tenant_hot_share_pct", 0.0))
+    # trace-plane rollup (utils/tracer.py): the sampled end-to-end
+    # critical-path p99 over the node's completed ring — the
+    # trace_critical_p99 SLO feed (worst label wins, as above)
+    for sec in ((snapshot.get("traceplane") or {}).get("nodes")
+                or {}).values():
+        crit = (sec or {}).get("critical_path") or {}
+        v = crit.get("p99_s")
+        if isinstance(v, (int, float)) and crit.get("count"):
+            out["trace_critical_p99_s"] = max(
+                float(v), out.get("trace_critical_p99_s", 0.0))
     return out
 
 
@@ -571,6 +581,7 @@ class FleetCollector:
             "tenant_converge_p99_s": _agg("tenant_converge_p99_s",
                                           "max"),
             "tenant_hot_share_pct": _agg("tenant_hot_share_pct", "max"),
+            "trace_critical_p99_s": _agg("trace_critical_p99_s", "max"),
         }
         tenants = self._tenant_rollup(nodes)
         if tenants:

@@ -130,6 +130,23 @@ TENANT_LEDGER_BUDGET_PCT = 2.0
 #: because it assigns blame that does not add up.
 TENANT_ATTRIBUTION_ERR_MAX_PCT = 1.0
 
+#: trace-plane gates (r19, config 19). All ABSOLUTE — properties of the
+#: tracer code (utils/tracer.py), not of the traffic mix:
+#: the plane's duty cycle (hook self time / traffic wall, both nodes
+#: combined) must stay under the same 2% bound every other ledger
+#: honors — an instrument that taxes the lifecycle it measures is the
+#: workload, not observability,
+TRACE_LEDGER_BUDGET_PCT = 2.0
+#: sampled traces must COMPLETE (origin finalize through converged-hash
+#: visibility, across the wire) at at least this rate — an instrument
+#: that loses traces mid-lifecycle reports a biased critical path,
+TRACE_COMPLETENESS_MIN_PCT = 99.0
+#: and the per-stage span sums must reconcile with the doc ledger's
+#: independently measured end-to-end lag within this percentage —
+#: stages that do not add up to the e2e number are decomposing
+#: something other than the latency they claim to explain.
+TRACE_STAGE_SUM_ERR_MAX_PCT = 5.0
+
 #: partial-replication gates (r12, config 13). All ABSOLUTE — each is a
 #: property of the subscription/relay code, not of the host:
 #: relay-tree total fan-out bytes must grow sublinearly in subscriber
@@ -377,7 +394,22 @@ def _norm_configs(raw) -> dict:
                                        "quiet_p99_degradation_x",
                                        "tenant_attribution_err_pct",
                                        "tenant_ledger_overhead_pct",
-                                       "tenant_disabled_parity")
+                                       "tenant_disabled_parity",
+                                       # the trace plane (r19, config
+                                       # 19): sampled-lifecycle
+                                       # completeness, stage-sum vs
+                                       # docledger e2e reconciliation,
+                                       # plane duty cycle, disabled-
+                                       # path parity, critical path
+                                       "trace_sampled",
+                                       "trace_completed",
+                                       "trace_stitched",
+                                       "trace_completeness_pct",
+                                       "trace_stage_sum_err_pct",
+                                       "trace_ledger_overhead_pct",
+                                       "trace_disabled_parity",
+                                       "trace_crit_p50_s",
+                                       "trace_crit_p99_s")
                      if isinstance(v.get(k), (int, float, str))}
         elif isinstance(v, (int, float)):
             entry = {"speedup": v}
@@ -1073,6 +1105,66 @@ def check(path: str | None = None, record: dict | None = None,
                          f"{hot_sh:.1f}% ingress share")
         lines.append("  tenant isolation baseline (ROADMAP #5 shrinks "
                      "this): " + "; ".join(extra))
+
+    # trace-plane gates (r19, config 19): the plane's own duty cycle
+    # must stay under its ABSOLUTE budget (TRACE_LEDGER_BUDGET_PCT — a
+    # property of the hook code, like every other ledger's bound),
+    # sampled traces must complete end to end at >=
+    # TRACE_COMPLETENESS_MIN_PCT, the per-stage sums must reconcile
+    # with the doc ledger's independently measured e2e lag within
+    # TRACE_STAGE_SUM_ERR_MAX_PCT, and the unset path must have proved
+    # byte-identical behavior in-run. The critical-path percentiles are
+    # reported alongside — they are the BASELINE decomposition fleet
+    # megabatching (ROADMAP #2) exists to shift, so they inform rather
+    # than gate. Skip-clean: runs without config 19 never fail.
+    def _tr(r: dict):
+        return ((r.get("configs") or {}).get("19") or {})
+
+    cur_trp = _tr(current).get("trace_ledger_overhead_pct")
+    if isinstance(cur_trp, (int, float)):
+        verdict = ("OK" if cur_trp <= TRACE_LEDGER_BUDGET_PCT
+                   else "TRACE PLANE OVER BUDGET")
+        lines.append(
+            f"  trace-plane duty cycle (config 19): {cur_trp:.3f}% "
+            f"(budget <= {TRACE_LEDGER_BUDGET_PCT}%) -> {verdict}")
+        if cur_trp > TRACE_LEDGER_BUDGET_PCT:
+            rc = 1
+    comp = _tr(current).get("trace_completeness_pct")
+    if isinstance(comp, (int, float)):
+        verdict = ("OK" if comp >= TRACE_COMPLETENESS_MIN_PCT
+                   else "SAMPLED TRACES LOST MID-LIFECYCLE")
+        lines.append(
+            f"  trace completeness (config 19): {comp:.2f}% "
+            f"(floor >= {TRACE_COMPLETENESS_MIN_PCT}%) -> {verdict}")
+        if comp < TRACE_COMPLETENESS_MIN_PCT:
+            rc = 1
+    serr = _tr(current).get("trace_stage_sum_err_pct")
+    if isinstance(serr, (int, float)):
+        verdict = ("OK" if serr <= TRACE_STAGE_SUM_ERR_MAX_PCT
+                   else "STAGES DO NOT RECONCILE WITH E2E LAG")
+        lines.append(
+            f"  trace stage-sum vs e2e lag (config 19): {serr:.2f}% "
+            f"(bound <= {TRACE_STAGE_SUM_ERR_MAX_PCT}%) -> {verdict}")
+        if serr > TRACE_STAGE_SUM_ERR_MAX_PCT:
+            rc = 1
+    trpar = _tr(current).get("trace_disabled_parity")
+    if trpar is not None:
+        lines.append("  trace-plane unset-path parity: "
+                     + ("OK (byte-equal hashes, zero traces recorded)"
+                        if trpar else "DIVERGED"))
+        if not trpar:
+            rc = 1
+    tcp99 = _tr(current).get("trace_crit_p99_s")
+    if isinstance(tcp99, (int, float)):
+        extra = [f"critical path p99 {tcp99:.4f}s"]
+        tcp50 = _tr(current).get("trace_crit_p50_s")
+        if isinstance(tcp50, (int, float)):
+            extra.insert(0, f"p50 {tcp50:.4f}s")
+        tst = _tr(current).get("trace_stitched")
+        if isinstance(tst, (int, float)):
+            extra.append(f"{int(tst)} stitched across the wire")
+        lines.append("  trace critical-path baseline (ROADMAP #2 "
+                     "shifts this): " + "; ".join(extra))
 
     # keystroke-flatness gate (r8, config 7): latency at 4x document
     # length over 1x must stay under the ceiling. A RATIO is

@@ -80,6 +80,12 @@ DEFAULT_DISPATCH_AMPLIFICATION = 8.0
 #: converge_p99, held PER TENANT so a quiet tenant's breach under a hot
 #: neighbor is visible even while the fleet aggregate stays green
 DEFAULT_TENANT_CONVERGE_P99_S = 2.0
+#: default bound on the sampled end-to-end critical-path p99 (seconds)
+#: from the trace plane (utils/tracer.py): the same latency bar as the
+#: fleet converge_p99, but measured over STITCHED per-change lifecycles
+#: (origin finalize through remote visibility) — a breach here comes
+#: with the stage decomposition that names which stage to fix
+DEFAULT_TRACE_CRITICAL_P99_S = 2.0
 
 
 class Slo:
@@ -129,7 +135,9 @@ def default_slos(converge_p99_s: float = DEFAULT_CONVERGE_P99_S,
                  dispatch_amplification: float =
                  DEFAULT_DISPATCH_AMPLIFICATION,
                  tenant_converge_p99_s: float =
-                 DEFAULT_TENANT_CONVERGE_P99_S) -> list[Slo]:
+                 DEFAULT_TENANT_CONVERGE_P99_S,
+                 trace_critical_p99_s: float =
+                 DEFAULT_TRACE_CRITICAL_P99_S) -> list[Slo]:
     return [
         Slo("converge_p99", "converge_p99_s", converge_p99_s,
             description="fleet max converge-stage p99 under bound"),
@@ -149,6 +157,11 @@ def default_slos(converge_p99_s: float = DEFAULT_CONVERGE_P99_S,
             description="worst per-tenant converge p99 under bound "
                         "(sync/tenantledger.py — the isolation "
                         "objective)"),
+        Slo("trace_critical_p99", "trace_critical_p99_s",
+            trace_critical_p99_s,
+            description="sampled end-to-end critical-path p99 under "
+                        "bound (utils/tracer.py trace plane — a breach "
+                        "names its stage via `perf trace`)"),
     ]
 
 

@@ -23,7 +23,12 @@ One screen, three bands (docs/OBSERVABILITY.md "Fleet health"):
 - the **tenant band** — per (node, tenant) from the `"tenantledger"`
   section (sync/tenantledger.py): ingress share, attributed dispatch
   share, converge-lag p99, and shed counts, hottest share first, with
-  the `perf tenant` handle for the full attribution report.
+  the `perf tenant` handle for the full attribution report;
+- the **trace-stage band** — per (node, lifecycle stage) from the
+  `"traceplane"` section (utils/tracer.py): each stage's share of the
+  sampled end-to-end critical path (visibility excluded — read-cadence
+  bound) and its p99, biggest share first, with the `perf trace`
+  handle for the stage table and stitched waterfalls.
 
 Keys (tty only): `q` quit · `p` pause/resume scraping ·
 `d` dump a `perf doctor` live report to a file and show the path.
@@ -121,6 +126,7 @@ def render(collector, slo_engine=None, width: int = 100) -> list[str]:
     lines.extend(hot_doc_lines(collector))
     lines.extend(dispatch_lines(collector))
     lines.extend(tenant_lines(collector))
+    lines.extend(trace_lines(collector))
     return [line[:width] for line in lines]
 
 
@@ -243,6 +249,55 @@ def tenant_lines(collector, limit: int = 5) -> list[str]:
     if len(rows) > limit:
         lines.append(f"  (+{len(rows) - limit} more tenant row(s) — "
                      "run `perf tenant` for the full report)")
+    return lines
+
+
+def trace_lines(collector, limit: int = 4) -> list[str]:
+    """The trace-stage band: one row per (node, stage) from the
+    `"traceplane"` snapshot section (utils/tracer.py), biggest share of
+    the sampled critical path first (visibility excluded — that stage
+    is read-cadence bound by design), plus the node's end-to-end
+    critical-path p99. Empty when no scraped node ships the section —
+    the band simply disappears (same contract as the other panels)."""
+    rows = []
+    for st in collector.nodes.values():
+        snap = st.last_snapshot
+        if not isinstance(snap, dict):
+            continue
+        for label, sec in ((snap.get("traceplane") or {})
+                           .get("nodes") or {}).items():
+            stages = (sec or {}).get("stages") or {}
+            crit = (sec or {}).get("critical_path") or {}
+            total = sum(float(d.get("sum_s") or 0.0)
+                        for s, d in stages.items() if s != "visibility")
+            for s, d in stages.items():
+                if s == "visibility" or not d.get("count"):
+                    continue
+                sum_s = float(d.get("sum_s") or 0.0)
+                rows.append({
+                    "node": label,
+                    "stage": s,
+                    "share": (100.0 * sum_s / total) if total else None,
+                    "p99": d.get("p99_s"),
+                    "done": (sec or {}).get("completed"),
+                    "crit_p99": crit.get("p99_s"),
+                })
+    if not rows:
+        return []
+    rows.sort(key=lambda r: -(r["share"]
+                              if isinstance(r["share"], (int, float))
+                              else -1.0))
+    lines = ["trace stages (critical-path share; `perf trace`):"]
+    for r in rows[:limit]:
+        lines.append(
+            f"  {str(r['stage'])[:16]:<16} @ {str(r['node'])[:10]:<10} "
+            f"share {_fmt(r['share'], '%', 1):>7} "
+            f"p99 {_fmt(r['p99'], 's', 4):>10} "
+            f"e2e p99 {_fmt(r['crit_p99'], 's', 4):>10} "
+            f"({r['done'] or 0} done)")
+    if len(rows) > limit:
+        lines.append(f"  (+{len(rows) - limit} more stage row(s) — "
+                     "run `perf trace` for the full report)")
     return lines
 
 

@@ -24,14 +24,15 @@ element-wise max all-reduce over int32 clock matrices
 from __future__ import annotations
 
 import contextlib
+import time
 from typing import Callable
 
 from ..core import clock as C
 from ..core.change import coerce_change
-from ..utils import chaos, flightrec, metrics, oplag
+from ..utils import chaos, flightrec, metrics, oplag, tracer
 from . import docledger
-from .frames import (OPLAG_KEY, SNAP_KEY, SUB_KEY, TRACE_KEY, msg_kind,
-                     pack_trace, unpack_trace)
+from .frames import (OPLAG_KEY, SNAP_KEY, SUB_KEY, TRACE_KEY,
+                     TRACEPLANE_KEY, msg_kind, pack_trace, unpack_trace)
 
 
 class InterestSet:
@@ -320,6 +321,7 @@ class Connection:
         self._our_clock = self._clock_union(self._our_clock, doc_id, clock)
         nbytes = None
         if changes is not None:
+            t_ser = time.perf_counter()
             if self._wire == "columnar":
                 from .frames import encode_frame
                 msg["frame"] = encode_frame(changes)
@@ -334,6 +336,16 @@ class Connection:
             hdr = oplag.wire_header(doc_id)
             if hdr is not None:
                 msg[OPLAG_KEY] = hdr
+            # trace-plane stitching (utils/tracer.py): this doc's post-
+            # flush lifecycle traces leave with the frame — the sender's
+            # accumulated spans + wall epoch — so the receiver completes
+            # one cross-process trace. Never emitted when the plane is
+            # off (the envelope stays byte-identical).
+            if tracer.enabled():
+                thdr = tracer.wire_header(
+                    doc_id, time.perf_counter() - t_ser)
+                if thdr is not None:
+                    msg[TRACEPLANE_KEY] = thdr
         if self._ledger is not None:
             self._ledger.record_send(
                 doc_id, self, len(changes) if changes is not None else 0,
@@ -737,6 +749,11 @@ class Connection:
         # peer-apply + convergence lag once the apply below finishes
         lag = oplag.wire_receive(msg.pop(OPLAG_KEY, None))
         doc_id = msg["docId"]
+        # trace-plane stitching: adopt the sender's lifecycle traces
+        # (the key is popped UNCONDITIONALLY — the envelope must not
+        # leak it downstream — and recording ignores the local sampling
+        # rate: the sender paid the decision)
+        tctx = tracer.wire_receive(msg.pop(TRACEPLANE_KEY, None), doc_id)
         if msg.get("clock") is not None:
             with self._state_lock:
                 self._their_clock = self._clock_union(
@@ -764,7 +781,9 @@ class Connection:
             from .frames import decode_frame
             metrics.bump("sync_frames_received")
             metrics.bump("sync_frame_bytes_received", len(msg["frame"]))
+            t_dec = time.perf_counter()
             cols = decode_frame(msg["frame"])
+            decode_s = time.perf_counter() - t_dec
             self._account_delivery(
                 doc_id,
                 [(cols.actors[int(a)], int(s))
@@ -776,21 +795,30 @@ class Connection:
             # under _apply_lock — a no-op for doc_sets declaring
             # concurrent_ingest, so N peer reader threads ride ONE
             # group-commit flush instead of serializing node-wide.
-            with self._apply_lock:
+            t_adm = time.perf_counter()
+            # tracer.remote_apply: a received change is never re-traced
+            # as a local origin — its lifecycle belongs to the sender's
+            # stitched context (tctx above)
+            with self._apply_lock, tracer.remote_apply():
                 if hasattr(self._doc_set, "apply_columns"):
                     out = self._doc_set.apply_columns(doc_id, cols)
                 else:
                     out = self._doc_set.apply_changes(doc_id,
                                                       cols.to_changes())
             oplag.peer_applied(lag)
+            tracer.remote_admitted(tctx, doc_id, decode_s,
+                                   time.perf_counter() - t_adm)
             return out
         if msg.get("changes") is not None:
             chs = [coerce_change(c) for c in msg["changes"]]
             self._account_delivery(doc_id,
                                    [(c.actor, c.seq) for c in chs], None)
-            with self._apply_lock:
+            t_adm = time.perf_counter()
+            with self._apply_lock, tracer.remote_apply():
                 out = self._doc_set.apply_changes(doc_id, chs)
             oplag.peer_applied(lag)
+            tracer.remote_admitted(tctx, doc_id, 0.0,
+                                   time.perf_counter() - t_adm)
             return out
 
         with self._state_lock:

@@ -77,6 +77,18 @@ TRACE_KEY = "trace"
 # convergence lag").
 OPLAG_KEY = "oplag"
 
+# Trace-plane stitching header (utils/tracer.py — r19): a change-bearing
+# message whose doc carries sampled lifecycle traces ships a
+# `"traceplane": [{tid, actor, seq, t0, sent, origin, spans, meta}, ...]`
+# key beside the oplag header — the SENDER'S accumulated stage spans plus
+# its wall epoch, so the receiving service stitches its own
+# decode/admission/visibility spans onto them and completes ONE
+# cross-process trace. Same envelope rules (JSON part of both wire forms;
+# unknown-key-ignored by peers that predate it). With AMTPU_TRACE_SAMPLE
+# unset the key is never emitted — the envelope stays byte-identical
+# (the bench config-19 parity gate).
+TRACEPLANE_KEY = "traceplane"
+
 # Subscription (interest) protocol message (sync/connection.py): a peer
 # declares WHICH docs it wants synced instead of the whole DocSet —
 # `{"sub": {"add": [...], "prefixes": [...], "remove": [...],

@@ -33,7 +33,8 @@ from ..core.change import Change
 from ..engine import dispatchledger
 from ..engine.resident import ResidentDocSet
 from ..engine.resident_rows import CompactionAnchorError, DeviceDispatchError
-from ..utils import chaos, flightrec, lockprof, metrics, oplag, perfscope
+from ..utils import (chaos, flightrec, lockprof, metrics, oplag, perfscope,
+                     tracer)
 from . import docledger, epochs, tenantledger
 
 
@@ -762,13 +763,19 @@ class EngineDocSet:
         in live-view mode, reconciles + emits diffs), log admissions, fold
         diff records into the doc's mirror view."""
         tok = oplag.admit(doc_id)
+        # trace plane: inline ingress — admission and seal coincide (no
+        # coalescing queue), so queue_wait ~ 0 and coalesce_wait is the
+        # service-lock wait; the apply below is the dispatch stage
+        tracer.admit(doc_id)
+        tracer.sealed((doc_id,))
+        want_t = tok is not None or tracer.enabled()
         flush_t0 = flush_s = 0.0
         with self._lock:
             self.add_doc(doc_id)
-            if tok is not None:
+            if want_t:
                 flush_t0 = _time.perf_counter()
             diffs = apply_fn()
-            if tok is not None:
+            if want_t:
                 # docs-major ingress applies inline: no coalescing queue,
                 # the apply IS the flush stage (recorded below, after the
                 # lock releases — profiler cost must not inflate holds)
@@ -792,6 +799,7 @@ class EngineDocSet:
         oplag.flush_boundary((doc_id,))   # retire a stale awaiting token
         if tok is not None:
             oplag.flushed(tok, flush_start=flush_t0, flush_s=flush_s)
+        tracer.flush_round((doc_id,), 0, flush_t0, flush_s)
         if records:
             self._drain_notifications()
         if admitted:
@@ -803,6 +811,10 @@ class EngineDocSet:
         """Admit a change batch into resident state (causal buffering and
         duplicate-drop happen in the engine's delta encoder) and notify
         handlers so attached Connections gossip the update."""
+        if tracer.enabled():
+            # trace plane: hand-built changes have no frontend finalize;
+            # the sampled ones' lifecycle starts at this service boundary
+            tracer.origin_ingress((c.actor, c.seq) for c in changes)
         if self.backend == "rows":
             from ..native.wire import changes_to_columns
             return self._rows_ingest(doc_id, changes_to_columns(changes))
@@ -823,6 +835,10 @@ class EngineDocSet:
         and the log keeps lazy refs into the frame — no per-op Python
         objects exist unless a lagging peer later needs re-serving. The
         fallback materializes Change objects once (one pass, no JSON)."""
+        if tracer.enabled():
+            tracer.origin_ingress(
+                (cols.actors[int(a)], int(s))
+                for a, s in zip(cols.change_actor, cols.change_seq))
         if self.backend == "rows":
             return self._rows_ingest(doc_id, cols)
 
@@ -859,6 +875,10 @@ class EngineDocSet:
         if self.backend != "rows" or not self._epoch_admission_open():
             self.apply_columns(doc_id, cols)
             return PendingIngress(self, None)
+        if tracer.enabled():
+            tracer.origin_ingress(
+                (cols.actors[int(a)], int(s))
+                for a, s in zip(cols.change_actor, cols.change_seq))
         return PendingIngress(self, self._epoch_append(doc_id, cols))
 
     def _epoch_admission_open(self) -> bool:
@@ -888,6 +908,7 @@ class EngineDocSet:
                         i, cols, 0, len(cols.op_action))
                 self._pending.setdefault(doc_id, []).append(cols)
                 tok = oplag.admit(doc_id)
+                tracer.admit(doc_id)
                 if tok is not None:
                     self._lag_pending.append(tok)
                 if not self._batch_depth:
@@ -935,6 +956,7 @@ class EngineDocSet:
         park on the returned ticket via PendingIngress.wait, so the
         wait/drain/re-raise contract lives in exactly one place."""
         gov = self.ingress_governor
+        gov_delay = 0.0
         if gov is not None:
             # delay happens HERE — on the writer thread, before any
             # buffer or lock is touched, so backpressure lands on the
@@ -943,6 +965,7 @@ class EngineDocSet:
             d = gov.admit(doc_id)
             if d:
                 _time.sleep(d)
+                gov_delay = d
         # chaos tenant-storm (utils/chaos.py): multiply ONE tenant's
         # ingress rate by re-appending this batch's columns as extra
         # un-waited epoch entries — duplicate changes dedup at admission
@@ -951,6 +974,9 @@ class EngineDocSet:
         # AMTPU_CHAOS_TENANT_STORM is set.
         extra = chaos.tenant_storm(self._chaos_node, doc_id)
         tok = oplag.admit(doc_id)
+        # trace plane: bind this thread's finalized traces to the doc —
+        # governor park recorded, queue_wait opens here (utils/tracer.py)
+        tracer.admit(doc_id, delay_s=gov_delay)
         ticket = self._epoch.append(doc_id, cols, tok, claimed=claimed)
         for _ in range(extra):
             self._epoch.append(doc_id, cols, None)
@@ -976,6 +1002,7 @@ class EngineDocSet:
         if not entries:
             return []
         tickets: list = []
+        sealed_docs: list = []
         n_ops = 0
         for e in entries:
             try:
@@ -993,7 +1020,11 @@ class EngineDocSet:
             if e.tok is not None:
                 oplag.sealed(e.tok)
                 self._lag_pending.append(e.tok)
+            sealed_docs.append(e.doc_id)
             tickets.append(e.ticket)
+        # trace plane: stamp-only under self._lock (recording defers to
+        # _drain_lag_records, exactly like the oplag tokens above)
+        tracer.sealed(sealed_docs)
         if n_ops:
             # bulk-counted here (one metrics-lock crossing per seal, and
             # in OPS — the registered unit — not buffered entries)
@@ -1134,7 +1165,8 @@ class EngineDocSet:
         # sampled op-lifecycle tokens riding this round (utils/oplag.py):
         # taken out NOW so a failing flush drops rather than re-times them
         toks, self._lag_pending = self._lag_pending, []
-        round_docs = frozenset(self._pending) if oplag.enabled() else None
+        round_docs = (frozenset(self._pending)
+                      if oplag.enabled() or tracer.enabled() else None)
         phases0 = perfscope.phase_totals() if toks else None
         t0 = _time.perf_counter()
         with metrics.trace("sync_round_flush", tags={"round": round_no},
@@ -1156,7 +1188,8 @@ class EngineDocSet:
             # after release, so the profiler's own cost never inflates
             # the hold-time / round-latency baselines it exists to record
             self._lag_flushed.append(
-                (toks, round_docs, t0, _time.perf_counter() - t0, deltas))
+                (toks, round_docs, t0, _time.perf_counter() - t0, deltas,
+                 round_no))
         # failure paths raise out of the span (its timing still records).
         # The swallowed mid-admission rebuild path restores the round to
         # self._pending for retry — subtract those ops so throughput
@@ -1486,13 +1519,18 @@ class EngineDocSet:
             return
         with self._lock:
             batch, self._lag_flushed = self._lag_flushed, []
-        for toks, round_docs, t0, flush_s, deltas in batch:
+        for toks, round_docs, t0, flush_s, deltas, round_no in batch:
             # retire stale awaiting tokens for docs this round re-flushed
             # BEFORE parking the round's own tokens
             oplag.flush_boundary(round_docs)
             for tok in toks:
                 oplag.flushed(tok, flush_start=t0, flush_s=flush_s,
                               phases=deltas)
+            # trace plane: the round's sampled lifecycle traces record
+            # queue_wait / coalesce_wait / dispatch and park in the
+            # awaiting-wire table — like the tokens above, BEFORE the
+            # handler gossip ships their docs' messages
+            tracer.flush_round(round_docs, round_no, t0, flush_s)
 
     def _drain_admitted(self) -> None:
         """Notify handlers for admitted docs, outside self._lock (a handler
@@ -1744,6 +1782,11 @@ class EngineDocSet:
             self._drain_admitted_shielded()
             raise
         self._drain_admitted()
+        # trace plane: this converged-hash read makes every admitted
+        # change visible — complete the awaiting lifecycle traces (after
+        # _drain_admitted, so a round flushed by THIS read gossips its
+        # traces out before visibility can claim them locally)
+        tracer.visible(None)
         flightrec.record("hash_read", shard=self._shard, docs=len(out))
         rb = getattr(self._resident, "resident_bytes", None)
         if callable(rb):    # per-shard memory footprint for post-mortems
@@ -1779,6 +1822,7 @@ class EngineDocSet:
             self._drain_admitted_shielded()
             raise
         self._drain_admitted()
+        tracer.visible(out)   # partial read: only the named docs turn visible
         flightrec.record("hash_read", shard=self._shard, docs=len(out))
         return out
 

@@ -142,6 +142,11 @@ EVENT_KINDS: dict[str, str] = {
     "remed_recovered": "a remediation episode closed: the fleet returned "
                        "to SLO-green with zero human action "
                        "(perf/remediate.py; mttr_s/actions)",
+    # trace plane (utils/tracer.py — r19)
+    "trace_exemplar": "a completed lifecycle trace set a new slowest-"
+                      "critical-path high-water mark (utils/tracer.py; "
+                      "tid/doc/role/crit_s/stages — the full waterfall "
+                      "lives in the traceplane section's exemplars)",
     # race plane (utils/locksan.py — r18)
     "locksan_violation": "the runtime lock-order sanitizer flagged a "
                          "violation (utils/locksan.py; violation=order|"
@@ -258,6 +263,14 @@ def dump(reason: str, path: str | None = None,
             lock_holders = lockprof.holders_snapshot()
         except Exception:
             lock_holders = {}
+        try:    # the slowest in-flight lifecycle traces at fault time:
+            #     a divergence capture shows what was mid-flight, not
+            #     just the aggregate gauges (docs/OBSERVABILITY.md
+            #     "Trace plane")
+            from . import tracer
+            inflight_traces = tracer.inflight_snapshot()
+        except Exception:
+            inflight_traces = []
         doc = {
             "reason": reason,
             "at": time.time(),
@@ -268,6 +281,7 @@ def dump(reason: str, path: str | None = None,
             "threads": threads,
             "recent_spans": metrics.recent_spans(),
             "watchdog_events": metrics.watchdog_events(),
+            "inflight_traces": inflight_traces,
             "metrics": metrics.snapshot(),
         }
         if extra:

@@ -492,6 +492,23 @@ GAUGES: dict[str, str] = {
         "p99 converge-lag restamp over the tenant's recent sample ring "
         "{tenant=...} (sync/tenantledger.py; the tenant_converge_p99 "
         "SLO family's per-node feed)",
+    # trace plane (utils/tracer.py — r19): refreshed on the plane's
+    # mutation path every GAUGE_REFRESH completions; stage-level detail
+    # lives in the nested "traceplane" snapshot section (no stage or
+    # doc labels here)
+    "obs_trace_sampled":
+        "changes stamped with a trace context at frontend finalize "
+        "since reset (utils/tracer.py; the completeness denominator)",
+    "obs_trace_completed":
+        "traces completed at converged-hash visibility since reset "
+        "(utils/tracer.py; stitched cross-process ones included)",
+    "obs_trace_inflight":
+        "sampled changes currently mid-lifecycle across the awaiting "
+        "tables (utils/tracer.py; TTL-expired ones leave as expired)",
+    "obs_trace_critical_path_p99_s":
+        "p99 end-to-end critical path over the completed-trace ring "
+        "(utils/tracer.py; the number ROADMAP #2's megabatching "
+        "divides into stages)",
     # remediation plane (perf/remediate.py — r13)
     "obs_remed_quarantined":
         "nodes currently quarantined by the remediation engine "
@@ -536,6 +553,10 @@ HISTOGRAMS: dict[str, str] = {
         "tenant-ledger self-time flushed per gauge refresh "
         "(sync/tenantledger.py; sum/elapsed = the duty-cycle bound the "
         "config-18 perf-check gate holds under 2%)",
+    "obs_trace_ledger_s":
+        "trace-plane self-time flushed per gauge refresh "
+        "(utils/tracer.py; sum/elapsed = the duty-cycle bound the "
+        "config-19 perf-check gate holds under 2%)",
     "obs_remed_tick_s":
         "remediation-engine per-tick wall cost (perf/remediate.py; "
         "p50/interval = the steady-state duty cycle bench config 14 "

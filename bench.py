@@ -4042,6 +4042,215 @@ def run_tenant_config(n_docs_per_tenant=48, rounds=16, writes_per_round=4,
     }
 
 
+def run_trace_config(n_docs=24, rounds=12, writes_per_round=16,
+                     zipf_s=1.1, sample_every=4, round_sleep_s=0.005):
+    """Config 19: trace plane on a real two-node TCP fleet. A zipf
+    write storm streams hand-built changes through node A (the
+    TcpSyncServer side) while both nodes' converged-hash reads drive
+    flush rounds and visibility; 1-in-``sample_every`` changes are
+    deterministically sampled (utils/tracer.py) and their lifecycles
+    stitched across the wire. Claims, each asserted in-run and re-gated
+    in `perf check`:
+
+    1. sampled-trace COMPLETENESS: >= 99% of sampled finalizes complete
+       end to end (origin finalize through converged-hash visibility,
+       crossing the TCP link for remote docs) — the bounded tables'
+       disclosed losses (dropped/expired) count against this, so a
+       leaky plane fails loudly;
+    2. the per-stage spans RECONCILE with the measured end-to-end lag:
+       per completed trace, the stage durations sum to its critical
+       path within 5% (TRACE_STAGE_SUM_ERR_MAX_PCT) — stages that do
+       not add up are decomposing something other than the latency
+       they claim to explain;
+    3. the plane's own duty cycle (hook self time / traffic wall)
+       stays under 2% (TRACE_LEDGER_BUDGET_PCT);
+    4. the unset path is behavior-identical: the same storm re-run with
+       sampling off produces byte-equal per-doc hashes on a fresh
+       fleet and records ZERO traces (the envelope carries no trace
+       key — frames stay byte-identical)."""
+    import random
+
+    import numpy as _np
+
+    from automerge_tpu.core.change import Change, Op
+    from automerge_tpu.core.ids import ROOT_ID
+    from automerge_tpu.native.wire import changes_to_columns
+    from automerge_tpu.perf.history import (TRACE_COMPLETENESS_MIN_PCT,
+                                            TRACE_LEDGER_BUDGET_PCT,
+                                            TRACE_STAGE_SUM_ERR_MAX_PCT)
+    from automerge_tpu.sync.service import EngineDocSet
+    from automerge_tpu.sync.tcp import TcpSyncClient, TcpSyncServer
+    from automerge_tpu.utils import tracer
+
+    docs = [f"tr{i:02d}" for i in range(n_docs)]
+
+    def build_pair():
+        a = EngineDocSet(backend="rows")
+        b = EngineDocSet(backend="rows")
+        server = TcpSyncServer(a).start()
+        client = TcpSyncClient(b, server.host, server.port).start()
+        return a, b, server, client
+
+    def teardown(a, b, server, client):
+        for x in (client, server):
+            try:
+                x.close()
+            except Exception:
+                pass
+        a.close()
+        b.close()
+
+    def hdict(h):
+        return {d: int(_np.uint32(v)) for d, v in h.items()}
+
+    def storm(a, b):
+        """The identical zipf storm (own rng: both runs replay the same
+        write schedule). Returns (converged hashes, total writes)."""
+        rng = random.Random(19)
+        pick = _zipf_picker(n_docs, zipf_s, rng)
+        seqs = [0] * n_docs
+        total = 0
+        for r in range(rounds):
+            for _ in range(writes_per_round):
+                i = pick()
+                seqs[i] += 1
+                a.apply_columns(docs[i], changes_to_columns([Change(
+                    actor=f"W{i:02d}", seq=seqs[i], deps={},
+                    ops=[Op("set", ROOT_ID, key=f"f{r % 4}",
+                            value=r)])]))
+                total += 1
+            # converged-hash reads drive flush rounds + visibility on
+            # both ends every round (the consumer cadence the
+            # visibility stage measures)
+            a.hashes()
+            b.hashes()
+            time.sleep(round_sleep_s)
+        written = {docs[i] for i in range(n_docs) if seqs[i] > 0}
+        deadline = time.perf_counter() + 30.0
+        while time.perf_counter() < deadline:
+            ha, hb = hdict(a.hashes()), hdict(b.hashes())
+            if set(ha) == set(hb) == written and ha == hb:
+                return ha, total
+            time.sleep(0.02)
+        raise AssertionError(
+            f"config 19 fleet did not converge: {len(a.hashes())} vs "
+            f"{len(b.hashes())} docs")
+
+    # -- sampled run ------------------------------------------------------
+    tracer.reset()
+    tracer.set_sample_rate(sample_every)
+    a, b, server, client = build_pair()
+    try:
+        with _quiet_traceback_dumps():
+            t0 = time.perf_counter()
+            hashes_on, total_ops = storm(a, b)
+            # drain the last in-flight lifecycles: further hash reads
+            # complete visibility on both ends
+            for _ in range(50):
+                if tracer.section()["inflight"] == 0:
+                    break
+                a.hashes()
+                b.hashes()
+                time.sleep(0.02)
+            traffic_wall = time.perf_counter() - t0
+    finally:
+        teardown(a, b, server, client)
+
+    sec = tracer.section()
+    ring = [t.to_dict() if hasattr(t, "to_dict") else t
+            for t in list(tracer._plane._completed)]
+    tracer.set_sample_rate(None)
+
+    assert sec["sampled"] > 0, "no change was sampled (rate too coarse)"
+    assert sec["stitched"] > 0, (
+        "no stitched trace completed across the TCP link")
+    completeness = round(100.0 * sec["completed"]
+                         / max(sec["sampled"], 1), 2)
+    assert completeness >= TRACE_COMPLETENESS_MIN_PCT, (
+        f"trace completeness {completeness}% under the "
+        f"{TRACE_COMPLETENESS_MIN_PCT}% floor (sampled={sec['sampled']} "
+        f"completed={sec['completed']} expired={sec['expired']} "
+        f"dropped={sec['dropped']} inflight={sec['inflight']})")
+    errs = []
+    for t in ring:
+        crit = float(t.get("crit_s") or 0.0)
+        if crit <= 0.0 or not t.get("spans"):
+            continue
+        covered = sum(float(s[2]) for s in t["spans"])
+        errs.append(abs(crit - covered) / crit * 100.0)
+    assert errs, "no completed trace carries spans to reconcile"
+    stage_sum_err = round(sum(errs) / len(errs), 2)
+    assert stage_sum_err <= TRACE_STAGE_SUM_ERR_MAX_PCT, (
+        f"per-stage sums off the measured e2e critical path by "
+        f"{stage_sum_err}% (> {TRACE_STAGE_SUM_ERR_MAX_PCT}%)")
+    duty_pct = round(100.0 * sec["self_s"] / max(traffic_wall, 1e-9), 3)
+    assert duty_pct < TRACE_LEDGER_BUDGET_PCT, (
+        f"trace-plane duty cycle {duty_pct}% breaches the "
+        f"{TRACE_LEDGER_BUDGET_PCT}% budget")
+
+    # -- unset-parity subrun ----------------------------------------------
+    base_counts = (sec["sampled"], sec["received"], sec["completed"])
+    os.environ.pop("AMTPU_TRACE_SAMPLE", None)
+    tracer._reload_for_tests()
+    try:
+        assert not tracer.enabled()
+        a2, b2, server2, client2 = build_pair()
+        try:
+            with _quiet_traceback_dumps():
+                hashes_off, _ = storm(a2, b2)
+        finally:
+            teardown(a2, b2, server2, client2)
+    finally:
+        tracer._reload_for_tests()
+    assert hashes_off == hashes_on, (
+        "sampling-disabled storm diverged: per-doc hashes differ "
+        f"({sum(1 for d in hashes_on if hashes_on[d] != hashes_off.get(d))}"
+        " docs)")
+    sec_off = tracer.section()
+    off_counts = (sec_off["sampled"], sec_off["received"],
+                  sec_off["completed"])
+    assert off_counts == base_counts, (
+        f"disabled plane still recorded traces: {base_counts} -> "
+        f"{off_counts}")
+
+    crit = sec["critical_path"]
+    return {
+        "config": 19,
+        "name": CONFIGS[19][0],
+        "docs": n_docs,
+        "ops": total_ops,
+        "sample_every": sample_every,
+        "zipf_s": zipf_s,
+        "storm_rounds": rounds,
+        "trace_sampled": sec["sampled"],
+        "trace_completed": sec["completed"],
+        "trace_stitched": sec["stitched"],
+        "trace_expired": sec["expired"],
+        "trace_dropped": sec["dropped"],
+        "trace_completeness_pct": completeness,
+        "trace_stage_sum_err_pct": stage_sum_err,
+        "trace_ledger_overhead_pct": duty_pct,
+        "trace_ledger_self_s": round(sec["self_s"], 5),
+        "trace_disabled_parity": 1,
+        "trace_crit_p50_s": crit["p50_s"],
+        "trace_crit_p99_s": crit["p99_s"],
+        "trace_crit_max_s": crit["max_s"],
+        "trace_stages": {st: d for st, d in sec["stages"].items()},
+        "protocol": (
+            f"{rounds} zipf({zipf_s}) storm rounds x {writes_per_round} "
+            f"writes over {n_docs} docs on a real 2-node TCP fleet "
+            f"(TcpSyncServer/Client), 1-in-{sample_every} deterministic "
+            "sampling; completeness, per-trace stage-sum vs e2e "
+            "critical path, duty cycle and unset-path parity "
+            "(byte-equal hashes, zero traces) asserted in-run"),
+        "traffic_wall_s": round(traffic_wall, 3),
+        "engine_s": round(traffic_wall, 3),
+        "oracle_s": None,
+        "speedup": None,
+        "parity": True,
+    }
+
+
 CONFIGS = {
     1: ("single-doc LWW storm (2 actors x 1000 sets)", gen_lww_storm),
     2: ("nested JSON card board (8 actors)", gen_trellis),
@@ -4075,6 +4284,10 @@ CONFIGS = {
          "hot-tenant storm mid-run, per-tenant cost shares + "
          "quiet-tenant p99 degradation, duty cycle < 2%, disabled-path "
          "parity", None),
+    19: ("trace plane: zipf storm over a 2-node TCP fleet, sampled "
+         "end-to-end lifecycles stitched across the wire, completeness "
+         ">= 99%, stage sums reconcile with e2e lag, duty cycle < 2%, "
+         "unset-path parity", None),
 }
 
 
@@ -4717,6 +4930,8 @@ def run_config(cfg: int, n_docs: int | None = None, oracle_cap_docs=12000):
         return run_dispatch_config()
     if cfg == 18:
         return run_tenant_config()
+    if cfg == 19:
+        return run_trace_config()
     name, gen = CONFIGS[cfg]
     kwargs = {}
     if cfg == 5 and n_docs:
@@ -5062,6 +5277,23 @@ def _final_record(results_by_cfg: dict, backend: str | None, attempts: list):
                 "tenant_disabled_parity": r["tenant_disabled_parity"],
                 "protocol": r["protocol"]}
                if r.get("config") == 18 else {}),
+            **({"trace_sampled": r["trace_sampled"],
+                "trace_completed": r["trace_completed"],
+                "trace_stitched": r["trace_stitched"],
+                "trace_expired": r["trace_expired"],
+                "trace_dropped": r["trace_dropped"],
+                "trace_completeness_pct": r["trace_completeness_pct"],
+                "trace_stage_sum_err_pct": r["trace_stage_sum_err_pct"],
+                "trace_ledger_overhead_pct":
+                    r["trace_ledger_overhead_pct"],
+                "trace_ledger_self_s": r["trace_ledger_self_s"],
+                "trace_disabled_parity": r["trace_disabled_parity"],
+                "trace_crit_p50_s": r["trace_crit_p50_s"],
+                "trace_crit_p99_s": r["trace_crit_p99_s"],
+                "trace_crit_max_s": r["trace_crit_max_s"],
+                "trace_stages": r["trace_stages"],
+                "protocol": r["protocol"]}
+               if r.get("config") == 19 else {}),
             **({"mttr_max_s": r["mttr_max_s"],
                 "mttr_mean_s": r["mttr_mean_s"],
                 "mttr_budget_s": r["mttr_budget_s"],

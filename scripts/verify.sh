@@ -50,7 +50,12 @@
 #          twice — sanitizer off, then under AMTPU_LOCKSAN=1 — with
 #          zero lock-order/long-hold violations and sanitizer overhead
 #          < 5% asserted (docs/ANALYSIS.md "The runtime lock-order
-#          sanitizer"). Never fails verify — a CPU-only
+#          sanitizer"), and the trace smoke: a two-service TCP fleet
+#          under forced sampling proves sampled lifecycles complete as
+#          stitched cross-process waterfalls with the plane's duty
+#          cycle under budget (docs/OBSERVABILITY.md "Trace plane";
+#          the fleet-scale gate is bench config 19 under `make
+#          perfcheck`). Never fails verify — a CPU-only
 #          image or a missing/empty history must not block the build
 #          (TUNNEL_DIAGNOSIS.md: TPU absence is an environment fact, not
 #          a code defect). Run `make perfcheck` for the enforcing gate.
@@ -86,6 +91,8 @@ JAX_PLATFORMS=cpu python -m automerge_tpu.perf tenant --smoke \
     || echo "tenant smoke FAILED (informational here; enforced by tests + perf check)"
 JAX_PLATFORMS=cpu python -m automerge_tpu.perf race --smoke \
     || echo "race smoke FAILED (informational here; enforced by tests + the locksan suite)"
+JAX_PLATFORMS=cpu python -m automerge_tpu.perf trace --smoke \
+    || echo "trace smoke FAILED (informational here; enforced by tests + perf check)"
 
 echo "== stage 3/3: tier-1 suite (ROADMAP.md) =="
 set -o pipefail

@@ -252,6 +252,57 @@ def test_post_mortem_views_from_dump_and_detail(tmp_path):
         "the sender-side join must survive the detail post-mortem path")
 
 
+# -- the trace stage-breakdown band -----------------------------------------
+
+
+def _texemplar(tid, doc, crit, spans, stitched=True):
+    return {"tid": tid, "doc": doc, "actor": tid.split(".")[0],
+            "seq": int(tid.split(".")[1]), "role": "stitched",
+            "origin": "x", "stitched": stitched, "crit_s": crit,
+            "spans": spans, "meta": {}}
+
+
+def test_trace_stage_band_renders_waterfall_rows():
+    tsecs = {"x": {"exemplars": [
+        _texemplar("A.1", "d", 0.5, [["finalize", 0.0, 0.0],
+                                     ["wire", 0.01, 0.1],
+                                     ["visibility", 0.11, 0.39]]),
+        _texemplar("A.9", "other-doc", 9.0, [["wire", 0.0, 9.0]]),
+    ]}}
+    lines = explain.trace_stage_lines("d", tsecs)
+    text = "\n".join(lines)
+    assert "stage breakdown (sampled traces; `perf trace`):" in text
+    assert "trace A.1 @ x (stitched across the wire, e2e 0.5000s):" in text
+    wire_row = next(line for line in lines
+                    if line.strip().startswith("wire"))
+    assert "20.0%" in wire_row                  # 0.1 of 0.5
+    assert "other-doc" not in text              # only this doc's traces
+
+
+def test_trace_stage_band_absent_without_matching_exemplar():
+    assert explain.trace_stage_lines("d", {}) == []
+    tsecs = {"x": {"exemplars": [
+        _texemplar("A.9", "other", 1.0, [["wire", 0.0, 1.0]])]}}
+    assert explain.trace_stage_lines("d", tsecs) == []
+    # an exemplar with no spans disappears the same way
+    tsecs = {"x": {"exemplars": [_texemplar("A.1", "d", 0.0, [])]}}
+    assert explain.trace_stage_lines("d", tsecs) == []
+
+
+def test_trace_stage_band_ranks_and_caps():
+    tsecs = {"x": {"exemplars": [
+        _texemplar(f"A.{k}", "d", float(k), [["wire", 0.0, float(k)]],
+                   stitched=False)
+        for k in range(1, 5)]}}
+    lines = explain.trace_stage_lines("d", tsecs, limit=2)
+    # header + 2 traces x (title + 1 span row) + overflow note
+    assert len(lines) == 1 + 2 * 2 + 1
+    assert "trace A.4" in lines[1]              # slowest e2e first
+    assert "origin-local" in lines[1]
+    assert "trace A.3" in lines[3]
+    assert "+2 more sampled trace(s)" in lines[5]
+
+
 def test_doctor_snapshot_join_emits_doc_stall():
     from automerge_tpu.perf.doctor import diagnose_snapshot
     rep = diagnose_snapshot(_stalled_snapshot(), label="t")
@@ -260,6 +311,45 @@ def test_doctor_snapshot_join_emits_doc_stall():
     ds = next(c for c in rep["causes"] if c["cause"] == "doc_stall")
     assert any("perf explain" in ev for ev in ds["evidence"])
     assert any("'d' @ Y" in ev for ev in ds["evidence"])
+
+
+def test_doctor_trace_stage_dominant_causes():
+    """The doctor's trace-plane join: a stage holding >= 30% of the
+    sampled critical path (visibility excluded — read-cadence bound)
+    becomes its named cause; thin sections stay silent."""
+    from automerge_tpu.perf.doctor import diagnose_snapshot
+
+    def snap(stages, done=8):
+        return {"traceplane": {"nodes": {"x": {
+            "label": "x", "completed": done, "stages": stages,
+            "critical_path": {"count": done, "p99_s": 1.0}}}}}
+
+    hot = snap({
+        "dispatch": {"count": 8, "sum_s": 0.1, "p99_s": 0.02},
+        "coalesce_wait": {"count": 8, "sum_s": 2.0, "p99_s": 0.4},
+        "remote_admission": {"count": 8, "sum_s": 1.8, "p99_s": 0.3},
+        "visibility": {"count": 8, "sum_s": 50.0, "p99_s": 9.0},
+    })
+    causes = {c["cause"]: c for c in diagnose_snapshot(hot)["causes"]}
+    assert "coalesce_wait_hot" in causes
+    assert "remote_admission_hot" in causes
+    assert "wire_serialize_hot" not in causes
+    cw = causes["coalesce_wait_hot"]
+    assert any("flush governor" in ev for ev in cw["evidence"])
+    assert any("perf trace" in ev for ev in cw["evidence"])
+    # visibility never becomes a cause even at 90%+ of wall time
+    assert not any("visibility" in c for c in causes)
+
+    # a balanced pipeline (< 30% each) and a thin sample stay silent
+    quiet = snap({st: {"count": 8, "sum_s": 1.0, "p99_s": 0.1}
+                  for st in ("queue_wait", "coalesce_wait", "dispatch",
+                             "wire", "remote_admission")})
+    assert not any(c["cause"].endswith("_hot")
+                   for c in diagnose_snapshot(quiet)["causes"])
+    thin = snap({"coalesce_wait": {"count": 2, "sum_s": 5.0,
+                                   "p99_s": 2.0}}, done=2)
+    assert not any(c["cause"].endswith("_hot")
+                   for c in diagnose_snapshot(thin)["causes"])
 
 
 def test_cli_explain_contract(tmp_path):

@@ -743,3 +743,91 @@ def test_norm_configs_carries_move_fields():
     assert c16["move_kernel_parity"] is True
     assert c16["move_storm_converged"] is True
     assert "protocol" not in c16  # prose rides the detail sidecar only
+
+
+def test_trace_gates_ok_over_and_absent(tmp_path):
+    """Config-19 trace-plane gates: duty-cycle budget, sampled-trace
+    completeness floor, stage-sum-vs-e2e reconciliation bound and the
+    unset-path parity verdict — all absolute, each judged
+    independently; runs without config 19 skip cleanly."""
+    p = str(tmp_path / "h.jsonl")
+
+    def trec(duty=0.1, comp=100.0, serr=2.3, par=1, source="test"):
+        return _rec(1000, source=source,
+                    configs={"19": {"trace_ledger_overhead_pct": duty,
+                                    "trace_completeness_pct": comp,
+                                    "trace_stage_sum_err_pct": serr,
+                                    "trace_disabled_parity": par,
+                                    "trace_crit_p50_s": 0.12,
+                                    "trace_crit_p99_s": 1.18,
+                                    "trace_stitched": 47}})
+
+    _write(p, [trec(), trec(source="ok")])
+    rc, lines = history.check(path=p)
+    assert rc == 0, lines
+    assert any("trace-plane duty cycle" in ln and "OK" in ln
+               for ln in lines)
+    assert any("trace completeness" in ln and "OK" in ln for ln in lines)
+    assert any("trace stage-sum vs e2e lag" in ln and "OK" in ln
+               for ln in lines)
+    assert any("trace-plane unset-path parity: OK" in ln for ln in lines)
+    assert any("trace critical-path baseline" in ln
+               and "47 stitched across the wire" in ln for ln in lines)
+
+    _write(p, [trec(), trec(duty=3.4, source="heavy")])
+    rc, lines = history.check(path=p)
+    assert rc == 1
+    assert any("TRACE PLANE OVER BUDGET" in ln for ln in lines)
+
+    _write(p, [trec(), trec(comp=91.0, source="leaky")])
+    rc, lines = history.check(path=p)
+    assert rc == 1
+    assert any("SAMPLED TRACES LOST MID-LIFECYCLE" in ln for ln in lines)
+
+    _write(p, [trec(), trec(serr=11.5, source="gappy")])
+    rc, lines = history.check(path=p)
+    assert rc == 1
+    assert any("STAGES DO NOT RECONCILE WITH E2E LAG" in ln
+               for ln in lines)
+
+    _write(p, [trec(), trec(par=0, source="tainted")])
+    rc, lines = history.check(path=p)
+    assert rc == 1
+    assert any("trace-plane unset-path parity: DIVERGED" in ln
+               for ln in lines)
+
+    # a record missing only the duty figure must not vacate the others
+    bad = trec(comp=91.0, source="partial")
+    del bad["configs"]["19"]["trace_ledger_overhead_pct"]
+    _write(p, [trec(), bad])
+    rc, lines = history.check(path=p)
+    assert rc == 1
+    assert any("SAMPLED TRACES LOST MID-LIFECYCLE" in ln for ln in lines)
+
+    _write(p, [trec(), _rec(1000, source="no-cfg19")])
+    rc, lines = history.check(path=p)
+    assert rc == 0
+    assert not any("trace" in ln for ln in lines)
+
+
+def test_norm_configs_carries_trace_fields():
+    rec = {"backend": "cpu", "value": 10, "configs": {
+        "19": {"trace_sampled": 51, "trace_completed": 51,
+               "trace_stitched": 47,
+               "trace_completeness_pct": 100.0,
+               "trace_stage_sum_err_pct": 2.5,
+               "trace_ledger_overhead_pct": 0.074,
+               "trace_disabled_parity": 1,
+               "trace_crit_p50_s": 0.118, "trace_crit_p99_s": 1.183,
+               "trace_stages": {"dropped": "(dict fields ride the "
+                                           "detail sidecar only)"}}}}
+    out = history.record_from_bench(rec)
+    c19 = out["configs"]["19"]
+    assert c19["trace_sampled"] == 51
+    assert c19["trace_stitched"] == 47
+    assert c19["trace_completeness_pct"] == 100.0
+    assert c19["trace_stage_sum_err_pct"] == 2.5
+    assert c19["trace_ledger_overhead_pct"] == 0.074
+    assert c19["trace_disabled_parity"] == 1
+    assert c19["trace_crit_p99_s"] == 1.183
+    assert "trace_stages" not in c19

@@ -11,7 +11,7 @@ import pytest
 from automerge_tpu.perf import slo
 from automerge_tpu.perf.fleet import FleetCollector
 from automerge_tpu.perf.top import (dispatch_lines, hot_doc_lines, render,
-                                    spark, tenant_lines)
+                                    spark, tenant_lines, trace_lines)
 from automerge_tpu.utils import flightrec, metrics
 
 
@@ -25,7 +25,8 @@ def _clean_metrics():
 
 
 def _snap(ops=0, flush_s=0.0, flush_n=0, lockw=0.0, drops=0, conv=None,
-          docledger=None, dispatchledger=None, tenantledger=None):
+          docledger=None, dispatchledger=None, tenantledger=None,
+          traceplane=None):
     out = {
         "sync_ops_ingested": ops,
         "sync_frames_dropped": drops,
@@ -45,6 +46,8 @@ def _snap(ops=0, flush_s=0.0, flush_n=0, lockw=0.0, drops=0, conv=None,
         out["dispatchledger"] = dispatchledger
     if tenantledger is not None:
         out["tenantledger"] = tenantledger
+    if traceplane is not None:
+        out["traceplane"] = traceplane
     return out
 
 
@@ -108,7 +111,8 @@ def _tenant_section(label="y", tenants=None):
 
 
 def _three_node_collector(straggler_conv=2.0, docledger=None,
-                          dispatchledger=None, tenantledger=None):
+                          dispatchledger=None, tenantledger=None,
+                          traceplane=None):
     c = FleetCollector(interval_s=0.02, min_nodes=3)
     c.add_local("a", _scripted(_snap(), _snap(ops=60, flush_s=0.06,
                                               flush_n=30, conv=0.01)),
@@ -121,7 +125,8 @@ def _three_node_collector(straggler_conv=2.0, docledger=None,
                                               conv=straggler_conv,
                                               docledger=docledger,
                                               dispatchledger=dispatchledger,
-                                              tenantledger=tenantledger)),
+                                              tenantledger=tenantledger,
+                                              traceplane=traceplane)),
                 role="peer")
     c.scrape_once()
     time.sleep(0.02)
@@ -335,6 +340,69 @@ def test_tenant_band_ranks_and_caps():
     # highest ingress share first
     assert "t7" in lines[1] and "t6" in lines[2] and "t5" in lines[3]
     assert "+5 more tenant row(s)" in lines[4]
+
+
+def _trace_section(label="y", stages=None, crit_p99=0.5, completed=12):
+    """A minimal `"traceplane"` snapshot section: stages maps
+    stage -> (count, sum_s, p99_s)."""
+    body = {st: {"count": n, "sum_s": s, "p50_s": s / max(n, 1),
+                 "p99_s": p99}
+            for st, (n, s, p99) in (stages or {}).items()}
+    return {"nodes": {label: {
+        "label": label, "sample_rate": 4, "sampled": completed,
+        "completed": completed, "stitched": completed, "expired": 0,
+        "dropped": 0, "inflight": 0, "self_s": 0.001,
+        "stages": body,
+        "critical_path": {"count": completed, "p50_s": crit_p99 / 2,
+                          "p99_s": crit_p99, "max_s": crit_p99},
+        "exemplars": [],
+    }}}
+
+
+def test_trace_band_renders_stage_rows():
+    sec = _trace_section(label="y", stages={
+        "coalesce_wait": (12, 6.0, 0.9),
+        "wire": (12, 3.0, 0.4),
+        "visibility": (12, 50.0, 5.0),   # excluded from the share
+    }, crit_p99=1.25)
+    c = _three_node_collector(traceplane=sec)
+    lines = render(c)
+    text = "\n".join(lines)
+    assert "trace stages (critical-path share; `perf trace`):" in text
+    row = next(line for line in lines if "coalesce_wait" in line)
+    assert "@ y" in row
+    assert "share" in row and "66.7%" in row      # 6.0 of 9.0
+    assert "p99" in row and "0.9000s" in row
+    assert "e2e p99" in row and "1.2500s" in row
+    assert "(12 done)" in row
+    # visibility is read-cadence bound by design: no row for it
+    assert not any(line.lstrip().startswith("visibility")
+                   for line in lines)
+    wire_row = next(line for line in lines if " wire " in line)
+    assert lines.index(row) < lines.index(wire_row)
+
+
+def test_trace_band_absent_without_section():
+    c = _three_node_collector()
+    assert trace_lines(c) == []
+    assert not any("trace stages (" in line for line in render(c))
+    # a section with no stages disappears the same way
+    empty = _trace_section(label="y", stages={})
+    c2 = _three_node_collector(traceplane=empty)
+    assert trace_lines(c2) == []
+
+
+def test_trace_band_ranks_and_caps():
+    stages = {f"s{k}": (4, float(k), 0.1 * k) for k in range(1, 9)}
+    sec = _trace_section(label="hub", stages=stages)
+    c = FleetCollector(interval_s=0.01, min_nodes=3)
+    c.add_local("hub", _scripted(_snap(traceplane=sec)))
+    c.scrape_once()
+    lines = trace_lines(c, limit=3)
+    assert len(lines) == 1 + 3 + 1       # header + rows + overflow note
+    # biggest critical-path share first
+    assert "s8" in lines[1] and "s7" in lines[2] and "s6" in lines[3]
+    assert "+5 more stage row(s)" in lines[4]
 
 
 def test_render_width_clamp():
