@@ -24,7 +24,8 @@ INTERNALS.md §4) and overridable via calibrate() for other deployments.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+import os
+from dataclasses import dataclass, field
 
 # Link cost model (seconds) — tunneled TPU v5e, INTERNALS.md §4.
 _LINK = {
@@ -187,6 +188,171 @@ def plan_for(doc_changes: list, passes: int = 1) -> Plan:
     plan.dims = {"docs": (len(doc_changes), d_pad),
                  "ops": (max_ops, ops_pad), "ins": (max_ins, ins_pad)}
     return plan
+
+
+# ---------------------------------------------------------------------------
+# Megabatch round planning (r20): one fused multi-doc dispatch per flush
+# round. pack.plan_megabuckets quantizes the round's ragged doc sizes onto
+# a small shape ladder; this planner prices the fused bucketed dispatches
+# against what the engine would otherwise do (full-buffer reconcile when a
+# majority of the fleet is dirty, the narrow full-dims lane gather
+# otherwise) and apply_round_adaptive executes the winning route.
+
+_megabatch: bool | None = None
+_megabatch_min: int | None = None
+
+
+def megabatch_enabled() -> bool:
+    """AMTPU_MEGABATCH != "0" (default on). One cached check — the
+    disabled path costs a single comparison per round."""
+    global _megabatch
+    if _megabatch is None:
+        _megabatch = os.environ.get("AMTPU_MEGABATCH", "1") != "0"
+    return _megabatch
+
+
+def megabatch_min_docs() -> int:
+    """Routing threshold (AMTPU_MEGABATCH_MIN_DOCS, default 2): rounds
+    dirtying fewer docs stay on the per-doc path — no batch of one can
+    amortize bucket planning."""
+    global _megabatch_min
+    if _megabatch_min is None:
+        try:
+            _megabatch_min = max(
+                int(os.environ.get("AMTPU_MEGABATCH_MIN_DOCS", "2")), 1)
+        except ValueError:
+            _megabatch_min = 2
+    return _megabatch_min
+
+
+def _reload_for_tests() -> None:
+    global _megabatch, _megabatch_min
+    _megabatch = None
+    _megabatch_min = None
+
+
+@dataclass
+class RoundPlan:
+    route: str                      # "megabatch" | "per_doc"
+    docs: list = field(default_factory=list)    # doc indices, sorted
+    buckets: list = field(default_factory=list)  # pack.plan_megabuckets
+    est_mega_s: float = 0.0
+    est_alt_s: float = 0.0
+
+
+def plan_round(rset, idxs) -> RoundPlan:
+    """Round-level routing for the dirty docs `idxs` of a resident set:
+    bucket their exact used sizes (band scans — correct across
+    compaction/rebuild) and compare the fused bucketed dispatches against
+    the per-doc-path alternative. Returns a RoundPlan whose buckets are
+    the offset tables apply_round_adaptive executes."""
+    from ..utils import metrics
+    from .pack import pad_to_lanes, plan_megabuckets, rows_count
+
+    idxs = sorted(int(i) for i in idxs)
+    if not megabatch_enabled() or len(idxs) < megabatch_min_docs():
+        return RoundPlan("per_doc", idxs)
+    i_used, l_used = rset._mega_doc_sizes(idxs)
+    dims_i, a, dims_le, _a_set, _a_del = rset.dims()
+    buckets = plan_megabuckets(i_used, l_used, (dims_i, a, dims_le),
+                               rset.cap_elems)
+    est_mega = 0.0
+    for b in buckets:
+        i_b, le_b = b["dims"]
+        wire = rows_count(i_b, a, le_b) * pad_to_lanes(len(b["docs"])) * 4
+        est_mega += _device_cost(wire, 1)
+    full_rows = rows_count(dims_i, a, dims_le)
+    n = len(rset.doc_ids)
+    alt_lanes = rset.n_pad if 2 * len(idxs) >= n \
+        else pad_to_lanes(len(idxs))
+    est_alt = _device_cost(full_rows * alt_lanes * 4, 1)
+    if est_mega <= est_alt:
+        return RoundPlan("megabatch", idxs, buckets, est_mega, est_alt)
+    metrics.bump("engine_megabatch_fallbacks")
+    return RoundPlan("per_doc", idxs, buckets, est_mega, est_alt)
+
+
+def apply_round_adaptive(rset, plan: RoundPlan, interpret: bool = False):
+    """Execute a megabatch-routed RoundPlan: per bucket, ONE fused
+    reconcile over a gathered [rows(bucket dims), k_pad] sub-buffer of
+    the host row mirror — the subset-layout property pack.mega_row_map
+    documents makes the hashes bit-identical to the per-doc path. The
+    per-doc hash mirror is refreshed in place (the offset tables make
+    unpacking exact); returns the round's occupancy summary, or None
+    when the plan routed per-doc (caller falls through to the classic
+    paths)."""
+    if plan is None or plan.route != "megabatch" or not plan.buckets:
+        return None
+    import numpy as np
+
+    from ..utils import metrics, perfscope
+    from . import dispatchledger
+    from .pack import mega_row_map, pad_to_lanes
+    from .pallas_kernels import reconcile_rows_hash
+
+    dims_i, a, dims_le, a_set, a_del = rset.dims()
+    mirror = rset._ensure_hash_mirror()
+    idxs = plan.docs
+    logical = padded = docs_cap = 0
+    tenant_lanes: dict[str, float] = {}
+    tenant_of = None
+    try:
+        from ..sync import tenantledger
+        if tenantledger.enabled():
+            tenant_of = tenantledger.tenant_of
+    except Exception:
+        pass
+    for b in plan.buckets:
+        docs = [idxs[p] for p in b["docs"].tolist()]
+        k = len(docs)
+        k_pad = pad_to_lanes(k)
+        i_b, le_b = b["dims"]
+        rmap = mega_row_map(dims_i, a, dims_le, i_b, le_b)
+        # padding lanes must be valid doc columns (the _reconcile_lanes
+        # rule): repeat the last doc, discard its extra hashes below
+        sel = np.asarray(docs + [docs[-1]] * (k_pad - k), np.int64)
+        with perfscope.phase("pack"):
+            sub = rset.rows_host[np.ix_(rmap, sel)]
+        rows_b = len(rmap)
+        with dispatchledger.call_scope(
+                "rows_mega", backend="device", docs=k,
+                axes={"docs": (k, k_pad), "rows": (rows_b, rows_b)}):
+            h = metrics.dispatch_jit(
+                "reconcile_rows_hash", reconcile_rows_hash,
+                rset._to_dev(sub), (i_b, a, le_b, a_set, a_del),
+                interpret)
+        with perfscope.phase("readback"):
+            vals = np.asarray(h)
+        mirror[np.asarray(docs, np.int64)] = vals[:k]
+        rset._doc_dirty.difference_update(docs)
+        logical += rows_b * k
+        padded += rows_b * k_pad
+        docs_cap += k_pad
+        if tenant_of is not None:
+            lane_cost = rows_b * k_pad / k
+            for d in docs:
+                tid = tenant_of(rset.doc_ids[d])
+                tenant_lanes[tid] = tenant_lanes.get(tid, 0.0) + lane_cost
+    nb = len(plan.buckets)
+    summary = {
+        "buckets": nb,
+        "docs": len(idxs),
+        "dispatches": nb,
+        "docs_cap": docs_cap,
+        "logical": logical,
+        "padded": padded,
+        "docs_per_dispatch": round(len(idxs) / nb, 4),
+        "fill_pct": round(100.0 * len(idxs) / docs_cap, 3) if docs_cap
+        else None,
+        "pad_waste_pct": round(100.0 * (1.0 - logical / padded), 3)
+        if padded else None,
+    }
+    if tenant_lanes:
+        summary["tenant_lanes"] = tenant_lanes
+    metrics.bump("engine_megabatch_rounds")
+    metrics.bump("engine_megabatch_docs", len(idxs))
+    dispatchledger.note_megabatch(summary)
+    return summary
 
 
 def plan_spans(n_docs: int, s_pad: int, passes: int = 1) -> Plan:

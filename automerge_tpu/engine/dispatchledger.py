@@ -137,7 +137,7 @@ class _Round:
     """One open flush round: thread-local call accumulator."""
 
     __slots__ = ("label", "dirty_docs", "calls", "dropped", "ambient",
-                 "self_s", "tenants")
+                 "self_s", "tenants", "mega")
 
     def __init__(self, dirty_docs, label, tenants=None):
         self.label = label
@@ -150,6 +150,10 @@ class _Round:
         # None when the tenant plane is disabled — the folded round then
         # stays byte-identical with pre-tenancy exports
         self.tenants = tenants
+        # megabatch occupancy summary (note_megabatch) — the ACHIEVED
+        # numbers next to the projection `perf dispatch` renders; None
+        # keeps pre-r20 folds byte-identical
+        self.mega = None
 
 
 class _Tls(threading.local):
@@ -224,6 +228,10 @@ class DispatchLedger:
         self._ambient_total = 0
         self._jits_total = 0
         self._retraces_total = 0
+        self._mega_rounds_total = 0
+        self._mega_dispatches_total = 0
+        self._mega_docs_total = 0
+        self._mega_docs_cap_total = 0
         self._self_s = 0.0
         self._self_s_flushed = 0.0
         self._active = False
@@ -286,6 +294,35 @@ class DispatchLedger:
                               "padded"):
                         dst[f] += b[f]
                     dst["wall_s"] = round(dst["wall_s"] + b["wall_s"], 6)
+        # megabatch ACHIEVED occupancy over the window — the numbers the
+        # PR 15 projection (perf/dispatchplane.megabatch_rows) is judged
+        # against, so the projection's accuracy is itself measured
+        m_rounds = m_disp = m_docs = m_cap = 0
+        m_logical = m_padded = 0
+        for r in self._ring:
+            m = r.get("mega")
+            if not m:
+                continue
+            m_rounds += 1
+            m_disp += m.get("dispatches", 0)
+            m_docs += m.get("docs", 0)
+            m_cap += m.get("docs_cap", 0)
+            m_logical += m.get("logical", 0)
+            m_padded += m.get("padded", 0)
+        mega = None
+        if m_rounds:
+            mega = {
+                "rounds": m_rounds,
+                "dispatches": m_disp,
+                "docs": m_docs,
+                "docs_per_dispatch": (round(m_docs / m_disp, 4)
+                                      if m_disp else None),
+                "fill_pct": (round(100.0 * m_docs / m_cap, 3)
+                             if m_cap else None),
+                "pad_waste_pct": (
+                    round(100.0 * (1.0 - m_logical / m_padded), 3)
+                    if m_padded else None),
+            }
         # ambient jit dispatches are dispatches too: megabatching must
         # divide them just the same, so they join the numerator
         amp = (round((dispatches + ambient) / dirty, 4) if dirty
@@ -312,6 +349,7 @@ class DispatchLedger:
             "kernels": kernels,
             "buckets": out_buckets,
             "buckets_truncated": max(0, len(buckets) - len(out_buckets)),
+            "megabatch": mega,
         }
 
     def _refresh_gauges_locked(self) -> None:
@@ -328,6 +366,13 @@ class DispatchLedger:
             metrics.gauge("obs_dispatch_per_round",
                           w["dispatches_per_round"])
         metrics.gauge("obs_dispatch_rounds_tracked", w["rounds"])
+        m = w.get("megabatch")
+        if m:
+            if m["docs_per_dispatch"] is not None:
+                metrics.gauge("obs_megabatch_docs_per_dispatch",
+                              m["docs_per_dispatch"])
+            if m["fill_pct"] is not None:
+                metrics.gauge("obs_megabatch_fill_pct", m["fill_pct"])
         delta = self._self_s - self._self_s_flushed
         self._self_s_flushed = self._self_s
         if delta > 0:
@@ -364,6 +409,10 @@ class DispatchLedger:
                 "ambient_total": self._ambient_total,
                 "jits_total": self._jits_total,
                 "retraces_total": self._retraces_total,
+                "mega_rounds_total": self._mega_rounds_total,
+                "mega_dispatches_total": self._mega_dispatches_total,
+                "mega_docs_total": self._mega_docs_total,
+                "mega_docs_cap_total": self._mega_docs_cap_total,
                 "window": window,
                 "ring": ring,
                 "ring_truncated": max(0, len(self._ring) - len(ring)),
@@ -381,6 +430,10 @@ class DispatchLedger:
             self._ambient_total = 0
             self._jits_total = 0
             self._retraces_total = 0
+            self._mega_rounds_total = 0
+            self._mega_dispatches_total = 0
+            self._mega_docs_total = 0
+            self._mega_docs_cap_total = 0
             self._self_s = self._self_s_flushed = 0.0
             self._active = False
             self._mutations = 0
@@ -438,6 +491,8 @@ class _RoundScope:
                 folded["label"] = rd.label
             if rd.tenants:
                 folded["tenants"] = dict(rd.tenants)
+            if rd.mega:
+                folded["mega"] = rd.mega
             amp = ((folded["dispatches"] + folded["ambient"])
                    / rd.dirty_docs if rd.dirty_docs else None)
             led._fold_round_locked(folded)
@@ -470,6 +525,47 @@ def round_scope(dirty_docs: int, label: str | None = None,
     return _RoundScope(dirty_docs, label, tenants=tenants)
 
 
+def note_megabatch(summary: dict) -> None:
+    """One executed megabatch round's ACHIEVED occupancy
+    (engine/dispatch.py apply_round_adaptive): attaches to the open
+    flush round when one is open — the fold carries it to the ring, the
+    tenant lane split (tenant_lanes) and the trace plane — and always
+    updates the cumulative megabatch account. Two summaries in one round
+    (a compaction retry) merge additively."""
+    if not enabled():
+        return
+    t0 = time.perf_counter()
+    rd = _tls.round
+    if rd is not None:
+        m = rd.mega
+        if m is None:
+            rd.mega = dict(summary)
+        else:
+            for f in ("buckets", "docs", "dispatches", "docs_cap",
+                      "logical", "padded"):
+                m[f] = m.get(f, 0) + summary.get(f, 0)
+            if m.get("dispatches"):
+                m["docs_per_dispatch"] = round(
+                    m["docs"] / m["dispatches"], 4)
+            if m.get("docs_cap"):
+                m["fill_pct"] = round(
+                    100.0 * m["docs"] / m["docs_cap"], 3)
+            if m.get("padded"):
+                m["pad_waste_pct"] = round(
+                    100.0 * (1.0 - m["logical"] / m["padded"]), 3)
+            for tid, w in (summary.get("tenant_lanes") or {}).items():
+                lanes = m.setdefault("tenant_lanes", {})
+                lanes[tid] = lanes.get(tid, 0.0) + w
+    led = _ledger
+    with led._lock:
+        led._mega_rounds_total += 1
+        led._mega_dispatches_total += summary.get("dispatches", 0)
+        led._mega_docs_total += summary.get("docs", 0)
+        led._mega_docs_cap_total += summary.get("docs_cap", 0)
+        led._active = True
+        led._self_s += time.perf_counter() - t0
+
+
 def last_round_summary() -> dict | None:
     """The most recently folded round, reduced to what a cross-plane
     join needs: its ledger seq plus per-round amplification / pad-waste.
@@ -489,7 +585,7 @@ def last_round_summary() -> dict | None:
     if r.get("padded"):
         waste = round(100.0 * (1.0 - r["logical"] / r["padded"]), 3)
     return {"round": r.get("round"), "amp": amp,
-            "pad_waste_pct": waste}
+            "pad_waste_pct": waste, "mega": r.get("mega")}
 
 
 class _CallScope:

@@ -223,6 +223,125 @@ def apply_rows_hash(rows, dims: tuple, n_docs: int, interpret: bool = False):
 
 
 # ---------------------------------------------------------------------------
+# Megabatch plane (r20): multi-doc fused dispatch over the docs-minor rows
+#
+# Independent documents already share lanes in the docs-minor buffer above;
+# what they do NOT share is SHAPE — one 16-op doc in a fleet grown to
+# I=1024 pays the whole 1024-row band. The megabatch plane fixes that by
+# observing that a smaller-dims (I', A, L'*E) layout is a pure ROW-INDEX
+# SUBSET of the full (I, A, L*E) layout for the same lanes, provided the
+# elem-slot stride E is preserved (whole lists only):
+#
+#   op bands        rows g + [0, I')           per op group g
+#   clock band      rows co + a*I + [0, I')    per actor a (strided)
+#   elem bands      rows g + [0, L'*E)         per elem group g
+#   ah band         all A rows
+#
+# Every band is lane-independent in the kernel (pallas_kernels: one output
+# per column), op/elem rows join only within their own band ranges, and
+# unused rows (op_mask=0 / ins_mask=0) contribute nothing to the hash — so
+# hashing the subset buffer at dims (I', A, L'*E) is BIT-IDENTICAL to
+# hashing the full buffer, for any I' >= ops_used and L' >= lists_used of
+# every selected lane. Ragged per-doc sizes are bucketed onto a power-of-
+# two ladder (the way pack_moves rank-compresses priorities) so a round
+# compiles to at most MEGA_MAX_BUCKETS kernel shapes; each bucket carries
+# its doc-index table, so unpacking the per-doc hashes is exact.
+
+#: distinct padded shapes per megabatched round — bounds both the compile
+#: cache and the per-round dispatch count (the amplification ceiling)
+MEGA_MAX_BUCKETS = 4
+#: smallest quantized op/list band (the kernel block height)
+MEGA_MIN_DIM = 8
+
+
+def mega_quantize(n: int, cap: int) -> int:
+    """Power-of-two ladder from MEGA_MIN_DIM up to (and clamped at) cap:
+    the bucket-shape rank compression. cap itself need not be a power of
+    two — the top rung is the fleet dimension."""
+    q = MEGA_MIN_DIM
+    while q < n:
+        q *= 2
+    return min(q, cap)
+
+
+def mega_bucket_dims(i_used: int, l_used: int, caps: tuple,
+                     e: int) -> tuple:
+    """Quantized (i_b, le_b) bucket dims for one doc's used sizes under
+    fleet caps (I, A, LE). Elem slots subset at LIST granularity only
+    (le_b = l_b * e keeps the slot stride), and both dims must stay
+    multiples of the kernel block height; when alignment cannot be met
+    the dimension falls back to the full fleet value."""
+    i_cap, a, le_cap = caps
+    i_b = mega_quantize(max(int(i_used), 1), i_cap)
+    if i_b % 8:
+        i_b = i_cap
+    if le_cap == 0 or e == 0:
+        return i_b, 0
+    l_cap = le_cap // e
+    l_b = mega_quantize(max(int(l_used), 1), l_cap) if l_used else 0
+    while l_b < l_cap and (l_b * e) % 8:
+        l_b *= 2
+    le_b = min(l_b * e, le_cap)
+    if le_b % 8:
+        le_b = le_cap
+    return i_b, le_b
+
+
+def mega_row_map(i: int, a: int, le: int, i_b: int,
+                 le_b: int) -> np.ndarray:
+    """Row indices into the full (i, a, le) docs-minor buffer that
+    gather a valid (i_b, a, le_b) buffer for the SAME doc lanes — the
+    subset property the module comment proves. Length is
+    rows_count(i_b, a, le_b); row_bases is the one layout definition on
+    both sides."""
+    src = row_bases(i, a, le)
+    ops = np.arange(i_b, dtype=np.int64)
+    elems = np.arange(le_b, dtype=np.int64)
+    parts = [src[g] + ops
+             for g in ("om", "ac", "fid", "act", "seq", "chg", "fh", "vh")]
+    parts.extend(src["co"] + aa * i + ops for aa in range(a))
+    parts.extend(src[g] + elems for g in ("im", "if", "ip", "io", "il"))
+    parts.append(src["ah"] + np.arange(a, dtype=np.int64))
+    out = np.concatenate(parts)
+    assert len(out) == rows_count(i_b, a, le_b)
+    return out
+
+
+def plan_megabuckets(i_used, l_used, caps: tuple, e: int) -> list[dict]:
+    """Bucket a round's docs by quantized shape: positions i group under
+    (i_b, le_b) = mega_bucket_dims(i_used[i], l_used[i]). More than
+    MEGA_MAX_BUCKETS distinct shapes merge smallest-volume-first into
+    their elementwise-max superset (any doc hashes identically at any
+    dims >= its used sizes, so merging only adds padding, never error).
+
+    Returns [{"dims": (i_b, le_b), "docs": np.ndarray positions}],
+    largest bucket first — the offset tables that make unpacking exact.
+    """
+    i_used = np.asarray(i_used, np.int64)
+    l_used = np.asarray(l_used, np.int64)
+    groups: dict[tuple, list] = {}
+    for pos in range(len(i_used)):
+        key = mega_bucket_dims(int(i_used[pos]), int(l_used[pos]), caps, e)
+        groups.setdefault(key, []).append(pos)
+    a_rows = caps[1]
+    while len(groups) > MEGA_MAX_BUCKETS:
+        # merge the smallest padded volume into its cheapest superset
+        small = min(groups, key=lambda k: (rows_count(k[0], a_rows, k[1])
+                                           * len(groups[k])))
+        members = groups.pop(small)
+        best = min(groups,
+                   key=lambda k: rows_count(max(k[0], small[0]), a_rows,
+                                            max(k[1], small[1])))
+        merged = (max(best[0], small[0]), max(best[1], small[1]))
+        members.extend(groups.pop(best))
+        groups.setdefault(merged, []).extend(members)
+    out = [{"dims": k, "docs": np.asarray(sorted(v), np.int64)}
+           for k, v in groups.items()]
+    out.sort(key=lambda b: -len(b["docs"]))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Span-table lane layout (the batched text-merge plane's wire shape)
 #
 # A span table is the run-length-encoded form of a text document's visible

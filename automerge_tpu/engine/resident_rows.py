@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from . import dispatchledger
 from .encode import _pad_to, content_hash
 from .resident import ResidentDocSet
+from . import dispatch as round_dispatch
 from .pack import pad_to_lanes
 from .pallas_kernels import reconcile_rows_hash
 from ..utils import flightrec, metrics, perfscope
@@ -1345,10 +1346,21 @@ class ResidentRowsDocSet(ResidentDocSet):
                         metrics.bump("rows_rounds_fallback", len(rounds))
                     encoded = [self._encode_round_frame(rc) for rc in rounds]
                 self._grow_for_rounds(encoded)
-                need_pre = (not self.lazy_dispatch
+                # r20 megabatch intent: an eager round dirtying enough
+                # docs skips the full-buffer device apply (and its
+                # pre-round host copy) — the dirty lanes reconcile
+                # through the fused bucketed dispatches instead, planned
+                # AFTER the trips commit so bucket shapes see this
+                # round's ops (engine/dispatch.py plan_round)
+                mega = (not self.lazy_dispatch
+                        and round_dispatch.megabatch_enabled()
+                        and len({d for rc in rounds for d in rc.doc_ids})
+                        >= round_dispatch.megabatch_min_docs())
+                need_pre = (not self.lazy_dispatch and not mega
                             and (self._dirty or self.rows_dev is None))
                 pre_rows = self.rows_host.copy() if need_pre else None
                 trip_list = [self._cols_triplets(e) for e in encoded]
+                self._mega_intent = mega
                 with self._dispatch_guard():
                     return self._dispatch_final(trip_list, pre_rows,
                                                 interpret)
@@ -1828,7 +1840,12 @@ class ResidentRowsDocSet(ResidentDocSet):
         overwrite each other on re-linearized position rows), so the scan
         over rounds collapses into a single gather-free scatter. Returns
         the device hash array without reading it back (None under
-        lazy_dispatch — the next hashes() read reconciles)."""
+        lazy_dispatch — the next hashes() read reconciles). Under the
+        megabatch route (_mega_intent, set by _apply_round_frames) the
+        host mirror is refreshed in place through the fused bucketed
+        dispatches and the hashes return from the mirror."""
+        mega = getattr(self, "_mega_intent", False)
+        self._mega_intent = False
         touched = self._mark_trips_dirty(trip_list)
         if self.lazy_dispatch:
             # _cols_triplets already committed the round to the host
@@ -1839,6 +1856,28 @@ class ResidentRowsDocSet(ResidentDocSet):
             self._dirty = True
             self._hash_handle = None
             return None
+        if mega and touched:
+            # megabatch route: the round is committed to the host mirror,
+            # which becomes authoritative — drop the device copy and
+            # reconcile ONLY this round's lanes through the fused
+            # bucketed dispatches (flush-time hash freshness at O(round),
+            # not the O(fleet) full-buffer apply). A cost-model fallback
+            # leaves the lanes dirty; the next hash read reconciles them
+            # through the classic narrow gather — byte-identical hashes
+            # either way (pack.mega_row_map's subset property).
+            self.rows_dev = None
+            self._dirty = True
+            self._hash_handle = None
+            plan = round_dispatch.plan_round(self, sorted(touched))
+            round_dispatch.apply_round_adaptive(self, plan, interpret)
+            # keep the return contract (post-batch per-doc hashes, padded
+            # to n_pad): a cost-model fallback — or dirty lanes outside
+            # this round — reconciles through the classic paths first
+            self._refresh_hash_mirror(None, interpret)
+            n = len(self.doc_ids)
+            out = np.zeros(self.n_pad, np.uint32)
+            out[:n] = self._ensure_hash_mirror()[:n]
+            return jnp.asarray(out)
         parts = [t for t in trip_list if len(t)]
         if parts:
             trips = np.concatenate(parts)
@@ -1916,6 +1955,24 @@ class ResidentRowsDocSet(ResidentDocSet):
                        and (want is None or i in want))
         if not dirty:
             return
+        if round_dispatch.megabatch_enabled() \
+                and 2 * len(dirty) < n \
+                and len(dirty) >= round_dispatch.megabatch_min_docs():
+            # r20 megabatch: bucket the dirty lanes by quantized shape
+            # and reconcile each bucket in ONE fused dispatch at the
+            # bucket's (smaller) dims — strictly less wire and compute
+            # than the full-dims alternatives below whenever doc sizes
+            # sit under the fleet caps. Falls through on a cost-model
+            # per-doc verdict (plan_round), hashes byte-identical.
+            # Gated to a minority-dirty fleet: when most lanes are dirty
+            # the bucketed gathers approach full-buffer size anyway, and
+            # the classic branch below re-primes the resident device
+            # copy (the posture the sharded per-device binding relies
+            # on) for one kernel shape.
+            plan = round_dispatch.plan_round(self, dirty)
+            if round_dispatch.apply_round_adaptive(
+                    self, plan, interpret) is not None:
+                return
         if 2 * len(dirty) >= n:
             # majority dirty: the narrow gather would copy most of the
             # buffer anyway — run the full-buffer reconcile (one kernel
@@ -1937,6 +1994,30 @@ class ResidentRowsDocSet(ResidentDocSet):
             self._doc_dirty.clear()
             return
         self._reconcile_lanes(dirty, interpret)
+
+    def _mega_doc_sizes(self, idxs):
+        """Exact per-doc used sizes for megabatch bucket planning, from
+        band scans over the selected lanes of the host row mirror: the
+        highest op row with op_mask set, and the highest occupied elem
+        slot rounded up to whole lists (elem bands subset only at list
+        granularity — pack.mega_row_map). Scanning the mirror, not the
+        admission bookkeeping, keeps the sizes correct across
+        compaction/rebuild. Returns (i_used, l_used) int64 arrays."""
+        b = self._bases()
+        sel = np.asarray(idxs, np.int64)
+        I = self.cap_ops
+        om = self.rows_host[b["om"]:b["om"] + I][:, sel] > 0
+        i_used = np.where(om.any(axis=0),
+                          I - np.argmax(om[::-1], axis=0), 0)
+        le = self.cap_lists * self.cap_elems
+        if le:
+            im = self.rows_host[b["im"]:b["im"] + le][:, sel] > 0
+            slot = np.where(im.any(axis=0),
+                            le - np.argmax(im[::-1], axis=0), 0)
+            l_used = -(-slot // self.cap_elems)
+        else:
+            l_used = np.zeros(len(sel), np.int64)
+        return i_used.astype(np.int64), l_used.astype(np.int64)
 
     def _reconcile_lanes(self, idxs: list[int], interpret) -> None:
         """Reconcile ONLY the given doc lanes: gather their columns from

@@ -32,6 +32,9 @@ ledger" / "Tenant attribution plane" / "Trace plane").
 - `remediate` — the chaos-recovery smoke (verify.sh stage 2): injects
   one conn_kill into a supervised TCP link and asserts the fleet
   self-heals (perf/remediate.py).
+- `megabatch` — the fused multi-doc round smoke (verify.sh stage 2):
+  a mixed-shape fleet storm through the megabatch path, byte-equal
+  against the disabled path (perf/megabatchplane.py).
 
 Exit codes: 0 = ok (including a gracefully skipped check), 1 = the
 regression gate tripped, 2 = usage error.
@@ -225,6 +228,12 @@ def main(argv=None) -> int:
         # sanitizer overhead < 5%
         from . import raceplane
         return raceplane.smoke_main(rest)
+    if cmd == "megabatch":
+        # the megabatch-plane smoke (verify.sh stage 2): a mixed-shape
+        # fleet storm through the fused multi-doc round, byte-equal
+        # against the AMTPU_MEGABATCH=0 path, occupancy asserted
+        from . import megabatchplane
+        return megabatchplane.smoke_main(rest)
     if cmd == "roofline":
         from . import roofline
         roofline.main(rest)
@@ -235,8 +244,8 @@ def main(argv=None) -> int:
         return 0
     print(f"unknown command {cmd!r}; expected one of "
           "report, check, contention, doctor, explain, top, dispatch, "
-          "tenant, trace, remediate, move, bootstrap, race, roofline, "
-          "resident",
+          "tenant, trace, remediate, move, bootstrap, race, megabatch, "
+          "roofline, resident",
           file=sys.stderr)
     return 2
 

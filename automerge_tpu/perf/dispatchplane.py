@@ -177,6 +177,23 @@ def report_lines(label: str, sec: dict, limit: int = 8) -> list[str]:
                 f"    projected: {base} -> {base - saved} dispatch(es) "
                 f"({_fmt(100.0 * saved / base, '%', 1)} fewer) over the "
                 "window if independent docs shared lanes")
+    mega = w.get("megabatch")
+    if mega:
+        lines.append(
+            f"  megabatch achieved ({mega.get('rounds', 0)} fused "
+            f"round(s)): {mega.get('docs', 0)} doc(s) over "
+            f"{mega.get('dispatches', 0)} dispatch(es) = "
+            f"{_fmt(mega.get('docs_per_dispatch'), nd=1)} docs/disp | "
+            f"bucket fill {_fmt(mega.get('fill_pct'), '%', 1)} | "
+            f"pad waste {_fmt(mega.get('pad_waste_pct'), '%', 1)}")
+    elif sec.get("mega_rounds_total"):
+        md, mt = sec.get("mega_docs_total", 0), \
+            sec.get("mega_dispatches_total", 0)
+        lines.append(
+            f"  megabatch achieved (cumulative, outside the ring "
+            f"window): {sec.get('mega_rounds_total')} fused round(s), "
+            f"{md} doc(s) over {mt} dispatch(es)"
+            + (f" = {_fmt(md / mt, nd=1)} docs/disp" if mt else ""))
     truncated = w.get("buckets_truncated") or 0
     if truncated:
         lines.append(f"  (+{truncated} bucket shape(s) beyond the "

@@ -408,6 +408,15 @@ def trace_stage_lines(doc_id: str, tsections: dict,
             share = 100.0 * float(dur) / crit
             lines.append(f"      {st:<17} {float(dur):>10.6f}s "
                          f"{share:>5.1f}%")
+        meta = t.get("meta") or {}
+        if meta.get("mega_docs") is not None:
+            waste = meta.get("mega_pad_waste_pct")
+            lines.append(
+                f"      (ops rode fused round {meta.get('round', '?')}: "
+                f"{meta.get('mega_docs')} doc(s) across "
+                f"{meta.get('mega_buckets')} bucket(s)"
+                + (f", {waste:.1f}% pad waste" if waste is not None else "")
+                + ")")
     if len(rows) > limit:
         lines.append(f"    (+{len(rows) - limit} more sampled trace(s) "
                      "— run `perf trace` for the waterfalls)")

@@ -147,6 +147,18 @@ TRACE_COMPLETENESS_MIN_PCT = 99.0
 #: something other than the latency they claim to explain.
 TRACE_STAGE_SUM_ERR_MAX_PCT = 5.0
 
+#: megabatch-plane gates (r20, config 20). Both ABSOLUTE — the first is
+#: the perf claim the plane exists to cash, the second is the r17
+#: baseline it must divide:
+#: the fused multi-doc round path must flush the 10K-doc zipf storm at
+#: least this many times faster than the identical storm under
+#: AMTPU_MEGABATCH=0 (the per-doc reference path),
+MEGABATCH_SPEEDUP_MIN = 5.0
+#: and fused dispatches per dirty doc served must stay STRICTLY below
+#: the per-doc dispatch-amplification floor config 17 recorded — a
+#: megabatch that does not divide amplification is just padding.
+MEGABATCH_AMP_MAX = 0.019
+
 #: partial-replication gates (r12, config 13). All ABSOLUTE — each is a
 #: property of the subscription/relay code, not of the host:
 #: relay-tree total fan-out bytes must grow sublinearly in subscriber
@@ -409,7 +421,25 @@ def _norm_configs(raw) -> dict:
                                        "trace_ledger_overhead_pct",
                                        "trace_disabled_parity",
                                        "trace_crit_p50_s",
-                                       "trace_crit_p99_s")
+                                       "trace_crit_p99_s",
+                                       # the megabatch plane (r20,
+                                       # config 20): fused-vs-per-doc
+                                       # round throughput, flush
+                                       # percentiles, achieved
+                                       # amplification + occupancy,
+                                       # both parity verdicts
+                                       "megabatch_speedup_x",
+                                       "megabatch_round_p50_s",
+                                       "megabatch_round_p99_s",
+                                       "perdoc_round_p50_s",
+                                       "perdoc_round_p99_s",
+                                       "megabatch_amplification",
+                                       "megabatch_rounds_fused",
+                                       "megabatch_dispatches",
+                                       "megabatch_docs_served",
+                                       "megabatch_docs_per_dispatch",
+                                       "megabatch_parity",
+                                       "megabatch_disabled_parity")
                      if isinstance(v.get(k), (int, float, str))}
         elif isinstance(v, (int, float)):
             entry = {"speedup": v}
@@ -1165,6 +1195,58 @@ def check(path: str | None = None, record: dict | None = None,
             extra.append(f"{int(tst)} stitched across the wire")
         lines.append("  trace critical-path baseline (ROADMAP #2 "
                      "shifts this): " + "; ".join(extra))
+
+    # megabatch-plane gates (r20, config 20): the fused round path must
+    # beat the per-doc reference by >= MEGABATCH_SPEEDUP_MIN on the
+    # identical storm, fused amplification must stay strictly below the
+    # r17 per-doc baseline (MEGABATCH_AMP_MAX), and BOTH parity
+    # verdicts (fused vs per-doc hashes; AMTPU_MEGABATCH=0 recording
+    # zero fused rounds) must have held in-run. Skip-clean: runs
+    # without config 20 never fail.
+    def _mb(r: dict):
+        return ((r.get("configs") or {}).get("20") or {})
+
+    mb_x = _mb(current).get("megabatch_speedup_x")
+    if isinstance(mb_x, (int, float)):
+        verdict = ("OK" if mb_x >= MEGABATCH_SPEEDUP_MIN
+                   else "FUSED ROUNDS TOO SLOW")
+        lines.append(
+            f"  megabatch round throughput (config 20): x{mb_x:.2f} "
+            f"vs per-doc (floor >= x{MEGABATCH_SPEEDUP_MIN}) "
+            f"-> {verdict}")
+        if mb_x < MEGABATCH_SPEEDUP_MIN:
+            rc = 1
+    mb_amp = _mb(current).get("megabatch_amplification")
+    if isinstance(mb_amp, (int, float)):
+        verdict = ("OK" if mb_amp < MEGABATCH_AMP_MAX
+                   else "AMPLIFICATION NOT DIVIDED")
+        lines.append(
+            f"  megabatch amplification (config 20): {mb_amp:.5f} "
+            f"dispatches/doc (strictly < {MEGABATCH_AMP_MAX} — the "
+            f"r17 per-doc baseline) -> {verdict}")
+        if mb_amp >= MEGABATCH_AMP_MAX:
+            rc = 1
+    for key, label in (("megabatch_parity", "fused-vs-per-doc"),
+                       ("megabatch_disabled_parity",
+                        "AMTPU_MEGABATCH=0")):
+        v = _mb(current).get(key)
+        if v is not None:
+            lines.append(f"  megabatch {label} parity: "
+                         + ("OK (byte-equal hashes)" if v
+                            else "DIVERGED"))
+            if not v:
+                rc = 1
+    mb_p99 = _mb(current).get("megabatch_round_p99_s")
+    if isinstance(mb_p99, (int, float)):
+        extra = [f"fused round p99 {mb_p99:.4f}s"]
+        pd_p99 = _mb(current).get("perdoc_round_p99_s")
+        if isinstance(pd_p99, (int, float)):
+            extra.append(f"per-doc p99 {pd_p99:.4f}s")
+        dpd = _mb(current).get("megabatch_docs_per_dispatch")
+        if isinstance(dpd, (int, float)):
+            extra.append(f"{dpd:.0f} docs/dispatch achieved")
+        lines.append("  megabatch occupancy baseline: "
+                     + "; ".join(extra))
 
     # keystroke-flatness gate (r8, config 7): latency at 4x document
     # length over 1x must stay under the ceiling. A RATIO is

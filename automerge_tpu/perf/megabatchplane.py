@@ -1,0 +1,165 @@
+"""`perf megabatch --smoke`: the fused multi-doc round, proven end to end.
+
+The seconds-scale verify.sh stage-2 proof for the megabatch plane
+(docs/OBSERVABILITY.md "The megabatch plane (r20)"): a heterogeneous
+rows fleet — one large doc that grows the resident caps, then a storm
+of small docs — is flushed through the eager dispatch path twice, once
+with megabatch routing live and once with `AMTPU_MEGABATCH=0`, from
+the SAME generated change sets (doc init is actor-random, so parity
+must replay identical changes, never rebuild). Asserted:
+
+- the storm round actually routed through the fused path (the dispatch
+  ledger's cumulative megabatch account moved, with bucket count within
+  `pack.MEGA_MAX_BUCKETS`);
+- converged hashes are BYTE-IDENTICAL between the fused and disabled
+  paths for every doc — the subset-row-map invariant;
+- the fused round's padded volume never exceeds what the classic
+  full-layout gather would have shipped (amplification, not hope);
+- occupancy telemetry landed (docs/dispatch, fill, pad waste).
+
+The deeper perf claim (>= 5x round throughput at 1K dirty docs per
+round) belongs to bench config 20 / `perf check`; this smoke proves
+correctness and liveness in seconds on any backend. The TPU link-cost
+model is recalibrated to CPU-scale constants for the run (and restored)
+so the planner's cost comparison reflects the machine the smoke is on.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: storm width — enough small docs to make lane sharing the obvious win
+SMOKE_DOCS = 24
+#: ops in the cap-growing large doc (inflates the full layout the
+#: classic path must gather)
+BIG_OPS = 96
+
+
+def _build_changes():
+    """One large doc + SMOKE_DOCS small docs, as (doc_id, changes)
+    pairs generated ONCE — both services replay exactly these."""
+    import automerge_tpu as am
+
+    out = []
+    big = am.init("big")
+    big = am.change(big, lambda d: am.assign(
+        d, {"items": list(range(BIG_OPS)), "meta": {"kind": "big"}}))
+    out.append(("doc-big", big._doc.opset.get_missing_changes({})))
+    for i in range(SMOKE_DOCS):
+        doc = am.init(f"w{i:03d}")
+        doc = am.change(doc, lambda d, i=i: am.assign(
+            d, {"x": i, "tags": ["a", "b"]}))
+        out.append((f"doc{i:03d}", doc._doc.opset.get_missing_changes({})))
+    return out
+
+
+def _run_fleet(changes):
+    """Flush the generated fleet through one eager-dispatch service:
+    the big doc's round first (grows caps), then the small-doc storm
+    as ONE coalesced round. Returns {doc_id: uint32 hash}."""
+    from ..sync.service import EngineDocSet
+
+    svc = EngineDocSet(backend="rows")
+    svc._lazy_resolved = True
+    svc._resident.lazy_dispatch = False
+    try:
+        big_id, big_chs = changes[0]
+        svc.apply_changes(big_id, big_chs)
+        svc.hashes()
+        with svc.batch():
+            for did, chs in changes[1:]:
+                svc.apply_changes(did, chs)
+        return {d: np.uint32(h) for d, h in svc.hashes().items()}
+    finally:
+        svc.close()
+
+
+def smoke_run(verbose: bool = True) -> int:
+    import os
+
+    from ..engine import dispatch, dispatchledger, pack
+
+    if not dispatch.megabatch_enabled():
+        print("perf megabatch --smoke: routing disabled "
+              "(AMTPU_MEGABATCH=0) — nothing to prove")
+        return 0
+    changes = _build_changes()
+
+    # CPU-scale link constants so the planner's fused-vs-classic wire
+    # comparison decides (the baked-in TPU constants price every extra
+    # dispatch at PCIe round-trip cost and would mask the routing)
+    keys = ("dispatch_fixed_s", "h2d_call_s", "d2h_call_s")
+    saved = {k: dispatch._LINK[k] for k in keys}
+    dispatch.calibrate(dispatch_fixed_s=1e-5, h2d_call_s=1e-6,
+                       d2h_call_s=1e-5)
+    led = dispatchledger.ledger() if dispatchledger.enabled() else None
+    base = (led.section() or {}) if led else {}
+    base_mega = int(base.get("mega_rounds_total") or 0)
+    try:
+        fused = _run_fleet(changes)
+    finally:
+        dispatch.calibrate(**saved)
+
+    # the disabled path, same change sets: byte parity or bust
+    os.environ["AMTPU_MEGABATCH"] = "0"
+    dispatch._reload_for_tests()
+    try:
+        classic = _run_fleet(changes)
+    finally:
+        os.environ.pop("AMTPU_MEGABATCH", None)
+        dispatch._reload_for_tests()
+
+    assert set(fused) == set(classic)
+    diverged = [d for d in fused if fused[d] != classic[d]]
+    assert not diverged, (
+        f"fused path diverged from the disabled path on {diverged}")
+
+    summary = None
+    if led:
+        sec = led.section() or {}
+        new_mega = int(sec.get("mega_rounds_total") or 0) - base_mega
+        assert new_mega >= 1, (
+            "the storm round never routed through the fused path "
+            f"(mega_rounds_total moved by {new_mega})")
+        summary = {
+            "rounds": new_mega,
+            "dispatches": (int(sec.get("mega_dispatches_total") or 0)
+                           - int(base.get("mega_dispatches_total") or 0)),
+            "docs": (int(sec.get("mega_docs_total") or 0)
+                     - int(base.get("mega_docs_total") or 0)),
+        }
+        assert summary["docs"] >= SMOKE_DOCS, (
+            f"fused rounds served {summary['docs']} doc(s); the "
+            f"{SMOKE_DOCS}-doc storm should ride the fused path")
+        assert summary["dispatches"] <= (summary["rounds"]
+                                         * pack.MEGA_MAX_BUCKETS), (
+            f"{summary['dispatches']} fused dispatch(es) over "
+            f"{summary['rounds']} round(s) breaches the "
+            f"{pack.MEGA_MAX_BUCKETS}-bucket cap")
+    if verbose:
+        if summary:
+            per = (summary["docs"] / summary["dispatches"]
+                   if summary["dispatches"] else 0.0)
+            print(f"perf megabatch --smoke OK: {len(fused)} doc(s) "
+                  f"byte-equal across paths; {summary['rounds']} fused "
+                  f"round(s), {summary['docs']} doc(s) over "
+                  f"{summary['dispatches']} dispatch(es) "
+                  f"({per:.1f} docs/disp)")
+        else:
+            print(f"perf megabatch --smoke OK: {len(fused)} doc(s) "
+                  "byte-equal across paths (dispatch ledger off — "
+                  "occupancy not asserted)")
+    return 0
+
+
+def smoke_main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="automerge_tpu.perf megabatch")
+    ap.add_argument("--smoke", action="store_true",
+                    help="fused-round liveness + byte parity vs the "
+                         "disabled path (CI self-check)")
+    ap.parse_args(argv)
+    # occupancy reporting lives in `perf dispatch` (projected vs
+    # achieved); this command is the smoke alone
+    return smoke_run()

@@ -74,17 +74,42 @@ def attribution_check(sec: dict) -> dict:
     and the summed dispatch shares must cover every attributed round —
     the config-18 'sums to fleet totals within 1%' gate, computed from
     one section so bench and CLI share the arithmetic. Truncated exports
-    (more tenants than EXPORT_TENANTS) disclose rather than fail."""
+    (more tenants than EXPORT_TENANTS) disclose rather than fail.
+
+    r20 extends the proof to the flush-round cost axes: summed per-tenant
+    dispatch/padded/logical/wall shares must land back on the ledger's
+    fleet totals even when megabatched rounds split the area-like costs
+    by lane occupancy instead of doc count (sync/tenantledger.py
+    note_round) — re-weighting must never create or destroy cost. Those
+    err_pcts are only meaningful on a complete export; err_pct (the
+    headline) stays the max over the axes that could be checked."""
     tenants = sec.get("tenants") or {}
     admitted = sum(int(t.get("admitted") or 0) for t in tenants.values())
     total = int(sec.get("admitted_total") or 0)
     err_pct = (abs(admitted - total) * 100.0 / total) if total else 0.0
-    return {
+    complete = not (sec.get("truncated") or 0)
+    out = {
         "admitted_sum": admitted,
         "admitted_total": total,
         "err_pct": round(err_pct, 4),
-        "complete": not (sec.get("truncated") or 0),
+        "complete": complete,
     }
+    if complete:
+        for axis, key in (("dispatch", "dispatch_share"),
+                          ("padded", "padded_share"),
+                          ("logical", "logical_share"),
+                          ("wall", "wall_share_s")):
+            fleet = sec.get(f"{axis}_total" if axis != "wall"
+                            else "wall_total_s")
+            if fleet is None:
+                continue
+            summed = sum(float(t.get(key) or 0.0) for t in tenants.values())
+            axis_err = (abs(summed - fleet) * 100.0 / fleet) if fleet else 0.0
+            out[f"{axis}_sum"] = round(summed, 4)
+            out[f"{axis}_total"] = fleet
+            out[f"{axis}_err_pct"] = round(axis_err, 4)
+            out["err_pct"] = max(out["err_pct"], round(axis_err, 4))
+    return out
 
 
 def _fmt(v, unit="", nd=2):

@@ -1384,6 +1384,13 @@ class EngineDocSet:
             rset.lazy_dispatch = jax.default_backend() == "cpu"
             self._lazy_resolved = True
 
+        # r20 megabatch handoff: the coalesced round frame (every doc
+        # dirtied this round, one columnar frame) IS the unit the engine's
+        # round planner buckets into fused multi-doc dispatches
+        # (engine/dispatch.py plan_round / apply_round_adaptive). Below
+        # AMTPU_MEGABATCH_MIN_DOCS — or on a cost-model loss — the engine
+        # falls back to the per-doc-era dispatch paths; converged hashes
+        # are byte-equal either way (tests/test_megabatch.py pins it).
         round_ = round_from_parts(pending)
         try:
             rset.apply_round_frames([round_])

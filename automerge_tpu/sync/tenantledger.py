@@ -165,6 +165,10 @@ class TenantLedger:
         self._overflowed = 0        # distinct ids folded into _overflow
         self._admitted_total = 0
         self._rounds_total = 0
+        self._dispatch_total = 0.0
+        self._padded_total = 0
+        self._logical_total = 0
+        self._wall_total_s = 0.0
         self._self_s = 0.0
         self._self_s_flushed = 0.0
         self._active = False
@@ -280,9 +284,21 @@ class TenantLedger:
                    label: str | None = None) -> None:
         """One folded flush round's per-tenant dispatch/padding shares
         (engine/dispatchledger.py round fold): the round's dispatches,
-        padded/logical lanes, and wall are attributed proportionally by
-        each tenant's dirty-doc count — Jiffy's amortized batch cost,
-        divided by who filled the batch."""
+        padded/logical lanes, and wall are attributed proportionally —
+        Jiffy's amortized batch cost, divided by who filled the batch.
+
+        Pre-r20 the split assumed each dispatch served one doc's dirty
+        fraction (dirty-doc count as weight). A megabatched round fuses
+        docs of very different shapes into shared dispatches, so when the
+        fold carries the megabatch occupancy summary (folded["mega"]
+        ["tenant_lanes"], engine/dispatch.py apply_round_adaptive), the
+        padded/logical/wall costs divide by each tenant's actual padded-
+        LANE occupancy instead — a tenant whose docs landed in big
+        buckets pays for big buckets. Dispatch counts stay doc-weighted
+        (a fused dispatch is shared headcount, not lane area). Both
+        weightings are normalized, so per-tenant shares still sum to the
+        fleet totals accumulated here (perf/tenantplane.py
+        attribution_check proves it per snapshot)."""
         if not enabled() or not tenant_docs:
             return
         t0 = time.perf_counter()
@@ -292,17 +308,35 @@ class TenantLedger:
         padded = folded.get("padded") or 0
         logical = folded.get("logical") or 0
         wall = folded.get("wall_s") or 0.0
+        lanes = (folded.get("mega") or {}).get("tenant_lanes") or None
+        # lane-occupancy weights for the area-like costs; tenants absent
+        # from the mega summary (their docs reconciled on a classic path
+        # this round) fall back to doc weight, and the mixed vector is
+        # re-normalized so shares still sum exactly to the fleet totals
+        lweight = {}
+        if lanes:
+            lanes_total = sum(lanes.values()) or 1.0
+            for tid, n in tenant_docs.items():
+                lweight[tid] = (lanes[tid] / lanes_total if tid in lanes
+                                else n / total)
+            lsum = sum(lweight.values()) or 1.0
+            lweight = {tid: w / lsum for tid, w in lweight.items()}
         with self._lock:
             for tid, n in tenant_docs.items():
                 share = n / total
+                lshare = lweight.get(tid, share)
                 t = self._tenant_locked(tid)
                 t.rounds += 1
                 t.dirty_docs += int(n)
                 t.dispatch_share += dispatches * share
-                t.padded_share += padded * share
-                t.logical_share += logical * share
-                t.wall_share_s += wall * share
+                t.padded_share += padded * lshare
+                t.logical_share += logical * lshare
+                t.wall_share_s += wall * lshare
             self._rounds_total += 1
+            self._dispatch_total += dispatches
+            self._padded_total += padded
+            self._logical_total += logical
+            self._wall_total_s += wall
             self._self_s += time.perf_counter() - t0
 
     def add_self(self, seconds: float) -> None:
@@ -366,6 +400,10 @@ class TenantLedger:
                 "overflow_tenants": self._overflowed,
                 "admitted_total": total,
                 "rounds_total": self._rounds_total,
+                "dispatch_total": round(self._dispatch_total, 4),
+                "padded_total": self._padded_total,
+                "logical_total": self._logical_total,
+                "wall_total_s": round(self._wall_total_s, 6),
                 "self_s": round(self._self_s, 6),
                 "tenants": tenants,
             }
@@ -377,6 +415,10 @@ class TenantLedger:
             self._overflowed = 0
             self._admitted_total = 0
             self._rounds_total = 0
+            self._dispatch_total = 0.0
+            self._padded_total = 0
+            self._logical_total = 0
+            self._wall_total_s = 0.0
             self._self_s = self._self_s_flushed = 0.0
             self._active = False
             self._mutations = 0

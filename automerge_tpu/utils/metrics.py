@@ -146,6 +146,17 @@ COUNTERS: dict[str, str] = {
         "jitted dispatches observed with no routed call scope open "
         "(engine/dispatchledger.note_jit; counted so nothing escapes "
         "the amplification account)",
+    # megabatch plane (engine/dispatch.py plan_round — r20)
+    "engine_megabatch_rounds":
+        "flush rounds executed through the fused multi-doc megabatch "
+        "path (engine/dispatch.py apply_round_adaptive)",
+    "engine_megabatch_docs":
+        "documents whose reconcile rode a fused megabatch dispatch "
+        "(engine/dispatch.py; lane sharing across independent docs)",
+    "engine_megabatch_fallbacks":
+        "rounds the cost model routed back to the per-doc path after "
+        "planning buckets (engine/dispatch.py plan_round; padded wire "
+        "would have exceeded the classic gather)",
     # rows — docs-minor streaming engine
     "rows_rounds_batched": "round frames through the vectorized admission",
     "rows_rounds_fallback": "round frames through the per-round fallback",
@@ -509,6 +520,16 @@ GAUGES: dict[str, str] = {
         "p99 end-to-end critical path over the completed-trace ring "
         "(utils/tracer.py; the number ROADMAP #2's megabatching "
         "divides into stages)",
+    # megabatch plane (engine/dispatchledger.py window — r20): achieved
+    # fused-round occupancy over the ring window, refreshed with the
+    # other obs_dispatch_* gauges
+    "obs_megabatch_docs_per_dispatch":
+        "docs served per fused dispatch over the megabatch rounds in "
+        "the ledger window (engine/dispatchledger.py; the achieved "
+        "number next to perf dispatch's projection)",
+    "obs_megabatch_fill_pct":
+        "percent of fused-dispatch doc-lane capacity actually occupied "
+        "over the window's megabatch rounds (engine/dispatchledger.py)",
     # remediation plane (perf/remediate.py — r13)
     "obs_remed_quarantined":
         "nodes currently quarantined by the remediation engine "

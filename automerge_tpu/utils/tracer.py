@@ -71,7 +71,7 @@ from . import flightrec, metrics
 #: lifecycle stages, in critical-path order (the waterfall's row order)
 STAGES = (
     "finalize", "governor_delay", "queue_wait", "coalesce_wait",
-    "dispatch", "wire_serialize", "wire", "remote_decode",
+    "dispatch", "megabatch", "wire_serialize", "wire", "remote_decode",
     "remote_admission", "visibility",
 )
 
@@ -368,6 +368,19 @@ class TracePlane:
                             tr.meta["amp"] = rd["amp"]
                         if rd.get("pad_waste_pct") is not None:
                             tr.meta["pad_waste_pct"] = rd["pad_waste_pct"]
+                        mega = rd.get("mega")
+                        if mega:
+                            # this change rode a fused multi-doc round
+                            # (engine/dispatch.py apply_round_adaptive);
+                            # the span shadows "dispatch" — same window,
+                            # tagged so `perf explain` can show which
+                            # fused round carried the doc's ops
+                            tr.span("megabatch", t_start, t_end)
+                            tr.meta["mega_buckets"] = mega.get("buckets")
+                            tr.meta["mega_docs"] = mega.get("docs")
+                            if mega.get("pad_waste_pct") is not None:
+                                tr.meta["mega_pad_waste_pct"] = (
+                                    mega["pad_waste_pct"])
                     else:
                         tr.meta["round"] = round_no
                 self._park_locked(self._awaiting_wire, d, traces)

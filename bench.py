@@ -4251,6 +4251,179 @@ def run_trace_config(n_docs=24, rounds=12, writes_per_round=16,
     }
 
 
+def run_megabatch_config(n_docs=10_000, n_heavy=8, heavy_ops=400,
+                         rounds=8, draws_per_round=3000, zipf_s=1.1):
+    """Config 20: fleet megabatching on a 10K-doc zipf dirty storm.
+    The ROADMAP #2 cash-out, asserted in-run:
+
+    1. **round throughput**: the identical storm (~1K dirty docs per
+       coalesced round, caps inflated by a handful of heavy cold docs —
+       the fleet posture where the classic path gathers the full layout
+       for everyone) runs through the fused megabatch path and the
+       AMTPU_MEGABATCH=0 per-doc path; the fused side must flush rounds
+       >= 5x faster (perf/history.py MEGABATCH_SPEEDUP_MIN gates the
+       recorded ratio, and round-flush p50/p99 land in the record);
+    2. **byte parity**: per-doc converged hashes from the two paths are
+       byte-identical — the subset-row-map invariant at fleet scale —
+       and the disabled path records ZERO fused rounds;
+    3. **amplification**: fused dispatches per dirty doc served stays
+       strictly below the r17 per-doc baseline (0.019 — config 17's
+       recorded dispatches/dirty-doc floor; MEGABATCH_AMP_MAX).
+
+    Both subruns replay the same zipf draws (own rng) and pin the eager
+    (TPU-posture) dispatch path, like config 17."""
+    import random
+
+    from automerge_tpu.core.change import Change, Op
+    from automerge_tpu.core.ids import ROOT_ID
+    from automerge_tpu.engine import dispatch, dispatchledger
+    from automerge_tpu.perf.history import (MEGABATCH_AMP_MAX,
+                                            MEGABATCH_SPEEDUP_MIN)
+    from automerge_tpu.sync.service import EngineDocSet
+
+    assert dispatchledger.enabled(), (
+        "config 20 needs the dispatch ledger on (unset "
+        "AMTPU_DISPATCHLEDGER)")
+    assert dispatch.megabatch_enabled(), (
+        "config 20 needs megabatch routing on (unset AMTPU_MEGABATCH)")
+
+    def storm(svc):
+        """Heavy cold docs first (they inflate the fleet caps and then
+        stay clean), then `rounds` coalesced zipf storm rounds; returns
+        (hashes, per-round flush walls, dirty-doc round counts)."""
+        rng = random.Random(20)
+        pick = _zipf_picker(n_docs - n_heavy, zipf_s, rng)
+        for h in range(n_heavy):
+            svc.apply_changes(f"heavy{h:02d}", [Change(
+                "storm", 1, {},
+                [Op("set", ROOT_ID, key=f"k{j}", value=j)
+                 for j in range(heavy_ops)])])
+        svc.hashes()
+        seqs: dict = {}
+        walls, dirty_counts = [], []
+        for r in range(rounds):
+            dirty = sorted({pick() for _ in range(draws_per_round)})
+            dirty_counts.append(len(dirty))
+            t0 = time.perf_counter()
+            with svc.batch():
+                for d in dirty:
+                    doc = f"doc{d:05d}"
+                    seqs[doc] = seqs.get(doc, 0) + 1
+                    svc.apply_changes(doc, [Change(
+                        "storm", seqs[doc], {},
+                        ops=[Op("set", ROOT_ID, key=f"f{r % 4}",
+                                value=r)])])
+            walls.append(time.perf_counter() - t0)
+        return svc.hashes(), walls, dirty_counts
+
+    def eager_service():
+        svc = EngineDocSet(backend="rows")
+        svc._lazy_resolved = True
+        svc._resident.lazy_dispatch = False
+        return svc
+
+    led = dispatchledger.ledger()
+
+    def mega_totals():
+        sec = led.section() or {}
+        return (int(sec.get("mega_rounds_total") or 0),
+                int(sec.get("mega_dispatches_total") or 0),
+                int(sec.get("mega_docs_total") or 0))
+
+    base = mega_totals()
+    svc = eager_service()
+    try:
+        with _quiet_traceback_dumps():
+            t0 = time.perf_counter()
+            hashes_mega, walls_mega, dirty_counts = storm(svc)
+            mega_wall = time.perf_counter() - t0
+    finally:
+        svc.close()
+    after = mega_totals()
+    fused_rounds = after[0] - base[0]
+    fused_disp = after[1] - base[1]
+    fused_docs = after[2] - base[2]
+    assert fused_rounds >= rounds, (
+        f"only {fused_rounds}/{rounds} storm rounds rode the fused "
+        "path — the cost model rejected the megabatch regime")
+    amp = fused_disp / max(fused_docs, 1)
+    assert amp < MEGABATCH_AMP_MAX, (
+        f"fused amplification {amp:.4f} not strictly below the per-doc "
+        f"baseline {MEGABATCH_AMP_MAX}")
+
+    # per-doc reference subrun: same storm, routing disabled — the
+    # byte-parity oracle AND the throughput baseline in one pass
+    os.environ["AMTPU_MEGABATCH"] = "0"
+    dispatch._reload_for_tests()
+    try:
+        assert not dispatch.megabatch_enabled()
+        base_off = mega_totals()
+        svc2 = eager_service()
+        try:
+            with _quiet_traceback_dumps():
+                t0 = time.perf_counter()
+                hashes_perdoc, walls_perdoc, _ = storm(svc2)
+                perdoc_wall = time.perf_counter() - t0
+        finally:
+            svc2.close()
+        assert mega_totals()[0] == base_off[0], (
+            "disabled path still recorded fused rounds")
+    finally:
+        os.environ.pop("AMTPU_MEGABATCH", None)
+        dispatch._reload_for_tests()
+
+    diverged = sum(1 for d in hashes_mega
+                   if np.uint32(hashes_mega[d])
+                   != np.uint32(hashes_perdoc.get(d, 0)))
+    assert not diverged and set(hashes_mega) == set(hashes_perdoc), (
+        f"megabatched storm diverged from the per-doc path on "
+        f"{diverged} doc(s)")
+
+    def pct(vals, q):
+        s = sorted(vals)
+        return round(s[min(len(s) - 1, int(q * (len(s) - 1)))], 4)
+
+    speedup = round(perdoc_wall / max(mega_wall, 1e-9), 2)
+    return {
+        "config": 20,
+        "name": CONFIGS[20][0],
+        "docs": n_docs,
+        "ops": sum(dirty_counts) + n_heavy * heavy_ops,
+        "storm_rounds": rounds,
+        "zipf_s": zipf_s,
+        "dirty_per_round_mean": round(sum(dirty_counts)
+                                      / len(dirty_counts), 1),
+        "megabatch_speedup_x": speedup,
+        "megabatch_round_p50_s": pct(walls_mega, 0.50),
+        "megabatch_round_p99_s": pct(walls_mega, 0.99),
+        "perdoc_round_p50_s": pct(walls_perdoc, 0.50),
+        "perdoc_round_p99_s": pct(walls_perdoc, 0.99),
+        "megabatch_amplification": round(amp, 5),
+        "megabatch_rounds_fused": fused_rounds,
+        "megabatch_dispatches": fused_disp,
+        "megabatch_docs_served": fused_docs,
+        "megabatch_docs_per_dispatch": round(
+            fused_docs / max(fused_disp, 1), 1),
+        "megabatch_parity": 1,
+        "megabatch_disabled_parity": 1,
+        "protocol": (
+            f"{rounds} coalesced zipf({zipf_s}) storm rounds over "
+            f"{n_docs} docs (~{round(sum(dirty_counts)/len(dirty_counts))}"
+            f" dirty/round), caps inflated by {n_heavy} x {heavy_ops}-op "
+            "cold docs, eager (TPU-posture) dispatch pinned; identical "
+            "storm run through the fused megabatch path and under "
+            "AMTPU_MEGABATCH=0: byte-equal hashes asserted in-run, "
+            f"amplification < {MEGABATCH_AMP_MAX} asserted in-run, "
+            f">= {MEGABATCH_SPEEDUP_MIN}x round throughput gated in "
+            "perf check"),
+        "traffic_wall_s": round(mega_wall + perdoc_wall, 3),
+        "engine_s": round(mega_wall, 3),
+        "oracle_s": round(perdoc_wall, 3),
+        "speedup": speedup,
+        "parity": True,
+    }
+
+
 CONFIGS = {
     1: ("single-doc LWW storm (2 actors x 1000 sets)", gen_lww_storm),
     2: ("nested JSON card board (8 actors)", gen_trellis),
@@ -4288,6 +4461,10 @@ CONFIGS = {
          "end-to-end lifecycles stitched across the wire, completeness "
          ">= 99%, stage sums reconcile with e2e lag, duty cycle < 2%, "
          "unset-path parity", None),
+    20: ("fleet megabatching: 10K-doc zipf storm at ~1K dirty/round, "
+         "fused multi-doc dispatch vs per-doc path, >= 5x round "
+         "throughput, byte parity both paths, amplification below the "
+         "r17 baseline", None),
 }
 
 
@@ -4932,6 +5109,8 @@ def run_config(cfg: int, n_docs: int | None = None, oracle_cap_docs=12000):
         return run_tenant_config()
     if cfg == 19:
         return run_trace_config()
+    if cfg == 20:
+        return run_megabatch_config()
     name, gen = CONFIGS[cfg]
     kwargs = {}
     if cfg == 5 and n_docs:
@@ -5294,6 +5473,21 @@ def _final_record(results_by_cfg: dict, backend: str | None, attempts: list):
                 "trace_stages": r["trace_stages"],
                 "protocol": r["protocol"]}
                if r.get("config") == 19 else {}),
+            **({"megabatch_speedup_x": r["megabatch_speedup_x"],
+                "megabatch_round_p50_s": r["megabatch_round_p50_s"],
+                "megabatch_round_p99_s": r["megabatch_round_p99_s"],
+                "perdoc_round_p50_s": r["perdoc_round_p50_s"],
+                "perdoc_round_p99_s": r["perdoc_round_p99_s"],
+                "megabatch_amplification": r["megabatch_amplification"],
+                "megabatch_rounds_fused": r["megabatch_rounds_fused"],
+                "megabatch_dispatches": r["megabatch_dispatches"],
+                "megabatch_docs_served": r["megabatch_docs_served"],
+                "megabatch_docs_per_dispatch":
+                    r["megabatch_docs_per_dispatch"],
+                "megabatch_parity": r["megabatch_parity"],
+                "megabatch_disabled_parity": r["megabatch_disabled_parity"],
+                "protocol": r["protocol"]}
+               if r.get("config") == 20 else {}),
             **({"mttr_max_s": r["mttr_max_s"],
                 "mttr_mean_s": r["mttr_mean_s"],
                 "mttr_budget_s": r["mttr_budget_s"],

@@ -93,6 +93,8 @@ JAX_PLATFORMS=cpu python -m automerge_tpu.perf race --smoke \
     || echo "race smoke FAILED (informational here; enforced by tests + the locksan suite)"
 JAX_PLATFORMS=cpu python -m automerge_tpu.perf trace --smoke \
     || echo "trace smoke FAILED (informational here; enforced by tests + perf check)"
+JAX_PLATFORMS=cpu python -m automerge_tpu.perf megabatch --smoke \
+    || echo "megabatch smoke FAILED (informational here; enforced by tests + perf check)"
 
 echo "== stage 3/3: tier-1 suite (ROADMAP.md) =="
 set -o pipefail

@@ -56,7 +56,7 @@ def _trace(rng, n_docs=12, n_rounds=10):
 
 
 @pytest.mark.parametrize("seed", [11, 22, 33])
-def test_soak_random_dispatch_failures_converge(seed):
+def test_soak_random_dispatch_failures_converge(seed, monkeypatch):
     rng = random.Random(seed)
     rounds, finals = _trace(rng)
 
@@ -66,7 +66,13 @@ def test_soak_random_dispatch_failures_converge(seed):
         pytest.skip("python-encoder fallback has no dispatch stage")
     # this soak targets the DISPATCH failure taxonomy (the TPU posture:
     # eager per-flush dispatch + cached hash handles); pin lazy off so the
-    # CPU service default doesn't bypass the machinery under test
+    # CPU service default doesn't bypass the machinery under test, and pin
+    # megabatch off so the fused round route (r20 — host-mirror
+    # authoritative, no cached flush-time handle) doesn't bypass the
+    # handle readback under test (the fused route's own failure soak
+    # lives in tests/test_megabatch.py)
+    from automerge_tpu.engine import dispatch as round_dispatch
+    monkeypatch.setattr(round_dispatch, "_megabatch", False)
     rset.lazy_dispatch = False
     e._lazy_resolved = True
     for did in finals:
